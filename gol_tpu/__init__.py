@@ -1,0 +1,57 @@
+"""gol_tpu — a TPU-native distributed Game of Life framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference Go
+implementation (uk.ac.bris.cs/gameoflife): a concurrent + distributed
+cellular-automaton engine with a typed event stream, PGM storage I/O,
+an interactive controller (pause / snapshot / quit / kill), live
+alive-count telemetry, a visualiser protocol, and multi-device scaling
+via row-strip sharding with ring halo exchange (`lax.ppermute` over ICI)
+instead of the reference's goroutine row-farm (ref: gol/distributor.go).
+
+Public surface mirrors the reference's single exported entry point
+`gol.Run(p, events, keyPresses)` (ref: gol/gol.go:12-41):
+
+    from gol_tpu import Params, run
+    events = run(Params(turns=100, threads=1, image_width=16, image_height=16))
+    for ev in events: ...
+
+Import of this package must not initialise a JAX backend; tests set
+JAX_PLATFORMS/XLA_FLAGS in conftest before anything touches jax.
+"""
+
+from gol_tpu.params import Params
+from gol_tpu.events import (
+    AliveCellsCount,
+    CellFlipped,
+    Event,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    State,
+    StateChange,
+    TurnComplete,
+)
+
+__all__ = [
+    "Params",
+    "Event",
+    "AliveCellsCount",
+    "ImageOutputComplete",
+    "StateChange",
+    "CellFlipped",
+    "TurnComplete",
+    "FinalTurnComplete",
+    "State",
+    "run",
+]
+
+__version__ = "0.1.0"
+
+
+def run(params, keypresses=None, events=None, **kwargs):
+    """Start the engine; returns the event queue (see engine.distributor).
+
+    Deferred import so that `import gol_tpu` stays backend-free.
+    """
+    from gol_tpu.engine.distributor import run as _run
+
+    return _run(params, keypresses=keypresses, events=events, **kwargs)

@@ -1,0 +1,101 @@
+"""Typed event protocol — the public contract between engine, tests and
+visualiser, re-designed from the reference's `gol/event.go`.
+
+Six concrete event types mirror the reference exactly
+(ref: gol/event.go:19-68); stringification rules mirror the reference's
+Stringer set so a log consumer prints the same lines the SDL loop would
+(ref: gol/event.go:72-131 — CellFlipped/TurnComplete/FinalTurnComplete
+stringify to "" and are therefore never logged, ref: sdl/loop.go:44-47).
+
+Turn numbering: `completed_turns` is the number of *fully committed*
+turns, 1-based after the first turn — the convention the golden CSV uses
+(check/alive/512x512.csv row 1 == after turn 1). The reference's counter
+was 0-based-and-racy (ref: gol/distributor.go:94,118,294 vs
+gol/event.go:12-14); this framework fixes the race and keeps the
+CSV-compatible observable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List
+
+from gol_tpu.utils.cell import Cell
+
+
+class State(enum.Enum):
+    """Engine execution state (ref: gol/event.go:34-45)."""
+
+    PAUSED = 0
+    EXECUTING = 1
+    QUITTING = 2
+
+    def __str__(self) -> str:  # ref: gol/event.go:110-121
+        return self.name.capitalize()
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """Base event; every event reports how many turns were complete when it
+    was emitted (ref: gol/event.go:9-15)."""
+
+    completed_turns: int
+
+    def __str__(self) -> str:
+        return ""
+
+
+@dataclasses.dataclass(frozen=True)
+class AliveCellsCount(Event):
+    """Periodic telemetry: number of alive cells (ref: gol/event.go:19-22),
+    emitted by the ticker every `tick_seconds` (ref: gol/distributor.go:290-295)."""
+
+    cells_count: int = 0
+
+    def __str__(self) -> str:  # ref: gol/event.go:72-75
+        return f"{self.cells_count} Cells Alive"
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageOutputComplete(Event):
+    """A PGM image write finished (ref: gol/event.go:26-29)."""
+
+    filename: str = ""
+
+    def __str__(self) -> str:  # ref: gol/event.go:78-81
+        return f"File {self.filename} output complete"
+
+
+@dataclasses.dataclass(frozen=True)
+class StateChange(Event):
+    """Engine switched execution state (ref: gol/event.go:32-45)."""
+
+    new_state: State = State.EXECUTING
+
+    def __str__(self) -> str:  # ref: gol/event.go:84-87
+        return f"State change to {self.new_state}"
+
+
+@dataclasses.dataclass(frozen=True)
+class CellFlipped(Event):
+    """One cell changed state this turn (ref: gol/event.go:50-53). Emitted
+    for every initially-alive cell before turn 1 (ref: gol/distributor.go:72-80)
+    and for every cell whose state changed on each committed turn
+    (ref: gol/distributor.go:212-220). Never logged (empty string)."""
+
+    cell: Cell = Cell(0, 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class TurnComplete(Event):
+    """A turn was committed (ref: gol/event.go:58-60). The visualiser
+    renders on this (ref: sdl/loop.go:38-40). Never logged."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FinalTurnComplete(Event):
+    """The run finished; carries the complete alive-cell set — the payload
+    the golden tests assert on (ref: gol/event.go:65-68, gol_test.go:36-41)."""
+
+    alive: List[Cell] = dataclasses.field(default_factory=list)

@@ -1,0 +1,3 @@
+from gol_tpu.io.pgm import read_pgm, write_pgm, alive_cells_from_pgm
+
+__all__ = ["read_pgm", "write_pgm", "alive_cells_from_pgm"]

@@ -1,0 +1,3 @@
+from gol_tpu.models.rules import Rule, LIFE, RULES
+
+__all__ = ["Rule", "LIFE", "RULES"]

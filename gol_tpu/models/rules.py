@@ -1,0 +1,58 @@
+"""Cellular-automaton rule models.
+
+The reference hard-codes Conway's B3/S23 in two places (worker path ref:
+gol/distributor.go:325-342, serial path ref: gol/distributor.go:350-379).
+Here the rule is a *model*: a (birth, survival) pair over the
+8-neighbour count in standard B/S notation. The step kernel unrolls the
+sets into fused compare/or terms at trace time (ops/life.py:apply_rule),
+so Conway Life costs exactly the same as any other life-like rule and no
+lookup happens at runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+_RULE_RE = re.compile(r"^B(?P<birth>[0-8]*)/S(?P<survive>[0-8]*)$", re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """A life-like rule: dead cell with n neighbours becomes alive iff
+    n ∈ birth; live cell stays alive iff n ∈ survive (B3/S23 semantics
+    ref: gol/distributor.go:325-342)."""
+
+    name: str
+    birth: frozenset
+    survive: frozenset
+
+    @classmethod
+    def parse(cls, notation: str) -> "Rule":
+        m = _RULE_RE.match(notation.strip())
+        if not m:
+            raise ValueError(f"bad B/S rule notation: {notation!r}")
+        return cls(
+            name=notation.upper(),
+            birth=frozenset(int(c) for c in m.group("birth")),
+            survive=frozenset(int(c) for c in m.group("survive")),
+        )
+
+    def __str__(self) -> str:
+        return self.name
+
+
+LIFE = Rule.parse("B3/S23")
+
+#: A few well-known life-like model variants, usable via Params(rule=...).
+RULES = {
+    "B3/S23": LIFE,  # Conway's Game of Life — the reference's model
+    "B36/S23": Rule.parse("B36/S23"),  # HighLife
+    "B3678/S34678": Rule.parse("B3678/S34678"),  # Day & Night
+    "B1357/S1357": Rule.parse("B1357/S1357"),  # Replicator
+    "B2/S": Rule.parse("B2/S"),  # Seeds
+}
+
+
+def get_rule(notation: str) -> Rule:
+    return RULES.get(notation.upper()) or Rule.parse(notation)

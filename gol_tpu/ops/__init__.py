@@ -1,0 +1,23 @@
+from gol_tpu.ops.life import (
+    ALIVE,
+    alive_cells,
+    alive_count,
+    from_bits,
+    neighbour_counts,
+    step,
+    step_n,
+    step_with_diff,
+    to_bits,
+)
+
+__all__ = [
+    "ALIVE",
+    "alive_cells",
+    "alive_count",
+    "from_bits",
+    "neighbour_counts",
+    "step",
+    "step_n",
+    "step_with_diff",
+    "to_bits",
+]

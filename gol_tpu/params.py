@@ -1,0 +1,61 @@
+"""Run parameters — the analog of the reference's `gol.Params` quadruple
+(ref: gol/gol.go:4-9) plus TPU-native knobs the Go version had no need for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    """Parameters of the Game of Life run.
+
+    The first four fields are the reference contract (ref: gol/gol.go:4-9,
+    flag defaults ref: main.go:17-46). `threads` is reinterpreted the
+    TPU-native way: it is the number of *row-strip shards* the grid is
+    split into across the device mesh (the reference's dynamic row-farm
+    spawned that many goroutines per turn, ref: gol/distributor.go:129).
+    Results are shard-count independent, as the reference's tests demand
+    thread-count independence (ref: gol_test.go:16-31).
+    """
+
+    turns: int = 10000000000
+    threads: int = 8
+    image_width: int = 512
+    image_height: int = 512
+
+    # --- TPU-native knobs (no reference analog) ---
+    # Cellular-automaton rule, B/S notation. "B3/S23" is Conway Life
+    # (ref: gol/distributor.go:325-342).
+    rule: str = "B3/S23"
+    # Max turns fused into one on-device lax.fori_loop dispatch when no
+    # per-turn event consumer is attached. 1 reproduces the reference's
+    # per-turn host cadence exactly.
+    chunk: int = 1
+    # Alive-count telemetry cadence in seconds (ref ticker: 2s,
+    # gol/distributor.go:285).
+    tick_seconds: float = 2.0
+    # Directory containing <W>x<H>.pgm inputs (ref: gol/io.go:39) and the
+    # output directory (ref: gol/io.go:43).
+    image_dir: str = "images"
+    out_dir: str = "out"
+
+    def __post_init__(self):
+        if self.image_width <= 0 or self.image_height <= 0:
+            raise ValueError("image dimensions must be positive")
+        if self.turns < 0:
+            raise ValueError("turns must be >= 0")
+        if self.threads < 1:
+            raise ValueError("threads must be >= 1")
+
+    @property
+    def input_name(self) -> str:
+        """Input image stem, `<W>x<H>` (ref: gol/distributor.go:39)."""
+        return f"{self.image_width}x{self.image_height}"
+
+    def output_name(self, turn: int | None = None) -> str:
+        """Output image stem `<W>x<H>x<turns>` (ref: gol/distributor.go:181,
+        's'-snapshot variant ref: gol/distributor.go:230)."""
+        t = self.turns if turn is None else turn
+        return f"{self.image_width}x{self.image_height}x{t}"

@@ -1,0 +1,4 @@
+from gol_tpu.utils.cell import Cell
+from gol_tpu.utils.check import check
+
+__all__ = ["Cell", "check"]

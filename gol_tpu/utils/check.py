@@ -1,0 +1,9 @@
+"""Error escalation helper (ref: util/check.go:3-7)."""
+
+
+def check(err):
+    """Raise if `err` is an exception / truthy error value."""
+    if isinstance(err, BaseException):
+        raise err
+    if err:
+        raise RuntimeError(str(err))

@@ -1,0 +1,47 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax initialises.
+
+The reference proves thread-count independence by sweeping goroutine
+counts 1..16 on one machine (ref: gol_test.go:16-31); the TPU-native
+analog proves *shard-count* independence on a virtual multi-device mesh,
+so no TPU (let alone eight) is needed for correctness tests — the
+single-process stand-in for a cluster that the reference never had
+(SURVEY.md §4 "Multi-node testing without a cluster").
+"""
+
+import os
+import pathlib
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+REFERENCE = pathlib.Path("/root/reference")
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "fixtures"
+
+
+def _fixture_root() -> pathlib.Path:
+    """Golden data lives in the read-only reference checkout when present
+    (images/, check/images/, check/alive/); fall back to a repo-local copy
+    so the suite is self-contained once fixtures are vendored."""
+    if (REFERENCE / "check" / "images").is_dir():
+        return REFERENCE
+    return FIXTURES
+
+
+@pytest.fixture(scope="session")
+def golden_root() -> pathlib.Path:
+    root = _fixture_root()
+    if not (root / "check" / "images").is_dir():
+        pytest.skip("no golden fixtures available")
+    return root
+
+
+@pytest.fixture(scope="session")
+def images_dir(golden_root) -> pathlib.Path:
+    return golden_root / "images"

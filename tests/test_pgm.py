@@ -1,0 +1,59 @@
+"""PGM codec tests — byte-exactness against every reference fixture
+(writer format ref: gol/io.go:52-59,76-81; asserted end-to-end by the
+reference's TestPgm, ref: pgm_test.go:27-38)."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.io.pgm import alive_cells_from_pgm, encode_pgm, read_pgm, write_pgm
+
+
+def test_roundtrip_is_byte_exact(golden_root, tmp_path):
+    for pgm in sorted((golden_root / "check" / "images").glob("*.pgm")):
+        raw = pgm.read_bytes()
+        world = read_pgm(pgm)
+        assert encode_pgm(world) == raw, f"{pgm.name} not byte-exact"
+
+
+def test_read_shapes_and_values(images_dir):
+    for stem, (h, w) in {
+        "16x16": (16, 16),
+        "64x64": (64, 64),
+        "512x512": (512, 512),
+    }.items():
+        world = read_pgm(images_dir / f"{stem}.pgm")
+        assert world.shape == (h, w)
+        assert set(np.unique(world)) <= {0, 255}
+
+
+def test_write_creates_dirs_and_fsyncs(tmp_path):
+    world = np.zeros((4, 6), np.uint8)
+    world[1, 2] = 255
+    out = tmp_path / "out" / "nested" / "4x6.pgm"
+    write_pgm(out, world)
+    assert out.read_bytes() == b"P5\n6 4\n255\n" + world.tobytes()
+    assert np.array_equal(read_pgm(out), world)
+
+
+def test_alive_cells_convention(tmp_path):
+    # Cell is (x=col, y=row) — ref: gol/distributor.go:420-432.
+    world = np.zeros((3, 5), np.uint8)
+    world[2, 4] = 255
+    p = tmp_path / "5x3.pgm"
+    write_pgm(p, world)
+    assert alive_cells_from_pgm(p) == [(4, 2)]
+
+
+def test_reader_rejects_bad_headers(tmp_path):
+    bad_magic = tmp_path / "bad1.pgm"
+    bad_magic.write_bytes(b"P2\n2 2\n255\n\x00\x00\x00\x00")
+    with pytest.raises(ValueError):
+        read_pgm(bad_magic)
+    bad_maxval = tmp_path / "bad2.pgm"
+    bad_maxval.write_bytes(b"P5\n2 2\n15\n\x00\x00\x00\x00")
+    with pytest.raises(ValueError):
+        read_pgm(bad_maxval)
+    truncated = tmp_path / "bad3.pgm"
+    truncated.write_bytes(b"P5\n4 4\n255\n\x00\x00")
+    with pytest.raises(ValueError):
+        read_pgm(truncated)
