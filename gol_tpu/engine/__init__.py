@@ -1,0 +1,3 @@
+from gol_tpu.engine.distributor import Engine, EventQueue, run
+
+__all__ = ["Engine", "EventQueue", "run"]

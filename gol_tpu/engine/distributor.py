@@ -1,0 +1,363 @@
+"""The distributor — turn scheduler, event emitter, controller services.
+
+Re-design of the reference's `distributor` (ref: gol/distributor.go:30-209)
+for a device-resident world:
+
+- The world lives on TPU as an immutable device array; the *single*
+  engine thread owns the ref. Each committed (turn, world) pair is
+  published atomically, so the ticker reads a consistent snapshot
+  without the reference's shared mutex (whose turn counter was read
+  racily, ref: gol/distributor.go:94,118 vs :291-294).
+- Per-turn CellFlipped diffs are computed on device as `old != new`
+  masks and shipped to the host in one bulk transfer
+  (ref: gol/distributor.go:212-220 did a host-side W×H scan emitting
+  one event per cell). When no consumer needs diffs, the engine runs
+  `chunk` turns per dispatch inside `lax.fori_loop` without touching
+  the host at all — the events-off fast path.
+- Control (ticker, keyboard verbs s/q/p/k, pause) interleaves with the
+  turn loop between dispatches, replacing the reference's four extra
+  goroutines + mutex (ref: gol/distributor.go:86-89,223-302).
+
+Verb semantics (ref README.md:177-183 and gol/distributor.go:223-280):
+  's'  snapshot current world to out/<W>x<H>x<turn>.pgm (async write)
+  'q'  snapshot, then stop gracefully — unlike the reference's
+       os.Exit(0) (ref: gol/distributor.go:261) the event stream is
+       closed properly; in distributed mode this detaches the
+       controller and the engine keeps evolving (see distributed/)
+  'p'  pause/resume with StateChange events
+  'k'  snapshot + full shutdown (the verb the reference forwards but
+       never handles, ref: sdl/loop.go:25-26, README.md:183)
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+from gol_tpu.events import (
+    AliveCellsCount,
+    CellFlipped,
+    Event,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    State,
+    StateChange,
+    TurnComplete,
+)
+from gol_tpu.io.service import IOService
+from gol_tpu.ops import life
+from gol_tpu.params import Params
+from gol_tpu.parallel import make_stepper
+from gol_tpu.utils.cell import cells_from_mask
+
+_CLOSE = object()
+
+
+class EventQueue:
+    """The events channel (ref: `events chan gol.Event`, main.go:53).
+
+    Unbounded; iteration ends when the producer closes it (the analog of
+    `close(events)`, ref: gol/distributor.go:206)."""
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+        self._closed = threading.Event()
+
+    def put(self, ev: Event) -> None:
+        self._q.put(ev)
+
+    def close(self) -> None:
+        self._closed.set()
+        self._q.put(_CLOSE)
+
+    def get(self, timeout: Optional[float] = None):
+        """Next event, or None once closed and drained."""
+        item = self._q.get(timeout=timeout)
+        if item is _CLOSE:
+            self._q.put(_CLOSE)  # keep the sentinel for other consumers
+            return None
+        return item
+
+    def __iter__(self) -> Iterator[Event]:
+        while True:
+            item = self._q.get()
+            if item is _CLOSE:
+                self._q.put(_CLOSE)
+                return
+            yield item
+
+
+class Engine:
+    """One run of the automaton: load → turn loop → final output."""
+
+    def __init__(
+        self,
+        params: Params,
+        events: Optional[EventQueue] = None,
+        keypresses: Optional[queue.Queue] = None,
+        *,
+        emit_flips: bool = True,
+        initial_world: Optional[np.ndarray] = None,
+        io_service: Optional[IOService] = None,
+        stepper=None,
+    ):
+        self.p = params
+        self.events = events if events is not None else EventQueue()
+        self.keypresses = keypresses
+        self.emit_flips = emit_flips
+        self._initial_world = initial_world
+        self.io = io_service or IOService(params.image_dir, params.out_dir)
+        self._own_io = io_service is None
+        self.stepper = stepper or make_stepper(
+            threads=params.threads,
+            height=params.image_height,
+            width=params.image_width,
+            rule=params.rule,
+        )
+        # Atomically published (completed_turns, device_world, device_count);
+        # the mutex-free replacement for ref: gol/distributor.go:34-36.
+        # ONLY the engine thread dispatches device work or realises device
+        # values: the device programs contain collectives, and a second
+        # thread blocking on the device wedges the collective rendezvous
+        # when host cores are scarce. Other threads (ticker, controllers)
+        # ask for counts via _count_req and the engine services them
+        # between dispatches.
+        self._committed = (0, None, None)
+        self._paused = False
+        self._stop_reason: Optional[str] = None
+        self._ticker_stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._count_lock = threading.Lock()
+        self._count_reqs: list = []
+        # Last (turn, count) pair actually realised together — the
+        # always-consistent fallback for timed-out requests.
+        self._last_pair = (0, 0)
+        self._finished = threading.Event()
+        #: Exception that killed the engine thread, if any.
+        self.error: Optional[BaseException] = None
+
+    # --- public api ---
+
+    def start(self) -> "Engine":
+        """Run asynchronously (the analog of `go gol.Run(...)`)."""
+        self._thread = threading.Thread(target=self.run, name="gol-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    @property
+    def completed_turns(self) -> int:
+        return self._committed[0]
+
+    def alive_count_now(self, timeout: float = 5.0) -> tuple[int, int]:
+        """(completed_turns, alive_count) of the last committed world —
+        safe from any thread: posts a request the engine thread services
+        between dispatches (no foreign-thread device access). On timeout
+        (engine paused/finished/dead) returns the last consistent pair."""
+        if not self._finished.is_set():
+            ev = threading.Event()
+            box: dict = {}
+            with self._count_lock:
+                self._count_reqs.append((ev, box))
+            if ev.wait(timeout):
+                return box["turn"], box["count"]
+        return self._last_pair
+
+    # --- engine thread ---
+
+    def run(self) -> None:
+        try:
+            self._run()
+        except BaseException as e:
+            # The reference log.Fatal's on any engine error
+            # (ref: gol/distributor.go:50-52, util/check.go); here the
+            # stream closes cleanly and the error is kept for callers.
+            self.error = e
+        finally:
+            self._ticker_stop.set()
+            self._finished.set()
+            self._service_count_request()  # release any waiting requester
+            self.events.close()  # idempotent; unblocks all consumers
+            if self._own_io:
+                self.io.stop()
+
+    def _run(self) -> None:
+        p = self.p
+        # World load (ref: gol/distributor.go:38-69): from the IO service
+        # unless the caller injected a board (tests, resume-from-snapshot).
+        if self._initial_world is not None:
+            host_world = np.asarray(self._initial_world, np.uint8)
+        else:
+            host_world = self.io.read(p.input_name)
+        if host_world.shape != (p.image_height, p.image_width):
+            raise ValueError(
+                f"image {p.input_name} has shape {host_world.shape}, "
+                f"params say {(p.image_height, p.image_width)}"
+            )
+        world = self.stepper.put(host_world)
+
+        # Initial CellFlipped burst for every live cell
+        # (ref: gol/distributor.go:72-80).
+        if self.emit_flips:
+            for cell in life.alive_cells(host_world):
+                self.events.put(CellFlipped(0, cell))
+
+        self._commit(0, world, self.stepper.alive_count_async(world))
+        self._last_pair = (0, int(np.count_nonzero(host_world)))
+
+        # Ticker thread: AliveCellsCount every tick_seconds
+        # (ref: gol/distributor.go:283-302).
+        ticker = threading.Thread(target=self._ticker, name="gol-ticker", daemon=True)
+        ticker.start()
+
+        turn = 0
+        while turn < p.turns and self._stop_reason is None:
+            self._service_count_request()
+            self._poll_keys(turn)
+            if self._stop_reason is not None:
+                break
+            if self._paused:
+                time.sleep(0.01)
+                continue
+            if self.emit_flips:
+                new_world, mask, count = self.stepper.step_with_diff(world)
+                turn += 1
+                for cell in cells_from_mask(self.stepper.fetch(mask)):
+                    self.events.put(CellFlipped(turn, cell))
+                world = new_world
+                self._commit(turn, world, count)
+                self.events.put(TurnComplete(turn))
+            else:
+                k = min(p.chunk, p.turns - turn)
+                world, count = self.stepper.step_n(world, k)
+                first = turn + 1
+                turn += k
+                self._commit(turn, world, count)
+                for t in range(first, turn + 1):
+                    self.events.put(TurnComplete(t))
+
+        self._ticker_stop.set()
+        self._last_pair = (turn, int(self._committed[2]))
+
+        if self._stop_reason in ("q", "k"):
+            # Snapshot-and-stop (ref: gol/distributor.go:244-261, but with
+            # a clean close instead of os.Exit(0)).
+            self._write_snapshot(turn, world, wait=True)
+            self.io.check_idle()
+            self.events.put(StateChange(turn, State.QUITTING))
+            self.events.close()
+            return
+
+        # Normal completion (ref: gol/distributor.go:180-206).
+        self._write_snapshot(turn, world, wait=True)
+        self.events.put(
+            FinalTurnComplete(turn, life.alive_cells(self.stepper.fetch(world)))
+        )
+        self.io.check_idle()
+        self.events.put(StateChange(turn, State.QUITTING))
+        self.events.close()
+
+    # --- services ---
+
+    def _commit(self, turn: int, world, count) -> None:
+        self._committed = (turn, world, count)
+
+    def _service_count_request(self) -> None:
+        """Engine thread: answer all pending alive-count requests by
+        realising the committed device scalar (already computed inside the
+        step program — this is a D2H copy, not new device work)."""
+        with self._count_lock:
+            reqs, self._count_reqs = self._count_reqs, []
+        if not reqs:
+            return
+        turn, _, count = self._committed
+        if count is not None:
+            self._last_pair = (turn, int(count))
+        turn, n = self._last_pair
+        for ev, box in reqs:
+            box["turn"] = turn
+            box["count"] = n
+            ev.set()
+
+    def _ticker(self) -> None:
+        """AliveCellsCount every tick (ref: gol/distributor.go:283-302) —
+        but as a *requester*: the engine thread does the device reads."""
+        while not self._ticker_stop.wait(self.p.tick_seconds):
+            if self._paused:
+                # The reference's ticker blocks on the pause mutex
+                # (ref: gol/distributor.go:291-294) — no counts while paused.
+                continue
+            turn, count = self.alive_count_now(timeout=60.0)
+            if not self._ticker_stop.is_set():
+                self.events.put(AliveCellsCount(turn, count))
+
+    def _poll_keys(self, turn: int) -> None:
+        if self.keypresses is None:
+            return
+        while True:
+            try:
+                key = self.keypresses.get_nowait()
+            except queue.Empty:
+                return
+            self._handle_key(key, turn)
+            if self._paused:
+                # Block on further keys while paused (ref: gol/distributor.go:264-277),
+                # but keep servicing count requests so alive_count_now
+                # callers aren't stalled for their whole timeout.
+                while self._paused and self._stop_reason is None:
+                    self._service_count_request()
+                    try:
+                        key = self.keypresses.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
+                    self._handle_key(key, turn)
+
+    def _handle_key(self, key: str, turn: int) -> None:
+        if key == "s":
+            turn_now, world, _ = self._committed
+            self._write_snapshot(turn_now, world)
+        elif key in ("q", "k"):
+            self._stop_reason = key
+            self._paused = False
+        elif key == "p":
+            self._paused = not self._paused
+            self.events.put(
+                StateChange(turn, State.PAUSED if self._paused else State.EXECUTING)
+            )
+
+    def _write_snapshot(self, turn: int, world, wait: bool = False) -> None:
+        """Write out/<W>x<H>x<turn>.pgm and emit ImageOutputComplete once
+        the bytes land (ref: gol/distributor.go:229-241, filename
+        convention ref: gol/distributor.go:181,230)."""
+        name = self.p.output_name(turn)
+        host = self.stepper.fetch(world)
+        done = threading.Event()
+
+        def on_complete(n: str, exc: Optional[BaseException]) -> None:
+            if exc is None:
+                self.events.put(ImageOutputComplete(turn, n))
+            done.set()
+
+        self.io.write(name, host, on_complete)
+        if wait:
+            done.wait(timeout=30)
+
+
+def run(
+    params: Params,
+    keypresses: Optional[queue.Queue] = None,
+    events: Optional[EventQueue] = None,
+    **engine_kwargs,
+) -> EventQueue:
+    """Start the engine and return its event queue — the public entry
+    point mirroring `gol.Run(p, events, keyPresses)` (ref: gol/gol.go:12-41)."""
+    engine = Engine(params, events=events, keypresses=keypresses, **engine_kwargs)
+    engine.start()
+    return engine.events

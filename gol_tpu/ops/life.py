@@ -107,14 +107,25 @@ def step_n(world: jax.Array, n: int, rule: Rule | str = LIFE) -> jax.Array:
     return from_bits(bits)
 
 
+@functools.partial(jax.jit, static_argnames=("n", "rule"))
+def step_n_counted(world: jax.Array, n: int, rule: Rule | str = LIFE):
+    """`n` turns plus the resulting alive count, fused into one program —
+    the engine's fast path (one dispatch, one collective rendezvous)."""
+    rule = _resolve(rule)
+    bits = to_bits(world)
+    bits = lax.fori_loop(0, n, lambda _, b: step_bits(b, rule), bits)
+    return from_bits(bits), jnp.sum(bits, dtype=jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("rule",))
 def step_with_diff(world: jax.Array, rule: Rule | str = LIFE):
-    """One turn plus the flipped-cell mask — the device-side analog of the
-    reference's per-turn diff scan that feeds `CellFlipped` events
-    (ref: gol/distributor.go:212-220). The mask ships to the host in one
-    bulk transfer instead of one event per cell."""
-    new = from_bits(step_bits(to_bits(world), _resolve(rule)))
-    return new, world != new
+    """One turn plus the flipped-cell mask plus the alive count — the
+    device-side analog of the reference's per-turn diff scan that feeds
+    `CellFlipped` events (ref: gol/distributor.go:212-220). The mask
+    ships to the host in one bulk transfer instead of one event per cell."""
+    bits = step_bits(to_bits(world), _resolve(rule))
+    new = from_bits(bits)
+    return new, world != new, jnp.sum(bits, dtype=jnp.int32)
 
 
 @jax.jit

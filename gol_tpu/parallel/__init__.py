@@ -1,0 +1,3 @@
+from gol_tpu.parallel.stepper import Stepper, make_stepper
+
+__all__ = ["Stepper", "make_stepper"]

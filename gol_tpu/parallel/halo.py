@@ -1,0 +1,124 @@
+"""Row-strip sharding with ring halo exchange — the TPU-native flagship.
+
+The reference's coursework spec calls for workers that own horizontal
+board strips and exchange *only their edge rows* with ring neighbours
+instead of resyncing the whole board through a central node
+(ref: README.md:195-199,239-245 — specified as the halo-exchange
+extension, never implemented; the in-repo row-farm dodges it by giving
+every worker the whole board, ref: gol/distributor.go:318-347).
+
+Here it is, done the TPU way: the grid is sharded into contiguous row
+strips over a 1-D device mesh via `shard_map`; each step every shard
+sends its first/last row to its ring neighbours with `lax.ppermute` —
+two one-row transfers per shard per turn over ICI — computes the
+stencil on its strip extended by the two halo rows, and applies the B/S
+rule. The torus wraps naturally because the ring is closed: shard 0's
+upper neighbour is shard n-1, which owns the bottom rows of the grid.
+
+Multi-turn chunks keep the whole loop (halos included) on device inside
+`lax.fori_loop` — zero host round-trips between turns. The global alive
+count is a local reduction + `psum` (the distributed analog of
+ref: gol/distributor.go:420-432).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gol_tpu.models.rules import Rule
+from gol_tpu.ops.life import apply_rule, from_bits, to_bits
+
+AXIS = "rows"
+
+
+def halo_step_bits(block: jax.Array, rule: Rule, axis: str = AXIS) -> jax.Array:
+    """One turn on a local {0,1} row strip, exchanging one-row halos with
+    ring neighbours over `axis`. Runs inside `shard_map`."""
+    n = lax.axis_size(axis)
+    # My bottom row is the upper halo of the shard below me; my top row is
+    # the lower halo of the shard above me. Closed ring => toroidal wrap.
+    down = [(i, (i + 1) % n) for i in range(n)]
+    up = [(i, (i - 1) % n) for i in range(n)]
+    halo_top = lax.ppermute(block[-1:], axis, down)
+    halo_bottom = lax.ppermute(block[:1], axis, up)
+    ext = jnp.concatenate([halo_top, block, halo_bottom], axis=0)
+    # Vertical 3-sum over the extended strip (valid region = my rows),
+    # then horizontal toroidal 3-sum, minus centre — same separable
+    # kernel as ops.life.neighbour_counts.
+    v = ext[:-2] + ext[1:-1] + ext[2:]
+    counts = v + jnp.roll(v, 1, 1) + jnp.roll(v, -1, 1) - block
+    return apply_rule(block, counts, rule)
+
+
+def sharded_stepper(rule: Rule, devices: list, height: int, width: int):
+    """Build a Stepper whose world lives row-sharded across `devices`."""
+    from gol_tpu.parallel.stepper import Stepper
+
+    n = len(devices)
+    if height % n != 0:
+        raise ValueError(f"height {height} not divisible by {n} shards")
+    mesh = Mesh(np.asarray(devices), (AXIS,))
+    sharding = NamedSharding(mesh, P(AXIS, None))
+    spec = P(AXIS, None)
+
+    @jax.jit
+    def step(world):
+        @functools.partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+        def _one(block):
+            return from_bits(halo_step_bits(to_bits(block), rule))
+
+        return _one(world)
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def step_n(world, k):
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P())
+        )
+        def _many(block):
+            bits = to_bits(block)
+            bits = lax.fori_loop(0, k, lambda _, b: halo_step_bits(b, rule), bits)
+            # Local reduction + psum over the ring — the distributed
+            # alive count (ref: gol/distributor.go:420-432), fused into
+            # the same program as the turns.
+            count = lax.psum(jnp.sum(bits, dtype=jnp.int32), AXIS)
+            return from_bits(bits), count
+
+        return _many(world)
+
+    @jax.jit
+    def step_with_diff(world):
+        new, count = step_n(world, 1)
+        return new, world != new, count
+
+    @jax.jit
+    def count(world):
+        return jnp.sum(world != 0, dtype=jnp.int32)
+
+    # On the CPU backend (virtual test meshes), concurrent in-flight
+    # programs containing collectives starve each other's rendezvous when
+    # host cores are scarce — intra-program collectives are fine, so the
+    # fix is to keep at most one program in flight by blocking on each
+    # dispatch. Real TPU streams don't have this hazard; dispatch stays
+    # fully async there.
+    if devices[0].platform == "cpu":
+        _sync = jax.block_until_ready
+    else:
+        def _sync(x):
+            return x
+
+    return Stepper(
+        name=f"halo-ring-{n}",
+        shards=n,
+        put=lambda w: jax.device_put(np.asarray(w, np.uint8), sharding),
+        fetch=lambda w: np.asarray(w),
+        step=lambda w: _sync(step(w)),
+        step_n=lambda w, k: _sync(step_n(w, int(k))),
+        step_with_diff=lambda w: _sync(step_with_diff(w)),
+        alive_count_async=lambda w: _sync(count(w)),
+    )

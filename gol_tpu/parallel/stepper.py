@@ -1,0 +1,98 @@
+"""Stepper — the engine's pluggable execution backend.
+
+The reference picks between a serial sweep and a goroutine row-farm on
+`Threads` (ref: gol/distributor.go:93-115 vs :116-173). Here the choice
+is between a single-device kernel and a row-strip-sharded kernel over a
+device mesh; `Params.threads` is the *requested shard count*, and —
+exactly like the reference, where any thread count 1..16 yields
+identical boards (ref: gol_test.go:16-31) — the actual shard count is an
+internal detail that never changes results. The factory clamps the
+request to what the hardware and the grid height allow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from gol_tpu.models.rules import LIFE, Rule, get_rule
+from gol_tpu.ops import life
+
+
+@dataclasses.dataclass
+class Stepper:
+    """Uniform interface over execution strategies.
+
+    All worlds are {0,255} uint8 of shape (H, W); `put` moves a host
+    array onto device(s) with the stepper's sharding, `fetch` brings one
+    back. Step functions are jitted and reused across turns.
+    """
+
+    name: str
+    shards: int
+    put: Callable
+    fetch: Callable
+    #: world -> world (one turn; plain API convenience)
+    step: Callable
+    #: (world, k) -> (world, count_scalar): k turns + alive count, fused
+    #: into ONE device program. Exactly one program runs at a time and
+    #: only the engine thread ever dispatches or realises device values —
+    #: a second thread touching the device wedges the collective
+    #: rendezvous on hosts with few cores (see engine.distributor).
+    step_n: Callable
+    #: world -> (world, flipped_mask, count_scalar), one fused program
+    step_with_diff: Callable
+    #: world -> count device scalar (engine thread only)
+    alive_count_async: Callable
+
+    def alive_count(self, world) -> int:
+        return int(self.alive_count_async(world))
+
+
+def _single_device(rule: Rule, device=None) -> Stepper:
+    dev = device or jax.devices()[0]
+
+    return Stepper(
+        name="single",
+        shards=1,
+        put=lambda w: jax.device_put(np.asarray(w, np.uint8), dev),
+        fetch=lambda w: np.asarray(w),
+        step=lambda w: life.step(w, rule=rule),
+        step_n=lambda w, n: life.step_n_counted(w, int(n), rule=rule),
+        step_with_diff=lambda w: life.step_with_diff(w, rule=rule),
+        alive_count_async=life.alive_count,
+    )
+
+
+def shard_count(requested: int, height: int, n_devices: int) -> int:
+    """Largest feasible shard count ≤ requested: must not exceed device
+    count and must divide the grid height evenly (halo exchange needs
+    uniform strips; the reference's row-farm had no such constraint
+    because workers shared the whole board, ref: gol/distributor.go:318-347)."""
+    limit = max(1, min(requested, n_devices, height))
+    for k in range(limit, 0, -1):
+        if height % k == 0:
+            return k
+    return 1
+
+
+def make_stepper(
+    threads: int = 1,
+    height: int = 512,
+    width: int = 512,
+    rule: Rule | str = LIFE,
+    devices: Optional[list] = None,
+) -> Stepper:
+    """Build the best stepper for the request (the dispatch analog of
+    ref: gol/distributor.go:93,116 picking serial vs row-farm)."""
+    rule = get_rule(rule) if isinstance(rule, str) else rule
+    devs = devices if devices is not None else jax.devices()
+    k = shard_count(threads, height, len(devs))
+    if k <= 1:
+        return _single_device(rule, devs[0])
+    from gol_tpu.parallel.halo import sharded_stepper
+
+    return sharded_stepper(rule, devs[:k], height, width)

@@ -11,12 +11,17 @@ single-process stand-in for a cluster that the reference never had
 import os
 import pathlib
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize pins jax_platforms to the TPU plugin and ignores
+# the JAX_PLATFORMS env var; a post-import config.update is what wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

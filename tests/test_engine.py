@@ -1,0 +1,247 @@
+"""Engine integration tests — the analogs of the reference's black-box
+suite driven through `gol.Run` + the event stream (ref: gol_test.go,
+pgm_test.go, sdl_test.go, count_test.go). All runs go through the public
+`gol_tpu.run` surface with golden fixtures as ground truth."""
+
+import csv
+import queue
+
+import numpy as np
+import pytest
+
+from gol_tpu import Params, run
+from gol_tpu.engine.distributor import Engine, EventQueue
+from gol_tpu.events import (
+    AliveCellsCount,
+    CellFlipped,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    State,
+    StateChange,
+    TurnComplete,
+)
+from gol_tpu.io.pgm import alive_cells_from_pgm, read_pgm
+
+
+def drain(events):
+    """Consume the stream to close, returning (all_events, final)
+    (the reference test loop, ref: gol_test.go:36-41)."""
+    evs = list(events)
+    finals = [e for e in evs if isinstance(e, FinalTurnComplete)]
+    return evs, (finals[-1] if finals else None)
+
+
+def csv_counts(golden_root, size):
+    with open(golden_root / "check" / "alive" / f"{size}.csv") as f:
+        return {int(r["completed_turns"]): int(r["alive_cells"]) for r in csv.DictReader(f)}
+
+
+def make_params(golden_root, tmp_path, **kw):
+    defaults = dict(
+        image_dir=str(golden_root / "images"),
+        out_dir=str(tmp_path / "out"),
+        tick_seconds=60.0,  # keep the ticker quiet unless a test wants it
+    )
+    defaults.update(kw)
+    return Params(**defaults)
+
+
+# --- TestGol analog (ref: gol_test.go:15-47) ---
+
+
+@pytest.mark.parametrize("threads", [1, 2, 8, 16])
+@pytest.mark.parametrize("turns", [0, 1, 100])
+@pytest.mark.parametrize("size", [16, 64])
+def test_gol_final_board(golden_root, tmp_path, size, turns, threads):
+    p = make_params(
+        golden_root, tmp_path, turns=turns, threads=threads,
+        image_width=size, image_height=size,
+    )
+    events = run(p, emit_flips=False)
+    _, final = drain(events)
+    assert final is not None
+    assert final.completed_turns == turns
+    want = set(alive_cells_from_pgm(
+        golden_root / "check" / "images" / f"{size}x{size}x{turns}.pgm"))
+    assert set(final.alive) == want
+
+
+@pytest.mark.parametrize("threads", [1, 8])
+def test_gol_final_board_512(golden_root, tmp_path, threads):
+    p = make_params(
+        golden_root, tmp_path, turns=100, threads=threads,
+        image_width=512, image_height=512, chunk=25,
+    )
+    _, final = drain(run(p, emit_flips=False))
+    want = set(alive_cells_from_pgm(golden_root / "check" / "images" / "512x512x100.pgm"))
+    assert set(final.alive) == want
+
+
+# --- TestPgm analog (ref: pgm_test.go:10-42) ---
+
+
+@pytest.mark.parametrize("threads", [1, 4])
+@pytest.mark.parametrize("turns", [0, 1, 100])
+def test_pgm_output(golden_root, tmp_path, turns, threads):
+    p = make_params(
+        golden_root, tmp_path, turns=turns, threads=threads,
+        image_width=64, image_height=64,
+    )
+    evs, final = drain(run(p, emit_flips=False))
+    assert final is not None
+    out = tmp_path / "out" / f"64x64x{turns}.pgm"
+    want = (golden_root / "check" / "images" / f"64x64x{turns}.pgm").read_bytes()
+    assert out.read_bytes() == want
+    # ImageOutputComplete must have announced exactly that file
+    names = [e.filename for e in evs if isinstance(e, ImageOutputComplete)]
+    assert f"64x64x{turns}" in names
+
+
+# --- TestSdl analog: the event-stream invariant via a shadow board
+# (ref: sdl_test.go:18-128 — CellFlipped XORs must reconstruct every
+# intermediate board) ---
+
+
+def test_event_stream_shadow_board(golden_root, tmp_path):
+    size, turns = 64, 20
+    p = make_params(golden_root, tmp_path, turns=turns, threads=4,
+                    image_width=size, image_height=size)
+    events = run(p)  # emit_flips defaults on, like the reference
+    counts = csv_counts(golden_root, "64x64")
+    shadow = np.zeros((size, size), bool)
+    seen_turns = 0
+    final = None
+    for ev in events:
+        if isinstance(ev, CellFlipped):
+            x, y = ev.cell
+            shadow[y, x] ^= True
+        elif isinstance(ev, TurnComplete):
+            seen_turns += 1
+            assert ev.completed_turns == seen_turns
+            assert int(shadow.sum()) == counts[seen_turns], (
+                f"shadow diverges at turn {seen_turns}")
+        elif isinstance(ev, FinalTurnComplete):
+            final = ev
+    assert seen_turns == turns
+    assert final is not None and final.completed_turns == turns
+    # The shadow board must equal the final board exactly
+    assert set(final.alive) == {(int(x), int(y)) for y, x in zip(*np.nonzero(shadow))}
+
+
+# --- TestAlive analog (ref: count_test.go:17-69) ---
+
+
+def test_alive_counts_match_csv(golden_root, tmp_path):
+    counts = csv_counts(golden_root, "512x512")
+    keys: queue.Queue = queue.Queue()
+    p = make_params(
+        golden_root, tmp_path, turns=100000000, threads=8,
+        image_width=512, image_height=512, tick_seconds=0.25,
+    )
+    events = run(p, keypresses=keys, emit_flips=False)
+    initial_alive = len(alive_cells_from_pgm(golden_root / "images" / "512x512.pgm"))
+    good = 0
+    # Watchdog: first count must arrive promptly (ref: count_test.go:30-38).
+    ev = events.get(timeout=5.0)
+    while good < 5:
+        assert ev is not None, "stream closed before 5 alive-count reports"
+        if isinstance(ev, AliveCellsCount):
+            t = ev.completed_turns
+            want = initial_alive if t == 0 else counts[t] if t <= 10000 else (
+                5565 if t % 2 == 0 else 5567)
+            assert ev.cells_count == want, f"turn {t}: {ev.cells_count} != {want}"
+            good += 1
+        ev = events.get(timeout=5.0)
+    # Terminate via 'q' (ref: count_test.go:63-64) — unlike the
+    # reference's os.Exit, we get a clean close + quitting event.
+    keys.put("q")
+    evs = [ev] + [e for e in events]
+    assert any(
+        isinstance(e, StateChange) and e.new_state == State.QUITTING for e in evs)
+    assert not any(isinstance(e, FinalTurnComplete) for e in evs)
+
+
+# --- keyboard verbs (ref: gol/distributor.go:223-280) ---
+
+
+def test_snapshot_key(golden_root, tmp_path):
+    keys: queue.Queue = queue.Queue()
+    p = make_params(golden_root, tmp_path, turns=50, threads=1,
+                    image_width=16, image_height=16)
+    engine = Engine(p, keypresses=keys, emit_flips=False)
+    keys.put("s")  # handled before the first turn: snapshot of turn 0..50
+    engine.start()
+    evs, final = drain(engine.events)
+    assert final is not None
+    outs = [e.filename for e in evs if isinstance(e, ImageOutputComplete)]
+    assert len(outs) >= 2  # the 's' snapshot plus the final image
+    snap_turn = int(outs[0].rsplit("x", 1)[1])
+    snap = read_pgm(tmp_path / "out" / f"{outs[0]}.pgm")
+    # Snapshot must be the exact board at its named turn.
+    from gol_tpu.ops import life
+    world = read_pgm(golden_root / "images" / "16x16.pgm")
+    want = np.asarray(life.step_n(world, snap_turn))
+    assert np.array_equal(snap, want)
+
+
+def test_pause_resume(golden_root, tmp_path):
+    keys: queue.Queue = queue.Queue()
+    p = make_params(golden_root, tmp_path, turns=200, threads=1,
+                    image_width=16, image_height=16)
+    events = run(p, keypresses=keys, emit_flips=False)
+    keys.put("p")
+    keys.put("p")  # immediate resume
+    evs, final = drain(events)
+    assert final is not None and final.completed_turns == 200
+    states = [e.new_state for e in evs if isinstance(e, StateChange)]
+    # paused, executing (resume), quitting (final)
+    assert states.count(State.PAUSED) == states.count(State.EXECUTING)
+    assert states[-1] == State.QUITTING
+
+
+def test_kill_key_writes_final_image(golden_root, tmp_path):
+    keys: queue.Queue = queue.Queue()
+    p = make_params(golden_root, tmp_path, turns=10**9, threads=2,
+                    image_width=64, image_height=64)
+    events = run(p, keypresses=keys, emit_flips=False)
+    keys.put("k")  # the verb the reference never implemented (README.md:183)
+    evs, final = drain(events)
+    assert final is None
+    outs = [e for e in evs if isinstance(e, ImageOutputComplete)]
+    assert outs, "'k' must write a final PGM before shutdown"
+    assert (tmp_path / "out" / f"{outs[-1].filename}.pgm").exists()
+
+
+def test_injected_world_and_shape_validation(golden_root, tmp_path):
+    # resume-from-snapshot path: inject a world instead of reading images/
+    world = read_pgm(golden_root / "images" / "16x16.pgm")
+    p = make_params(golden_root, tmp_path, turns=1, threads=1,
+                    image_width=16, image_height=16)
+    engine = Engine(p, emit_flips=False, initial_world=world)
+    engine.start()
+    _, final = drain(engine.events)
+    want = set(alive_cells_from_pgm(golden_root / "check" / "images" / "16x16x1.pgm"))
+    assert set(final.alive) == want
+
+    bad = Engine(
+        make_params(golden_root, tmp_path, turns=1, threads=1,
+                    image_width=32, image_height=32),
+        emit_flips=False, initial_world=world,
+    )
+    with pytest.raises(ValueError):
+        bad._run()
+
+
+def test_engine_error_closes_stream(tmp_path):
+    # Missing input image: the stream must close (no consumer deadlock)
+    # and the error be recorded (the reference log.Fatal'd here,
+    # ref: gol/io.go:101, util/check.go).
+    p = Params(turns=5, threads=1, image_width=16, image_height=16,
+               image_dir=str(tmp_path / "nonexistent"), out_dir=str(tmp_path / "out"),
+               tick_seconds=60.0)
+    engine = Engine(p, emit_flips=False)
+    engine.start()
+    evs = list(engine.events)  # must terminate
+    engine.join(5)
+    assert engine.error is not None
+    assert not any(isinstance(e, FinalTurnComplete) for e in evs)

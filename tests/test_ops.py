@@ -89,11 +89,12 @@ def test_alive_count_matches_csv(golden_root):
 def test_step_with_diff():
     w = np.zeros((5, 5), np.uint8)
     w[2, 1:4] = 255
-    new, mask = life.step_with_diff(w)
+    new, mask, count = life.step_with_diff(w)
     flips = set(life.flipped_cells(mask))
     # blinker: ends flip off, top/bottom of centre flip on
     assert flips == {(1, 2), (3, 2), (2, 1), (2, 3)}
     assert np.array_equal(np.asarray(new) != w, np.asarray(mask))
+    assert int(count) == 3
 
 
 def test_highlife_b6_birth_differs_from_life():
