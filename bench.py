@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Headline benchmark — 512x512 Game of Life throughput on the attached
+accelerator vs the single-threaded scalar serial engine.
+
+This is the BASELINE.md north-star config (512x512 x 10,000 turns; the
+reference's sanctioned harness is 512x512 x 1000 turns,
+ref: content/ReporGuidanceCollated.md:60-82 — we run 10x that). The
+baseline denominator is `bench/baseline_serial.cpp` compiled -O2 at
+bench time: the stand-in for the reference's single-threaded Go serial
+sweep (no Go toolchain in this image; see that file's header).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent
+
+W = H = 512
+TURNS = 10_000
+CHUNK = 1_000  # turns fused per device dispatch (lax.fori_loop)
+BASELINE_TURNS = 40  # enough for a stable turns/s estimate (~2s scalar)
+
+
+def measure_baseline() -> float:
+    """Single-threaded scalar turns/s (compile bench/baseline_serial.cpp)."""
+    src = REPO / "bench" / "baseline_serial.cpp"
+    exe = REPO / "bench" / ".baseline_serial"
+    if not exe.exists() or exe.stat().st_mtime < src.stat().st_mtime:
+        subprocess.run(
+            ["g++", "-O2", "-march=native", "-o", str(exe), str(src)],
+            check=True,
+        )
+    out = subprocess.run(
+        [str(exe), str(W), str(H), str(BASELINE_TURNS)],
+        check=True,
+        capture_output=True,
+        text=True,
+    ).stdout
+    r = json.loads(out)
+    return r["turns"] / r["seconds"]
+
+
+def measure_tpu() -> tuple[float, int]:
+    """Fused-chunk turns/s on the attached device; returns (turns/s, alive
+    at turn TURNS) so correctness can be cross-checked against
+    check/alive/512x512.csv when the reference data is present."""
+    import jax
+
+    from gol_tpu.io.pgm import read_pgm
+    from gol_tpu.ops import life
+
+    ref_img = pathlib.Path("/root/reference/images") / f"{W}x{H}.pgm"
+    if ref_img.exists():
+        world0 = read_pgm(ref_img)
+    else:
+        world0 = life.random_world(H, W, density=0.25, seed=42)
+
+    world = jax.device_put(world0, jax.devices()[0])
+
+    # Warm-up: compile the chunk program and run one chunk.
+    w, c = life.step_n_counted(world, CHUNK)
+    jax.block_until_ready((w, c))
+
+    world = jax.device_put(world0, jax.devices()[0])
+    t0 = time.perf_counter()
+    count = None
+    for _ in range(TURNS // CHUNK):
+        world, count = life.step_n_counted(world, CHUNK)
+    count = int(count)  # blocks on the full chain
+    dt = time.perf_counter() - t0
+    return TURNS / dt, count
+
+
+def expected_alive() -> int | None:
+    csv = pathlib.Path("/root/reference/check/alive") / f"{W}x{H}.csv"
+    if not csv.exists():
+        return None
+    for line in csv.read_text().splitlines():
+        parts = line.split(",")
+        if parts[0] == str(TURNS):
+            return int(parts[1])
+    return None
+
+
+def main() -> None:
+    baseline = measure_baseline()
+    tps, alive = measure_tpu()
+
+    want = expected_alive()
+    if want is not None and alive != want:
+        print(
+            f"CORRECTNESS FAILURE: alive@{TURNS}={alive}, expected {want}",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"gol_{W}x{H}_{TURNS}turns_throughput",
+                "value": round(tps, 1),
+                "unit": "turns/s",
+                "vs_baseline": round(tps / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

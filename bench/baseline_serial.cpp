@@ -1,0 +1,58 @@
+// Single-threaded scalar Game of Life — the benchmark *denominator*.
+//
+// The reference's 50x throughput target is phrased against the
+// single-threaded Go serial engine (BASELINE.md); no Go toolchain exists
+// in this image, so this C++ translation-equivalent stands in: the same
+// algorithmic shape as the reference's serial sweep (per-cell loop, 8
+// bounds-wrapped neighbour reads, double buffer — ref:
+// gol/distributor.go:350-417) without being a copy of it. g++ -O2 scalar
+// code and gc-compiled Go scalar code are within a small constant factor,
+// and if anything this flatters the baseline (no GC, no channels).
+//
+// Usage: baseline_serial W H TURNS [density_seed]
+// Prints: {"turns": T, "seconds": S, "alive": N}
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <chrono>
+#include <vector>
+
+int main(int argc, char** argv) {
+  const int w = argc > 1 ? std::atoi(argv[1]) : 512;
+  const int h = argc > 2 ? std::atoi(argv[2]) : 512;
+  const int turns = argc > 3 ? std::atoi(argv[3]) : 100;
+  std::vector<uint8_t> cur((size_t)w * h), nxt((size_t)w * h);
+
+  // Deterministic pseudo-random seed board, ~25% density (xorshift).
+  uint64_t s = argc > 4 ? std::strtoull(argv[4], nullptr, 10) : 42;
+  for (auto& c : cur) {
+    s ^= s << 13; s ^= s >> 7; s ^= s << 17;
+    c = (s & 3) == 0 ? 255 : 0;
+  }
+
+  auto t0 = std::chrono::steady_clock::now();
+  for (int t = 0; t < turns; ++t) {
+    for (int y = 0; y < h; ++y) {
+      const int yu = (y == 0 ? h - 1 : y - 1) * w;
+      const int yc = y * w;
+      const int yd = (y == h - 1 ? 0 : y + 1) * w;
+      for (int x = 0; x < w; ++x) {
+        const int xl = x == 0 ? w - 1 : x - 1;
+        const int xr = x == w - 1 ? 0 : x + 1;
+        const int n = (cur[yu + xl] != 0) + (cur[yu + x] != 0) + (cur[yu + xr] != 0)
+                    + (cur[yc + xl] != 0)                      + (cur[yc + xr] != 0)
+                    + (cur[yd + xl] != 0) + (cur[yd + x] != 0) + (cur[yd + xr] != 0);
+        nxt[yc + x] = (cur[yc + x] != 0) ? ((n == 2 || n == 3) ? 255 : 0)
+                                         : (n == 3 ? 255 : 0);
+      }
+    }
+    cur.swap(nxt);
+  }
+  const double sec =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0).count();
+
+  long alive = 0;
+  for (auto c : cur) alive += c != 0;
+  std::printf("{\"turns\": %d, \"seconds\": %.6f, \"alive\": %ld}\n", turns, sec, alive);
+  return 0;
+}

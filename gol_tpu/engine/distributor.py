@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
 from typing import Iterator, Optional
 
 import numpy as np
@@ -143,8 +142,14 @@ class Engine:
     # --- public api ---
 
     def start(self) -> "Engine":
-        """Run asynchronously (the analog of `go gol.Run(...)`)."""
-        self._thread = threading.Thread(target=self.run, name="gol-engine", daemon=True)
+        """Run asynchronously (the analog of `go gol.Run(...)`).
+
+        The thread is non-daemon on purpose: interpreter shutdown while
+        the engine is mid-dispatch tears down XLA under a live C++ frame
+        (pthread forced-unwind → terminate). The engine always ends —
+        `run()`'s finally closes the stream — so waiting for it at exit
+        is bounded once the run finishes or is told to stop."""
+        self._thread = threading.Thread(target=self.run, name="gol-engine")
         self._thread.start()
         return self
 
@@ -223,9 +228,6 @@ class Engine:
             self._poll_keys(turn)
             if self._stop_reason is not None:
                 break
-            if self._paused:
-                time.sleep(0.01)
-                continue
             if self.emit_flips:
                 new_world, mask, count = self.stepper.step_with_diff(world)
                 turn += 1

@@ -91,7 +91,10 @@ class IOService:
                     # IO thread would hang every later read/check_idle.
                     exc = e
                 if on_complete is not None:
-                    on_complete(name, exc)
+                    try:
+                        on_complete(name, exc)
+                    except BaseException:
+                        pass  # a raising callback must not kill the service
             elif verb == "idle":
                 cmd[1].put(True)
             elif verb == "stop":

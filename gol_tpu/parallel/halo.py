@@ -56,7 +56,7 @@ def halo_step_bits(block: jax.Array, rule: Rule, axis: str = AXIS) -> jax.Array:
     return apply_rule(block, counts, rule)
 
 
-def sharded_stepper(rule: Rule, devices: list, height: int, width: int):
+def sharded_stepper(rule: Rule, devices: list, height: int):
     """Build a Stepper whose world lives row-sharded across `devices`."""
     from gol_tpu.parallel.stepper import Stepper
 

@@ -95,4 +95,4 @@ def make_stepper(
         return _single_device(rule, devs[0])
     from gol_tpu.parallel.halo import sharded_stepper
 
-    return sharded_stepper(rule, devs[:k], height, width)
+    return sharded_stepper(rule, devs[:k], height)

@@ -48,6 +48,10 @@ class Params:
             raise ValueError("turns must be >= 0")
         if self.threads < 1:
             raise ValueError("threads must be >= 1")
+        if self.chunk < 1:
+            raise ValueError("chunk must be >= 1")
+        if self.tick_seconds <= 0:
+            raise ValueError("tick_seconds must be > 0")
 
     @property
     def input_name(self) -> str:
