@@ -1,0 +1,7 @@
+"""`python -m gol_tpu` — process entry (ref: main.go)."""
+
+import sys
+
+from gol_tpu.cli import main
+
+sys.exit(main())

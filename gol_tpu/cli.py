@@ -1,0 +1,155 @@
+"""Command-line entry — the analog of the reference's process entry
+(ref: main.go:13-68).
+
+Same flags, same defaults, same single-dash spelling (`-t 8 -w 512
+-h 512 -turns N -noVis`, ref: main.go:17-46), plus TPU-native knobs the
+Go version had no need for (--rule, --chunk, --images, --out, --tick).
+
+Without `-noVis` the event stream drives the visualiser loop
+(`gol_tpu.visual`) — a real window when a native backend is available,
+otherwise a headless shadow board that still prints non-empty events the
+way the SDL loop does (ref: sdl/loop.go:44-47). With `-noVis` the stream
+is drained silently until `FinalTurnComplete` (ref: main.go:58-67).
+
+Keyboard verbs p/s/q/k are forwarded from the window when visualising
+(ref: sdl/loop.go:18-27) or from a raw-mode stdin reader when running
+headless in a terminal — the reference has no headless key path at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import queue
+import sys
+import threading
+from typing import Optional
+
+from gol_tpu.engine.distributor import Engine
+from gol_tpu.events import FinalTurnComplete
+from gol_tpu.params import Params
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="gol_tpu",
+        description="TPU-native distributed Game of Life",
+        allow_abbrev=False,
+        add_help=False,  # -h is image height (ref: main.go:29-33); use --help
+    )
+    # Reference contract flags (single-dash, Go flag style — ref: main.go:17-46).
+    ap.add_argument("-t", type=int, default=8, metavar="N",
+                    help="number of worker shards (default 8)")
+    ap.add_argument("-w", type=int, default=512, metavar="W",
+                    help="image width (default 512)")
+    ap.add_argument("-h", type=int, default=512, metavar="H",
+                    help="image height (default 512)")
+    ap.add_argument("-turns", type=int, default=10000000000,
+                    help="turns to process (default 10000000000)")
+    ap.add_argument("-noVis", action="store_true", dest="novis",
+                    help="disable visualisation; drain events silently")
+    ap.add_argument("--help", action="help",
+                    help="show this help message and exit")
+    # TPU-native extensions.
+    ap.add_argument("--rule", default="B3/S23",
+                    help="cellular-automaton rule in B/S notation")
+    ap.add_argument("--chunk", type=int, default=None, metavar="K",
+                    help="turns fused per device dispatch when no per-turn "
+                         "consumer is attached (default: 1 visualising, "
+                         "64 headless)")
+    ap.add_argument("--images", default="images", metavar="DIR",
+                    help="input image directory (default images/)")
+    ap.add_argument("--out", default="out", metavar="DIR",
+                    help="output image directory (default out/)")
+    ap.add_argument("--tick", type=float, default=2.0, metavar="SEC",
+                    help="AliveCellsCount cadence in seconds (default 2)")
+    ap.add_argument("--platform", default=None, metavar="NAME",
+                    help="force a jax platform (e.g. cpu, tpu); some "
+                         "site configs pin the platform so the "
+                         "JAX_PLATFORMS env var alone is ignored")
+    return ap
+
+
+def _stdin_keys(keypresses: queue.Queue, stop: threading.Event) -> None:
+    """Stdin reader forwarding the p/s/q/k verbs. The terminal mode is
+    owned by main() — this daemon thread can be frozen mid-read at
+    interpreter exit, so it must not be the one holding the restore."""
+    while not stop.is_set():
+        ch = sys.stdin.read(1)
+        if ch in ("p", "s", "q", "k"):
+            keypresses.put(ch)
+        if ch in ("q", "k") or not ch:
+            return
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    # Banner (ref: main.go:48-50).
+    print("Threads:", args.t)
+    print("Width:", args.w)
+    print("Height:", args.h)
+
+    chunk = args.chunk if args.chunk is not None else (64 if args.novis else 1)
+    params = Params(
+        turns=args.turns,
+        threads=args.t,
+        image_width=args.w,
+        image_height=args.h,
+        rule=args.rule,
+        chunk=chunk,
+        tick_seconds=args.tick,
+        image_dir=args.images,
+        out_dir=args.out,
+    )
+
+    keypresses: queue.Queue = queue.Queue()
+    stop_keys = threading.Event()
+    saved_termios = None
+    if sys.stdin.isatty():
+        import termios
+        import tty
+
+        saved_termios = termios.tcgetattr(sys.stdin.fileno())
+        tty.setcbreak(sys.stdin.fileno())
+        threading.Thread(
+            target=_stdin_keys, args=(keypresses, stop_keys),
+            name="gol-keys", daemon=True,
+        ).start()
+
+    # Per-turn CellFlipped diffs only matter when something consumes them.
+    engine = Engine(params, keypresses=keypresses, emit_flips=not args.novis)
+    engine.start()
+
+    try:
+        if args.novis:
+            # Silent drain until the final turn (ref: main.go:58-67).
+            for ev in engine.events:
+                if isinstance(ev, FinalTurnComplete):
+                    break
+        else:
+            from gol_tpu.visual import run_loop
+
+            run_loop(params, engine.events, keypresses)
+    except KeyboardInterrupt:
+        keypresses.put("q")
+    finally:
+        stop_keys.set()
+        engine.join(timeout=60)
+        if saved_termios is not None:
+            import termios
+
+            termios.tcsetattr(sys.stdin.fileno(), termios.TCSADRAIN, saved_termios)
+
+    if engine.error is not None:
+        print(f"engine error: {engine.error!r}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,8 +31,10 @@ Verb semantics (ref README.md:177-183 and gol/distributor.go:223-280):
 
 from __future__ import annotations
 
+import atexit
 import queue
 import threading
+import weakref
 from typing import Iterator, Optional
 
 import numpy as np
@@ -54,6 +56,27 @@ from gol_tpu.parallel import make_stepper
 from gol_tpu.utils.cell import cells_from_mask
 
 _CLOSE = object()
+
+# Engines whose thread may still be running. The engine thread is
+# non-daemon (see Engine.start), so an abandoned infinite run would pin
+# interpreter shutdown forever. Plain atexit fires too late — CPython
+# joins non-daemon threads BEFORE atexit callbacks — so this uses
+# threading._register_atexit, which runs at the start of
+# threading._shutdown (the hook concurrent.futures relies on for the
+# same problem).
+_live_engines: "weakref.WeakSet[Engine]" = weakref.WeakSet()
+
+
+def _stop_live_engines() -> None:
+    for engine in list(_live_engines):
+        engine.stop()
+        engine.join(timeout=30)
+
+
+try:
+    threading._register_atexit(_stop_live_engines)
+except AttributeError:  # private API; fall back for exotic interpreters
+    atexit.register(_stop_live_engines)
 
 
 class EventQueue:
@@ -150,8 +173,16 @@ class Engine:
         `run()`'s finally closes the stream — so waiting for it at exit
         is bounded once the run finishes or is told to stop."""
         self._thread = threading.Thread(target=self.run, name="gol-engine")
+        _live_engines.add(self)
         self._thread.start()
         return self
+
+    def stop(self) -> None:
+        """Programmatic graceful stop: end the turn loop at the next
+        dispatch boundary without the 'q'/'k' snapshot side effects. The
+        stream still closes with StateChange{Quitting}."""
+        self._stop_reason = self._stop_reason or "stop"
+        self._paused = False
 
     def join(self, timeout: Optional[float] = None) -> None:
         if self._thread is not None:
@@ -247,6 +278,13 @@ class Engine:
 
         self._ticker_stop.set()
         self._last_pair = (turn, int(self._committed[2]))
+
+        if self._stop_reason == "stop":
+            # Programmatic stop (Engine.stop / atexit): no snapshot, just
+            # a clean close of the stream.
+            self.events.put(StateChange(turn, State.QUITTING))
+            self.events.close()
+            return
 
         if self._stop_reason in ("q", "k"):
             # Snapshot-and-stop (ref: gol/distributor.go:244-261, but with

@@ -12,6 +12,7 @@ disk); reads are synchronous.
 
 from __future__ import annotations
 
+import logging
 import os
 import queue
 import threading
@@ -94,7 +95,11 @@ class IOService:
                     try:
                         on_complete(name, exc)
                     except BaseException:
-                        pass  # a raising callback must not kill the service
+                        # A raising callback must not kill the service —
+                        # but it must not vanish without a trace either.
+                        logging.getLogger(__name__).exception(
+                            "IO on_complete callback failed for %r", name
+                        )
             elif verb == "idle":
                 cmd[1].put(True)
             elif verb == "stop":

@@ -1,0 +1,6 @@
+"""Visualiser layer: native/headless pixel boards + the event loop."""
+
+from gol_tpu.visual.board import NativeBoard, NumpyBoard, make_board
+from gol_tpu.visual.loop import run_loop
+
+__all__ = ["NativeBoard", "NumpyBoard", "make_board", "run_loop"]

@@ -1,0 +1,208 @@
+"""Pixel-board bindings — ctypes over the native C++ core, with a pure
+NumPy shadow board as fallback.
+
+The native core (`gol_tpu/native/board.cpp`) is the analog of the
+reference's SDL window wrapper (ref: sdl/window.go); when libSDL2 is
+present at runtime it opens a real window, otherwise it is a headless
+framebuffer — the stand-in the reference's tests build by hand
+(ref: sdl_test.go:18-90, the `-noVis` shadow board).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libgolvis.so"
+_build_lock = threading.Lock()
+
+
+def _load_native() -> ctypes.CDLL | None:
+    """Build (once, cached as a .so next to the source) and load the
+    native core; None when no toolchain is available."""
+    with _build_lock:
+        src = _NATIVE_DIR / "board.cpp"
+        try:
+            if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < src.stat().st_mtime:
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR), "libgolvis.so"],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except (OSError, subprocess.CalledProcessError):
+            return None
+    lib.golvis_create.restype = ctypes.c_void_p
+    lib.golvis_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    for fn, res, args in [
+        ("golvis_has_window", ctypes.c_int, [ctypes.c_void_p]),
+        ("golvis_flip_pixel", ctypes.c_int, [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]),
+        ("golvis_set_pixel", ctypes.c_int,
+         [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]),
+        ("golvis_get_pixel", ctypes.c_int, [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]),
+        ("golvis_count_pixels", ctypes.c_long, [ctypes.c_void_p]),
+        ("golvis_clear", None, [ctypes.c_void_p]),
+        ("golvis_load_mask", None, [ctypes.c_void_p, ctypes.c_char_p]),
+        ("golvis_flip_mask", None, [ctypes.c_void_p, ctypes.c_char_p]),
+        ("golvis_render", None, [ctypes.c_void_p]),
+        ("golvis_poll_key", ctypes.c_int, [ctypes.c_void_p]),
+        ("golvis_destroy", None, [ctypes.c_void_p]),
+    ]:
+        f = getattr(lib, fn)
+        f.restype = res
+        f.argtypes = args
+    return lib
+
+
+_native: ctypes.CDLL | None = None
+_native_tried = False
+
+
+def native_lib() -> ctypes.CDLL | None:
+    global _native, _native_tried
+    if not _native_tried:
+        _native = _load_native()
+        _native_tried = True
+    return _native
+
+
+class NativeBoard:
+    """ctypes handle over the C++ board (windowed or headless)."""
+
+    def __init__(self, width: int, height: int, want_window: bool = False):
+        lib = native_lib()
+        if lib is None:
+            raise RuntimeError("native visualiser core unavailable")
+        self._lib = lib
+        self.width, self.height = width, height
+        self._h = lib.golvis_create(width, height, 1 if want_window else 0)
+        if not self._h:
+            raise RuntimeError("golvis_create failed")
+
+    @property
+    def has_window(self) -> bool:
+        return bool(self._lib.golvis_has_window(self._h))
+
+    def _check(self, rc: int) -> None:
+        if rc < 0:
+            # The reference panics on out-of-range flips (ref: sdl/window.go:80-82).
+            raise IndexError("pixel out of range")
+
+    def flip(self, x: int, y: int) -> None:
+        self._check(self._lib.golvis_flip_pixel(self._h, x, y))
+
+    def set(self, x: int, y: int, on: bool) -> None:
+        self._check(self._lib.golvis_set_pixel(self._h, x, y, 1 if on else 0))
+
+    def get(self, x: int, y: int) -> bool:
+        rc = self._lib.golvis_get_pixel(self._h, x, y)
+        self._check(rc)
+        return bool(rc)
+
+    def count(self) -> int:
+        return self._lib.golvis_count_pixels(self._h)
+
+    def clear(self) -> None:
+        self._lib.golvis_clear(self._h)
+
+    def load_mask(self, mask: np.ndarray) -> None:
+        self._lib.golvis_load_mask(self._h, self._as_bytes(mask))
+
+    def flip_mask(self, mask: np.ndarray) -> None:
+        self._lib.golvis_flip_mask(self._h, self._as_bytes(mask))
+
+    def _as_bytes(self, mask: np.ndarray) -> bytes:
+        m = np.ascontiguousarray(mask, dtype=np.uint8)
+        if m.shape != (self.height, self.width):
+            raise ValueError(f"mask shape {m.shape} != {(self.height, self.width)}")
+        return m.tobytes()
+
+    def render(self) -> None:
+        self._lib.golvis_render(self._h)
+
+    def poll_key(self) -> str | None:
+        """Next pending key as a one-char string, 'CLOSE' on window close,
+        None when no events are pending (headless boards never have any)."""
+        k = self._lib.golvis_poll_key(self._h)
+        if k == -1:
+            return "CLOSE"
+        if k > 0 and 32 <= k < 127:
+            return chr(k)
+        return None
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.golvis_destroy(self._h)
+            self._h = None
+
+
+class NumpyBoard:
+    """Pure-python shadow board — same surface, zero dependencies."""
+
+    has_window = False
+
+    def __init__(self, width: int, height: int, want_window: bool = False):
+        self.width, self.height = width, height
+        self._px = np.zeros((height, width), dtype=bool)
+
+    def _check(self, x: int, y: int) -> None:
+        if not (0 <= x < self.width and 0 <= y < self.height):
+            raise IndexError("pixel out of range")
+
+    def flip(self, x: int, y: int) -> None:
+        self._check(x, y)
+        self._px[y, x] ^= True
+
+    def set(self, x: int, y: int, on: bool) -> None:
+        self._check(x, y)
+        self._px[y, x] = on
+
+    def get(self, x: int, y: int) -> bool:
+        self._check(x, y)
+        return bool(self._px[y, x])
+
+    def count(self) -> int:
+        return int(self._px.sum())
+
+    def clear(self) -> None:
+        self._px[:] = False
+
+    def load_mask(self, mask: np.ndarray) -> None:
+        self._px[:] = self._checked(mask)
+
+    def flip_mask(self, mask: np.ndarray) -> None:
+        self._px ^= self._checked(mask)
+
+    def _checked(self, mask: np.ndarray) -> np.ndarray:
+        # Same strictness as NativeBoard._as_bytes — no silent broadcast.
+        m = np.asarray(mask)
+        if m.shape != (self.height, self.width):
+            raise ValueError(f"mask shape {m.shape} != {(self.height, self.width)}")
+        return m != 0
+
+    def render(self) -> None:
+        pass
+
+    def poll_key(self) -> str | None:
+        return None
+
+    def destroy(self) -> None:
+        pass
+
+
+def make_board(width: int, height: int, want_window: bool = False):
+    """Best available board: native (windowed if SDL2 + display exist),
+    NumPy shadow board otherwise. `GOL_TPU_NO_NATIVE=1` forces the
+    fallback (for tests)."""
+    if os.environ.get("GOL_TPU_NO_NATIVE") != "1":
+        try:
+            return NativeBoard(width, height, want_window)
+        except RuntimeError:
+            pass
+    return NumpyBoard(width, height, want_window)

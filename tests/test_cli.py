@@ -1,0 +1,51 @@
+"""CLI tests — flag surface and end-to-end runs (ref: main.go:13-68)."""
+
+import pytest
+
+from gol_tpu.cli import build_parser, main
+
+
+def test_flag_defaults_match_reference():
+    # (ref: main.go:17-46)
+    a = build_parser().parse_args([])
+    assert (a.t, a.w, a.h, a.turns, a.novis) == (8, 512, 512, 10000000000, False)
+
+
+def test_flag_parsing_single_dash_style():
+    a = build_parser().parse_args(
+        ["-t", "4", "-w", "64", "-h", "32", "-turns", "7", "-noVis"]
+    )
+    assert (a.t, a.w, a.h, a.turns, a.novis) == (4, 64, 32, 7, True)
+
+
+def test_headless_run_writes_golden_pgm(golden_root, tmp_path, capsys):
+    rc = main([
+        "-w", "64", "-h", "64", "-turns", "100", "-t", "4", "-noVis",
+        "--images", str(golden_root / "images"), "--out", str(tmp_path),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Threads: 4" in out and "Width: 64" in out and "Height: 64" in out
+    got = (tmp_path / "64x64x100.pgm").read_bytes()
+    want = (golden_root / "check" / "images" / "64x64x100.pgm").read_bytes()
+    assert got == want
+
+
+def test_visual_run_headless_board(golden_root, tmp_path, capsys, monkeypatch):
+    # No SDL2 in CI: the -noVis-less path still runs on the shadow board.
+    monkeypatch.setenv("GOL_TPU_NO_NATIVE", "1")
+    rc = main([
+        "-w", "16", "-h", "16", "-turns", "2", "-t", "1",
+        "--images", str(golden_root / "images"), "--out", str(tmp_path),
+    ])
+    assert rc == 0
+    assert "File 16x16x2 output complete" in capsys.readouterr().out
+
+
+def test_bad_image_dir_reports_engine_error(tmp_path, capsys):
+    rc = main([
+        "-w", "16", "-h", "16", "-turns", "1", "-noVis",
+        "--images", str(tmp_path / "nope"), "--out", str(tmp_path),
+    ])
+    assert rc == 1
+    assert "engine error" in capsys.readouterr().err

@@ -245,3 +245,52 @@ def test_engine_error_closes_stream(tmp_path):
     engine.join(5)
     assert engine.error is not None
     assert not any(isinstance(e, FinalTurnComplete) for e in evs)
+
+
+# --- programmatic stop + interpreter-exit safety ---
+
+
+def test_engine_stop_api(golden_root, tmp_path):
+    """Engine.stop() ends an effectively-infinite run cleanly: stream
+    closes with StateChange{Quitting}, no snapshot is written."""
+    p = make_params(golden_root, tmp_path, turns=10**9, threads=1,
+                    image_width=16, image_height=16, chunk=4)
+    eng = Engine(p, emit_flips=False)
+    eng.start()
+    import time
+
+    deadline = time.monotonic() + 30
+    while eng.completed_turns < 8 and time.monotonic() < deadline:
+        time.sleep(0.01)  # let it actually run
+    assert eng.completed_turns >= 8
+    eng.stop()
+    eng.join(30)
+    assert not eng._thread.is_alive()
+    evs = list(eng.events)
+    assert evs[-1] == StateChange(evs[-1].completed_turns, State.QUITTING)
+    assert not any(isinstance(e, (FinalTurnComplete, ImageOutputComplete)) for e in evs)
+    assert not (tmp_path / "out").exists() or not list((tmp_path / "out").iterdir())
+
+
+def test_abandoned_engine_does_not_hang_exit(golden_root, tmp_path):
+    """A started-and-forgotten infinite engine must not pin interpreter
+    shutdown (non-daemon thread + atexit stop)."""
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    code = f"""
+import sys; sys.path.insert(0, {repr(str(repo))})
+import jax; jax.config.update("jax_platforms", "cpu")
+from gol_tpu import Params, run
+events = run(Params(turns=10**10, threads=1, image_width=16, image_height=16,
+                    chunk=8, image_dir={repr(str(golden_root / 'images'))},
+                    out_dir={repr(str(tmp_path))}))
+next(iter(events))  # touch the stream, then abandon everything
+print("abandoning")
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "abandoning" in r.stdout
