@@ -19,6 +19,7 @@ headless in a terminal — the reference has no headless key path at all.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import queue
 import sys
 import threading
@@ -66,6 +67,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="force a jax platform (e.g. cpu, tpu); some "
                          "site configs pin the platform so the "
                          "JAX_PLATFORMS env var alone is ignored")
+    # Distributed split (the working version of the reference's intended
+    # controller ⇄ engine topology, ref: README.md:157-233).
+    ap.add_argument("--serve", default=None, metavar="[HOST:]PORT",
+                    help="run as a headless engine server on this address")
+    ap.add_argument("--connect", default=None, metavar="HOST:PORT",
+                    help="run as a controller attached to a remote engine")
+    ap.add_argument("--resume", default=None, metavar="SNAPSHOT.pgm",
+                    help="(with --serve) resume from an out/ snapshot, "
+                         "continuing at the turn encoded in its filename")
     return ap
 
 
@@ -94,7 +104,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     print("Width:", args.w)
     print("Height:", args.h)
 
-    chunk = args.chunk if args.chunk is not None else (64 if args.novis else 1)
+    # Headless engines (noVis drain or server) default to the fused-chunk
+    # fast path; a local visualiser needs per-turn diffs, so chunk 1.
+    headless = args.novis or args.serve is not None
+    chunk = args.chunk if args.chunk is not None else (64 if headless else 1)
     params = Params(
         turns=args.turns,
         threads=args.t,
@@ -106,6 +119,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         image_dir=args.images,
         out_dir=args.out,
     )
+
+    if args.serve is not None:
+        return _serve(args, params)
 
     keypresses: queue.Queue = queue.Queue()
     stop_keys = threading.Event()
@@ -121,34 +137,118 @@ def main(argv: Optional[list[str]] = None) -> int:
             name="gol-keys", daemon=True,
         ).start()
 
-    # Per-turn CellFlipped diffs only matter when something consumes them.
-    engine = Engine(params, keypresses=keypresses, emit_flips=not args.novis)
-    engine.start()
-
     try:
-        if args.novis:
-            # Silent drain until the final turn (ref: main.go:58-67).
-            for ev in engine.events:
-                if isinstance(ev, FinalTurnComplete):
-                    break
-        else:
-            from gol_tpu.visual import run_loop
+        if args.connect is not None:
+            return _control(args, params, keypresses)
 
-            run_loop(params, engine.events, keypresses)
-    except KeyboardInterrupt:
-        keypresses.put("q")
+        # Per-turn CellFlipped diffs only matter when something consumes them.
+        engine = Engine(params, keypresses=keypresses, emit_flips=not args.novis)
+        engine.start()
+        try:
+            if args.novis:
+                # Silent drain until the final turn (ref: main.go:58-67).
+                for ev in engine.events:
+                    if isinstance(ev, FinalTurnComplete):
+                        break
+            else:
+                from gol_tpu.visual import run_loop
+
+                run_loop(params, engine.events, keypresses)
+        except KeyboardInterrupt:
+            keypresses.put("q")
+        finally:
+            engine.join(timeout=60)
+
+        if engine.error is not None:
+            print(f"engine error: {engine.error!r}", file=sys.stderr)
+            return 1
+        return 0
     finally:
         stop_keys.set()
-        engine.join(timeout=60)
         if saved_termios is not None:
             import termios
 
             termios.tcsetattr(sys.stdin.fileno(), termios.TCSADRAIN, saved_termios)
 
-    if engine.error is not None:
-        print(f"engine error: {engine.error!r}", file=sys.stderr)
+
+def _addr(spec: str, default_host: str = "127.0.0.1") -> tuple[str, int]:
+    host, _, port = spec.rpartition(":")
+    try:
+        return (host or default_host, int(port))
+    except ValueError:
+        raise SystemExit(
+            f"error: bad address {spec!r} — expected [HOST:]PORT"
+        ) from None
+
+
+def _serve(args, params: Params) -> int:
+    """Headless engine server (the reference's AWS-side node,
+    ref: README.md:157-175)."""
+    from gol_tpu.distributed import EngineServer
+
+    host, port = _addr(args.serve, default_host="0.0.0.0")
+    server = EngineServer(params, host, port, resume_from=args.resume)
+    print(f"engine serving on {server.address[0]}:{server.address[1]}")
+    server.start()
+    try:
+        while not server.wait(timeout=1.0):
+            pass
+    except KeyboardInterrupt:
+        server.shutdown()
+    if server.engine.error is not None:
+        print(f"engine error: {server.engine.error!r}", file=sys.stderr)
         return 1
     return 0
+
+
+def _control(args, params: Params, keypresses: queue.Queue) -> int:
+    """Controller attached to a remote engine (ref: README.md:177-183)."""
+    from gol_tpu.distributed import Controller
+
+    host, port = _addr(args.connect)
+    ctl = Controller(host, port, want_flips=not args.novis)
+
+    class _WireKeys:
+        """queue.Queue-shaped sink that forwards verbs over the wire —
+        lets the visualiser loop and the stdin pump share one path."""
+
+        def put(self, key):
+            try:
+                ctl.send_key(key)
+            except (OSError, ConnectionError):
+                pass
+
+    wire_keys = _WireKeys()
+
+    def pump():  # local stdin verbs → remote engine
+        while True:
+            try:
+                wire_keys.put(keypresses.get(timeout=0.2))
+            except queue.Empty:
+                if ctl.detached.is_set():
+                    return
+
+    threading.Thread(target=pump, name="gol-ctl-keys", daemon=True).start()
+    try:
+        if args.novis:
+            for ev in ctl.events:
+                s = str(ev)
+                if s:
+                    print(f"Completed Turns {ev.completed_turns:<8}{s}")
+        else:
+            from gol_tpu.visual import run_loop
+
+            # The engine's board size wins over local -w/-h flags: the
+            # attach sync carries the authoritative dimensions.
+            if ctl.wait_sync() and ctl.board is not None:
+                h, w = ctl.board.shape
+                params = dataclasses.replace(
+                    params, image_width=w, image_height=h
+                )
+            run_loop(params, ctl.events, wire_keys)
+        return 0
+    finally:
+        ctl.close()
 
 
 if __name__ == "__main__":

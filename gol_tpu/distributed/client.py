@@ -1,0 +1,136 @@
+"""Controller client — the local half of the distributed split.
+
+Connects to an `EngineServer`, replays the attach-time board sync as an
+initial CellFlipped burst (exactly how the engine announces a freshly
+loaded world, ref: gol/distributor.go:72-80), then exposes the remote
+event stream as a local `EventQueue` — so the visualiser loop, shadow
+boards and tests all work unchanged against a remote engine. Keyboard
+verbs go the other way with `send_key` (ref: sdl/loop.go:18-27).
+
+Detach/reattach (ref: README.md:182): `send_key('q')` — the server acks
+with "detached", the local stream closes, the remote engine keeps
+evolving; a new Controller can attach later and board-sync.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import socket
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from gol_tpu.distributed import wire
+from gol_tpu.engine.distributor import EventQueue
+from gol_tpu.events import CellFlipped, TurnComplete
+from gol_tpu.utils.cell import cells_from_mask
+
+
+class ServerBusyError(ConnectionError):
+    """The engine already has a controller attached."""
+
+
+class Controller:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8030,
+        *,
+        want_flips: bool = True,
+        timeout: float = 30.0,
+    ):
+        self.events = EventQueue()
+        #: Board state from the attach sync (None until it arrives).
+        self.board: Optional[np.ndarray] = None
+        #: Completed turns as of the attach sync.
+        self.sync_turn: int = 0
+        #: Set once the attach-time BoardSync has been applied.
+        self.synced = threading.Event()
+        self.detached = threading.Event()
+        self._send_lock = threading.Lock()
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(None)
+        wire.send_msg(self._sock, {"t": "hello", "want_flips": want_flips})
+        first = wire.recv_msg(self._sock)
+        if first is not None and first.get("t") == "error":
+            self.close()
+            raise ServerBusyError(first.get("reason", "rejected"))
+        self._reader = threading.Thread(
+            target=self._reader_loop, args=(first,), name="gol-ctl-reader",
+            daemon=True,
+        )
+        self._reader.start()
+
+    def send_key(self, key: str) -> None:
+        """Forward a keyboard verb (p/s/q/k) to the engine. Callable from
+        any thread (stdin pump + visualiser share one controller)."""
+        if key not in ("p", "s", "q", "k"):
+            raise ValueError(f"unknown verb {key!r}")
+        with self._send_lock:
+            wire.send_msg(self._sock, {"t": "key", "key": key})
+
+    def wait_sync(self, timeout: float = 60.0) -> bool:
+        """Block until the attach-time board sync has been applied (or
+        the stream closed first — returns False then)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.synced.wait(0.05):
+                return True
+            if self.events.closed:
+                return self.synced.is_set()
+        return self.synced.is_set()
+
+    def detach(self, timeout: float = 30.0) -> bool:
+        """'q': detach from the engine, leaving it running."""
+        with contextlib.suppress(OSError, wire.WireError):
+            self.send_key("q")
+        return self.detached.wait(timeout)
+
+    def close(self) -> None:
+        with contextlib.suppress(OSError):
+            self._sock.shutdown(socket.SHUT_RDWR)
+        with contextlib.suppress(OSError):
+            self._sock.close()
+        self.events.close()
+
+    # --- reader ---
+
+    def _handle(self, msg: dict) -> bool:
+        """Apply one server message; False ends the stream."""
+        t = msg.get("t")
+        if t == "board":
+            self.sync_turn, board = wire.msg_to_board(msg)
+            # Replay as a flip burst + a render tick so any attached
+            # visualiser shows the synced board immediately. Flips are
+            # XOR for consumers, so the burst is the *difference* from
+            # the previous known state — idempotent under repeated syncs.
+            prev = self.board
+            diff = board != 0 if prev is None else (board != 0) ^ (prev != 0)
+            self.board = board
+            for cell in cells_from_mask(diff):
+                self.events.put(CellFlipped(self.sync_turn, cell))
+            self.events.put(TurnComplete(self.sync_turn))
+            self.synced.set()
+            return True
+        if t in ("ev", "flips"):
+            for ev in wire.msg_to_events(msg):
+                self.events.put(ev)
+            return True
+        if t == "detached":
+            self.detached.set()
+            return False
+        if t == "bye":
+            return False
+        return True  # unknown message kinds are ignored (forward compat)
+
+    def _reader_loop(self, first: Optional[dict]) -> None:
+        try:
+            msg = first
+            while msg is not None and self._handle(msg):
+                msg = wire.recv_msg(self._sock)
+        except (wire.WireError, OSError):
+            pass  # server died — surface as stream close
+        finally:
+            self.close()

@@ -1,0 +1,267 @@
+"""Engine server — the TPU-side half of the distributed split.
+
+The reference spec's topology is controller ⇄ engine over the network,
+with the engine running headless "on AWS" and controllers attaching and
+detaching at will (ref: README.md:157-233; the committed code has only
+dead stubs, ref: gol/distributor.go:44-52,459-530). This server is that
+capability, working:
+
+- owns the Engine (device turn loop) and keeps it evolving whether or
+  not a controller is attached — the fault story's first half
+  (SURVEY.md §5: "engine keeps evolving without a controller");
+- accepts ONE controller at a time over TCP; on attach it syncs the
+  full board (the role of the commented GetCurrentBoard RPC,
+  ref: gol/distributor.go:489-498) and then streams events;
+- per-turn CellFlipped diffs are streamed only while a controller that
+  asked for them is attached (`hello.want_flips`) — flips-off engines
+  run the chunked fast path, so a detached engine pays zero event tax;
+- verbs: 'p'/'s' forwarded to the engine; 'q' detaches the controller
+  and the engine lives on (ref: README.md:182); 'k' shuts the whole
+  system down after a final snapshot (ref: README.md:183);
+- `resume_from` boots the engine from an out/<W>x<H>x<T>.pgm snapshot,
+  continuing at turn T — PGM-out + PGM-in checkpoint/resume
+  (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import logging
+import os
+import queue
+import socket
+import threading
+from typing import Optional
+
+from gol_tpu.distributed import wire
+from gol_tpu.engine.distributor import Engine
+from gol_tpu.events import BoardSync, CellFlipped, TurnComplete
+from gol_tpu.io.pgm import read_pgm
+from gol_tpu.params import Params
+
+log = logging.getLogger(__name__)
+
+
+def snapshot_turn(path: str) -> int:
+    """Turn number encoded in a snapshot filename `<W>x<H>x<T>.pgm`
+    (ref: gol/distributor.go:230 filename convention)."""
+    stem = os.path.basename(path).rsplit(".", 1)[0]
+    return int(stem.split("x")[2])
+
+
+class _Conn:
+    """One attached controller: socket + send lock + subscription mode."""
+
+    _next_token = itertools.count(1).__next__  # only the accept thread draws
+
+    def __init__(self, sock: socket.socket, want_flips: bool):
+        self.sock = sock
+        self.want_flips = want_flips
+        #: Matches this connection to the BoardSync it requested.
+        self.token = _Conn._next_token()
+        # No events flow until this connection's BoardSync has been sent:
+        # a controller's first message is always the board state, never a
+        # TurnComplete it has no context for.
+        self.synced = False
+        self._lock = threading.Lock()
+
+    def send(self, msg: dict) -> None:
+        with self._lock:
+            wire.send_msg(self.sock, msg)
+
+    def close(self) -> None:
+        with contextlib.suppress(OSError):
+            self.sock.shutdown(socket.SHUT_RDWR)
+        with contextlib.suppress(OSError):
+            self.sock.close()
+
+
+class EngineServer:
+    """Serve one engine run to at-most-one controller at a time."""
+
+    def __init__(
+        self,
+        params: Params,
+        host: str = "127.0.0.1",
+        port: int = 8030,
+        *,
+        resume_from: Optional[str] = None,
+        **engine_kwargs,
+    ):
+        self.params = params
+        if resume_from is not None:
+            engine_kwargs.setdefault("initial_world", read_pgm(resume_from))
+            engine_kwargs.setdefault("start_turn", snapshot_turn(resume_from))
+        self._keys: queue.Queue = queue.Queue()
+        self.engine = Engine(
+            params, keypresses=self._keys, emit_flips=False, **engine_kwargs
+        )
+        self._listener = socket.create_server((host, port))
+        self.address = self._listener.getsockname()
+        self._conn: Optional[_Conn] = None
+        self._conn_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self.done = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # --- lifecycle ---
+
+    def start(self) -> "EngineServer":
+        self.engine.start()
+        for fn, name in [(self._accept_loop, "gol-accept"),
+                         (self._broadcast_loop, "gol-broadcast")]:
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def shutdown(self, *, stop_engine: bool = True) -> None:
+        if self._shutdown.is_set():
+            return
+        self._shutdown.set()
+        if stop_engine:
+            self.engine.stop()
+        with contextlib.suppress(OSError):
+            self._listener.close()
+        with self._conn_lock:
+            conn, self._conn = self._conn, None
+        if conn is not None:
+            with contextlib.suppress(Exception):
+                conn.send({"t": "bye"})
+            conn.close()
+        self.engine.join(timeout=60)
+        self.done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+    # --- accept path ---
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                hello = wire.recv_msg(sock)
+                if not hello or hello.get("t") != "hello":
+                    raise wire.WireError(f"bad hello: {hello!r}")
+            except (wire.WireError, OSError, ValueError) as e:
+                log.warning("rejecting connection from %s: %s", addr, e)
+                sock.close()
+                continue
+
+            conn = _Conn(sock, bool(hello.get("want_flips", False)))
+            with self._conn_lock:
+                if self._conn is not None:
+                    busy = True
+                else:
+                    self._conn, busy = conn, False
+            if busy:
+                # One controller at a time (the reference's controller is
+                # singular too, ref: README.md:201-207).
+                with contextlib.suppress(Exception):
+                    wire.send_msg(sock, {"t": "error", "reason": "busy"})
+                sock.close()
+                continue
+
+            self._attach(conn)
+            threading.Thread(
+                target=self._reader_loop, args=(conn,),
+                name="gol-conn-reader", daemon=True,
+            ).start()
+
+    def _attach(self, conn: _Conn) -> None:
+        """Ask the engine to publish a BoardSync (and, if wanted, start
+        per-turn flips) at its next dispatch boundary. Both ride the
+        event stream, so the broadcaster delivers them in turn order —
+        no side-channel race between the sync and newer diffs."""
+        self.engine.request_board_sync(
+            enable_flips=conn.want_flips, token=conn.token
+        )
+
+    def _detach(self, conn: _Conn) -> None:
+        with self._conn_lock:
+            if self._conn is conn:
+                self._conn = None
+                self.engine.emit_flips = False
+        conn.close()
+
+    # --- controller → engine ---
+
+    def _reader_loop(self, conn: _Conn) -> None:
+        while True:
+            try:
+                msg = wire.recv_msg(conn.sock)
+            except (wire.WireError, OSError):
+                msg = None
+            if msg is None:  # controller went away (crash or close)
+                self._detach(conn)
+                return
+            if msg.get("t") != "key":
+                continue
+            key = msg.get("key")
+            if key in ("p", "s"):
+                self._keys.put(key)
+            elif key == "q":
+                # Detach only — the engine keeps evolving (ref: README.md:182).
+                with contextlib.suppress(Exception):
+                    conn.send({"t": "detached"})
+                self._detach(conn)
+                return
+            elif key == "k":
+                # Global shutdown with a final snapshot (ref: README.md:183).
+                self._keys.put("k")
+                return  # broadcaster sends the tail + bye, then shutdown
+
+    # --- engine → controller ---
+
+    def _broadcast_loop(self) -> None:
+        """Single consumer of the engine's event stream; batches each
+        turn's CellFlipped burst into one wire message."""
+        flips: list = []
+        flips_turn = 0
+        for ev in self.engine.events:
+            conn = self._conn
+            if isinstance(ev, CellFlipped):
+                if conn is not None and conn.want_flips:
+                    flips_turn = ev.completed_turns
+                    flips.append([ev.cell.x, ev.cell.y])
+                continue
+            if conn is None:
+                flips.clear()
+                continue
+            try:
+                if isinstance(ev, BoardSync):
+                    if ev.token != conn.token:
+                        # Sync for a controller that vanished before it
+                        # was serviced; re-assert the current conn's
+                        # subscription (a stale enable_flips may have
+                        # turned diffs on for nobody).
+                        self.engine.emit_flips = conn.want_flips and conn.synced
+                        continue
+                    flips.clear()  # the sync supersedes any batched diff
+                    conn.send(wire.board_to_msg(ev.completed_turns, ev.world,
+                                                ev.token))
+                    conn.synced = True
+                    continue
+                if not conn.synced:
+                    continue  # pre-sync events are not this controller's
+                if flips and isinstance(ev, TurnComplete):
+                    conn.send({"t": "flips", "turn": flips_turn, "cells": flips})
+                    flips.clear()
+                conn.send(wire.event_to_msg(ev))
+            except (wire.WireError, OSError):
+                self._detach(conn)
+                flips.clear()
+                continue
+        # Engine stream closed: the run is over (final turn, 'k', or stop).
+        with self._conn_lock:
+            conn, self._conn = self._conn, None
+        if conn is not None:
+            with contextlib.suppress(Exception):
+                conn.send({"t": "bye"})
+            conn.close()
+        self.shutdown(stop_engine=False)

@@ -1,0 +1,149 @@
+"""Wire protocol for the controller ⇄ engine link.
+
+The reference intended `net/rpc` over TCP between controller, broker and
+engine workers but shipped only dead stubs (ref: gol/distributor.go:44-52,
+459-530; topology spec ref: README.md:201-207). This is the working
+equivalent: length-prefixed JSON messages over a stream socket — a
+control plane carrying events, keys and board syncs. (The *data plane* —
+halo exchange, alive-count reductions — never touches this layer: it is
+XLA collectives over ICI inside the step program, see parallel/halo.py.)
+
+Framing: 4-byte big-endian payload length + UTF-8 JSON object. Every
+message has a "t" discriminator. Board rasters ride zlib-compressed then
+base64 — a GoL board is mostly dead cells, so even a 5120² raster
+compresses well under the 64 MiB frame cap.
+
+Message catalog:
+  controller → engine:
+    {"t":"hello","want_flips":bool}   attach + subscription mode
+    {"t":"key","key":"p|s|q|k"}       keyboard verb (ref: sdl/loop.go:18-27)
+  engine → controller:
+    {"t":"board","turn":N,"width":W,"height":H,"data":b64}  attach sync
+    {"t":"flips","turn":N,"cells":[[x,y],...]}              per-turn diff
+    {"t":"ev", ...}                   one serialized Event (below)
+    {"t":"detached"}                  'q' acknowledged; engine lives on
+    {"t":"bye"}                       stream over (final turn or 'k')
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from gol_tpu.events import (
+    AliveCellsCount,
+    CellFlipped,
+    Event,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    State,
+    StateChange,
+    TurnComplete,
+)
+from gol_tpu.utils.cell import Cell
+
+MAX_FRAME = 64 << 20
+_LEN = struct.Struct(">I")
+
+
+class WireError(ConnectionError):
+    pass
+
+
+def send_msg(sock: socket.socket, msg: dict) -> None:
+    payload = json.dumps(msg, separators=(",", ":")).encode()
+    if len(payload) > MAX_FRAME:
+        raise WireError(f"frame too large: {len(payload)} bytes")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict]:
+    """Next message, or None on clean EOF at a frame boundary."""
+    header = _recv_exact(sock, _LEN.size, allow_eof=True)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise WireError(f"frame too large: {n} bytes")
+    payload = _recv_exact(sock, n, allow_eof=False)
+    return json.loads(payload.decode())
+
+
+def _recv_exact(sock: socket.socket, n: int, allow_eof: bool) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if allow_eof and not buf:
+                return None
+            raise WireError("connection closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+# --- event (de)serialization ---
+
+_STATE = {s.name: s for s in State}
+
+
+def event_to_msg(ev: Event) -> dict:
+    if isinstance(ev, AliveCellsCount):
+        return {"t": "ev", "k": "alive", "turn": ev.completed_turns,
+                "count": ev.cells_count}
+    if isinstance(ev, ImageOutputComplete):
+        return {"t": "ev", "k": "image", "turn": ev.completed_turns,
+                "filename": ev.filename}
+    if isinstance(ev, StateChange):
+        return {"t": "ev", "k": "state", "turn": ev.completed_turns,
+                "state": ev.new_state.name}
+    if isinstance(ev, TurnComplete):
+        return {"t": "ev", "k": "turn", "turn": ev.completed_turns}
+    if isinstance(ev, FinalTurnComplete):
+        return {"t": "ev", "k": "final", "turn": ev.completed_turns,
+                "alive": [[c.x, c.y] for c in ev.alive]}
+    if isinstance(ev, CellFlipped):  # normally batched into "flips"
+        return {"t": "flips", "turn": ev.completed_turns,
+                "cells": [[ev.cell.x, ev.cell.y]]}
+    raise TypeError(f"unserializable event {ev!r}")
+
+
+def msg_to_events(msg: dict) -> list[Event]:
+    """Expand one engine→controller message into Event objects (a "flips"
+    batch becomes one CellFlipped per cell)."""
+    t = msg["t"]
+    if t == "flips":
+        turn = msg["turn"]
+        return [CellFlipped(turn, Cell(x, y)) for x, y in msg["cells"]]
+    if t != "ev":
+        raise TypeError(f"not an event message: {msg!r}")
+    k, turn = msg["k"], msg["turn"]
+    if k == "alive":
+        return [AliveCellsCount(turn, msg["count"])]
+    if k == "image":
+        return [ImageOutputComplete(turn, msg["filename"])]
+    if k == "state":
+        return [StateChange(turn, _STATE[msg["state"]])]
+    if k == "turn":
+        return [TurnComplete(turn)]
+    if k == "final":
+        return [FinalTurnComplete(turn, [Cell(x, y) for x, y in msg["alive"]])]
+    raise TypeError(f"unknown event kind {k!r}")
+
+
+def board_to_msg(turn: int, world: np.ndarray, token: int = 0) -> dict:
+    h, w = world.shape
+    raw = zlib.compress(np.ascontiguousarray(world, np.uint8).tobytes(), 1)
+    return {"t": "board", "turn": turn, "width": w, "height": h,
+            "token": token, "data": base64.b64encode(raw).decode("ascii")}
+
+
+def msg_to_board(msg: dict) -> tuple[int, np.ndarray]:
+    raw = zlib.decompress(base64.b64decode(msg["data"]))
+    world = np.frombuffer(raw, np.uint8).reshape(msg["height"], msg["width"])
+    return msg["turn"], world

@@ -41,6 +41,7 @@ import numpy as np
 
 from gol_tpu.events import (
     AliveCellsCount,
+    BoardSync,
     CellFlipped,
     Event,
     FinalTurnComplete,
@@ -96,6 +97,10 @@ class EventQueue:
         self._closed.set()
         self._q.put(_CLOSE)
 
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
     def get(self, timeout: Optional[float] = None):
         """Next event, or None once closed and drained."""
         item = self._q.get(timeout=timeout)
@@ -124,6 +129,7 @@ class Engine:
         *,
         emit_flips: bool = True,
         initial_world: Optional[np.ndarray] = None,
+        start_turn: int = 0,
         io_service: Optional[IOService] = None,
         stepper=None,
     ):
@@ -132,6 +138,12 @@ class Engine:
         self.keypresses = keypresses
         self.emit_flips = emit_flips
         self._initial_world = initial_world
+        # Resuming from a checkpoint: the world is `initial_world` as of
+        # `start_turn` completed turns (PGM snapshots are complete state,
+        # turn number in the filename — SURVEY.md §5 checkpoint/resume).
+        if start_turn < 0 or start_turn > params.turns:
+            raise ValueError("start_turn must be in [0, turns]")
+        self.start_turn = start_turn
         self.io = io_service or IOService(params.image_dir, params.out_dir)
         self._own_io = io_service is None
         self.stepper = stepper or make_stepper(
@@ -153,8 +165,9 @@ class Engine:
         self._stop_reason: Optional[str] = None
         self._ticker_stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._count_lock = threading.Lock()
-        self._count_reqs: list = []
+        self._req_lock = threading.Lock()
+        # Pending cross-thread requests, each ("count"|"world", event, box).
+        self._requests: list = []
         # Last (turn, count) pair actually realised together — the
         # always-consistent fallback for timed-out requests.
         self._last_pair = (0, 0)
@@ -200,11 +213,23 @@ class Engine:
         if not self._finished.is_set():
             ev = threading.Event()
             box: dict = {}
-            with self._count_lock:
-                self._count_reqs.append((ev, box))
+            with self._req_lock:
+                self._requests.append(("count", ev, box))
             if ev.wait(timeout):
                 return box["turn"], box["count"]
         return self._last_pair
+
+    def request_board_sync(self, enable_flips: bool = False, token: int = 0) -> None:
+        """Ask the engine thread to publish a BoardSync event at the next
+        dispatch boundary, optionally turning on per-turn CellFlipped
+        diffs *at that same boundary* — so a subscriber that applies the
+        sync then the flips never misses or double-applies a turn.
+        `token` is echoed on the BoardSync so the consumer can match the
+        sync to the subscriber that asked for it."""
+        with self._req_lock:
+            self._requests.append(
+                ("sync", None, {"enable_flips": enable_flips, "token": token})
+            )
 
     # --- engine thread ---
 
@@ -219,7 +244,7 @@ class Engine:
         finally:
             self._ticker_stop.set()
             self._finished.set()
-            self._service_count_request()  # release any waiting requester
+            self._service_requests()  # release any waiting requester
             self.events.close()  # idempotent; unblocks all consumers
             if self._own_io:
                 self.io.stop()
@@ -243,19 +268,19 @@ class Engine:
         # (ref: gol/distributor.go:72-80).
         if self.emit_flips:
             for cell in life.alive_cells(host_world):
-                self.events.put(CellFlipped(0, cell))
+                self.events.put(CellFlipped(self.start_turn, cell))
 
-        self._commit(0, world, self.stepper.alive_count_async(world))
-        self._last_pair = (0, int(np.count_nonzero(host_world)))
+        self._commit(self.start_turn, world, self.stepper.alive_count_async(world))
+        self._last_pair = (self.start_turn, int(np.count_nonzero(host_world)))
 
         # Ticker thread: AliveCellsCount every tick_seconds
         # (ref: gol/distributor.go:283-302).
         ticker = threading.Thread(target=self._ticker, name="gol-ticker", daemon=True)
         ticker.start()
 
-        turn = 0
+        turn = self.start_turn
         while turn < p.turns and self._stop_reason is None:
-            self._service_count_request()
+            self._service_requests()
             self._poll_keys(turn)
             if self._stop_reason is not None:
                 break
@@ -309,22 +334,29 @@ class Engine:
     def _commit(self, turn: int, world, count) -> None:
         self._committed = (turn, world, count)
 
-    def _service_count_request(self) -> None:
-        """Engine thread: answer all pending alive-count requests by
-        realising the committed device scalar (already computed inside the
-        step program — this is a D2H copy, not new device work)."""
-        with self._count_lock:
-            reqs, self._count_reqs = self._count_reqs, []
+    def _service_requests(self) -> None:
+        """Engine thread: answer all pending cross-thread requests by
+        realising committed device values (D2H copies of results already
+        computed inside the step program — no new device work)."""
+        with self._req_lock:
+            reqs, self._requests = self._requests, []
         if not reqs:
             return
-        turn, _, count = self._committed
+        turn, world, count = self._committed
         if count is not None:
             self._last_pair = (turn, int(count))
-        turn, n = self._last_pair
-        for ev, box in reqs:
-            box["turn"] = turn
-            box["count"] = n
-            ev.set()
+        for kind, ev, box in reqs:
+            if kind == "sync":
+                if world is not None and not self._finished.is_set():
+                    self.events.put(
+                        BoardSync(turn, self.stepper.fetch(world), box["token"])
+                    )
+                    if box["enable_flips"]:
+                        self.emit_flips = True
+            else:
+                box["turn"], box["count"] = self._last_pair
+            if ev is not None:
+                ev.set()
 
     def _ticker(self) -> None:
         """AliveCellsCount every tick (ref: gol/distributor.go:283-302) —
@@ -352,7 +384,7 @@ class Engine:
                 # but keep servicing count requests so alive_count_now
                 # callers aren't stalled for their whole timeout.
                 while self._paused and self._stop_reason is None:
-                    self._service_count_request()
+                    self._service_requests()
                     try:
                         key = self.keypresses.get(timeout=0.1)
                     except queue.Empty:

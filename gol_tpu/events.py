@@ -99,3 +99,21 @@ class FinalTurnComplete(Event):
     the golden tests assert on (ref: gol/event.go:65-68, gol_test.go:36-41)."""
 
     alive: List[Cell] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class BoardSync(Event):
+    """Framework extension (no reference analog): a full host copy of the
+    committed world, emitted by the engine when a controller attaches
+    mid-run. Riding the event stream — not a side channel — is what makes
+    the attach sync ordered against per-turn CellFlipped diffs: BoardSync
+    at turn N is always followed by flips for N+1, never overlapped.
+    Plays the role of the reference's commented GetCurrentBoard RPC
+    (ref: gol/distributor.go:489-498). Never logged (empty string).
+
+    `token` identifies the requester, so a sync queued for a subscriber
+    that vanished before it was serviced is dropped instead of being
+    delivered to the next subscriber."""
+
+    world: "object" = None  # np.ndarray (H, W) {0,255}
+    token: int = 0

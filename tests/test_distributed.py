@@ -1,0 +1,278 @@
+"""Distributed split tests — controller ⇄ engine over localhost TCP.
+
+What the reference could never test (its engine was a dead stub dialing
+a hard-coded 2022 AWS host, ref: gol/distributor.go:49-52): attach,
+board-sync, live event streaming, detach-and-keep-running ('q'),
+reattach, global shutdown with final snapshot ('k'), snapshot resume,
+and single-controller arbitration — all in-process against a real
+engine on the virtual device mesh.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu.distributed import Controller, EngineServer, ServerBusyError, snapshot_turn
+from gol_tpu.distributed.wire import (
+    board_to_msg,
+    event_to_msg,
+    msg_to_board,
+    msg_to_events,
+)
+from gol_tpu.events import (
+    AliveCellsCount,
+    CellFlipped,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    State,
+    StateChange,
+    TurnComplete,
+)
+from gol_tpu.io.pgm import read_pgm
+from gol_tpu.params import Params
+from gol_tpu.visual.board import NumpyBoard
+
+
+def make_server(golden_root, tmp_path, resume_from=None, **kw):
+    defaults = dict(
+        turns=100, threads=2, image_width=64, image_height=64,
+        image_dir=str(golden_root / "images"), out_dir=str(tmp_path / "out"),
+        tick_seconds=60.0, chunk=2,
+    )
+    defaults.update(kw)
+    return EngineServer(Params(**defaults), port=0, resume_from=resume_from)
+
+
+# --- wire unit tests ---
+
+
+def test_wire_event_roundtrip():
+    evs = [
+        AliveCellsCount(7, 42),
+        ImageOutputComplete(8, "64x64x8"),
+        StateChange(9, State.PAUSED),
+        TurnComplete(10),
+        FinalTurnComplete(11, [  # alive set survives the trip
+            *(msg_to_events({"t": "flips", "turn": 11,
+                             "cells": [[1, 2], [3, 4]]})[i].cell for i in range(2))
+        ]),
+    ]
+    for ev in evs:
+        (back,) = msg_to_events(event_to_msg(ev))
+        assert back == ev
+
+
+def test_wire_board_roundtrip():
+    world = (np.arange(12, dtype=np.uint8).reshape(3, 4) % 2) * 255
+    turn, back = msg_to_board(board_to_msg(5, world))
+    assert turn == 5
+    np.testing.assert_array_equal(back, world)
+
+
+def test_snapshot_turn_parsing():
+    assert snapshot_turn("/x/out/512x512x3671.pgm") == 3671
+
+
+# --- end-to-end ---
+
+
+def test_attach_stream_final(golden_root, tmp_path):
+    """A controller attached from the start sees a consistent stream and
+    the correct final alive set (remote TestGol analog)."""
+    server = make_server(golden_root, tmp_path).start()
+    ctl = Controller(*server.address, want_flips=True)
+    board = NumpyBoard(64, 64)
+    final = None
+    for ev in ctl.events:
+        if isinstance(ev, CellFlipped):
+            board.flip(ev.cell.x, ev.cell.y)
+        elif isinstance(ev, FinalTurnComplete):
+            final = ev
+    assert final is not None and final.completed_turns == 100
+    golden = read_pgm(golden_root / "check" / "images" / "64x64x100.pgm")
+    want = {(x, y) for y, x in zip(*np.nonzero(golden))}
+    assert {(c.x, c.y) for c in final.alive} == want
+    # The flip stream reconstructed the same board (BoardSync + diffs).
+    np.testing.assert_array_equal(board._px, golden != 0)
+    assert server.wait(30)
+    ctl.close()
+
+
+def test_detach_keeps_engine_running_then_reattach(golden_root, tmp_path):
+    """'q' detaches the controller; the engine keeps evolving; a second
+    controller attaches, board-syncs, and tracks to the end
+    (ref: README.md:182 + the fault story, SURVEY.md §5)."""
+    server = make_server(golden_root, tmp_path, turns=300, chunk=1).start()
+    ctl1 = Controller(*server.address, want_flips=True)
+    seen_turn = 0
+    for ev in ctl1.events:
+        if isinstance(ev, TurnComplete) and ev.completed_turns >= 3:
+            seen_turn = ev.completed_turns
+            break
+    assert ctl1.detach(30)
+    assert not server.done.is_set()
+
+    # Engine must advance while no controller is attached.
+    deadline = time.monotonic() + 30
+    while server.engine.completed_turns <= seen_turn + 5:
+        assert time.monotonic() < deadline, "engine stalled after detach"
+        time.sleep(0.01)
+
+    ctl2 = Controller(*server.address, want_flips=True)
+    board = NumpyBoard(64, 64)
+    synced = None
+    final = None
+    for ev in ctl2.events:
+        if isinstance(ev, CellFlipped):
+            board.flip(ev.cell.x, ev.cell.y)
+        elif isinstance(ev, FinalTurnComplete):
+            final = ev
+    assert ctl2.board is not None and ctl2.sync_turn > seen_turn
+    assert final is not None and final.completed_turns == 300
+    assert board.count() == len(final.alive)
+    ctl1.close()
+    ctl2.close()
+    assert server.wait(30)
+
+
+def test_kill_verb_shuts_down_with_snapshot(golden_root, tmp_path):
+    """'k' stops the whole system after writing the latest board
+    (ref: README.md:183 — the verb the reference never implemented)."""
+    server = make_server(golden_root, tmp_path, turns=10**9).start()
+    ctl = Controller(*server.address, want_flips=False)
+    got_image = None
+    sent_k = False
+    for ev in ctl.events:
+        if not sent_k and isinstance(ev, TurnComplete) and ev.completed_turns >= 4:
+            ctl.send_key("k")
+            sent_k = True
+        if isinstance(ev, ImageOutputComplete):
+            got_image = ev
+    assert server.wait(60)
+    assert got_image is not None
+    snap = tmp_path / "out" / f"{got_image.filename}.pgm"
+    assert snap.exists()
+    assert snapshot_turn(str(snap)) == got_image.completed_turns
+    ctl.close()
+
+
+def test_resume_from_snapshot_golden(golden_root, tmp_path):
+    """PGM checkpoint/resume against golden data: a turn-60 snapshot
+    (produced with the core kernel) resumed to turn 100 must land exactly
+    on the golden 64x64x100 board."""
+    from gol_tpu.io.pgm import write_pgm
+    from gol_tpu.ops import life
+
+    w0 = read_pgm(golden_root / "images" / "64x64.pgm")
+    snap = tmp_path / "out" / "64x64x60.pgm"
+    write_pgm(snap, np.asarray(life.step_n(w0, 60)))
+
+    server = make_server(golden_root, tmp_path, turns=100,
+                         resume_from=str(snap)).start()
+    assert server.engine.start_turn == 60
+    ctl = Controller(*server.address, want_flips=False)
+    final = None
+    for ev in ctl.events:
+        if isinstance(ev, FinalTurnComplete):
+            final = ev
+    assert final is not None and final.completed_turns == 100
+    assert server.wait(30)
+    golden = read_pgm(golden_root / "check" / "images" / "64x64x100.pgm")
+    want = {(x, y) for y, x in zip(*np.nonzero(golden))}
+    assert {(c.x, c.y) for c in final.alive} == want
+    ctl.close()
+
+
+def test_live_kill_snapshot_resumes_exactly(golden_root, tmp_path):
+    """Live 'k' checkpoint at an arbitrary turn T, then resume T→T+50:
+    the resumed run must match step_n(snapshot, 50) cell-for-cell."""
+    from gol_tpu.ops import life
+
+    server = make_server(golden_root, tmp_path, turns=10**9).start()
+    ctl = Controller(*server.address, want_flips=False)
+    snap_ev = None
+    sent = False
+    for ev in ctl.events:
+        if not sent:
+            ctl.send_key("k")  # checkpoint wherever the engine is
+            sent = True
+        if isinstance(ev, ImageOutputComplete):
+            snap_ev = ev
+    assert server.wait(60) and snap_ev is not None
+    snap = tmp_path / "out" / f"{snap_ev.filename}.pgm"
+    t0 = snapshot_turn(str(snap))
+    assert t0 == snap_ev.completed_turns
+
+    server2 = make_server(golden_root, tmp_path, turns=t0 + 50,
+                          resume_from=str(snap)).start()
+    ctl2 = Controller(*server2.address, want_flips=False)
+    final = None
+    for ev in ctl2.events:
+        if isinstance(ev, FinalTurnComplete):
+            final = ev
+    assert final is not None and final.completed_turns == t0 + 50
+    assert server2.wait(30)
+    expect = np.asarray(life.step_n(read_pgm(snap), 50))
+    want = {(x, y) for y, x in zip(*np.nonzero(expect))}
+    assert {(c.x, c.y) for c in final.alive} == want
+    ctl.close()
+    ctl2.close()
+
+
+def test_second_controller_rejected_while_busy(golden_root, tmp_path):
+    server = make_server(golden_root, tmp_path, turns=10**9).start()
+    ctl = Controller(*server.address, want_flips=False)
+    with pytest.raises(ServerBusyError):
+        Controller(*server.address)
+    assert ctl.detach(30)
+    # After detach the slot is free again.
+    ctl2 = Controller(*server.address, want_flips=False)
+    ctl2.send_key("k")
+    assert server.wait(60)
+    ctl.close()
+    ctl2.close()
+
+
+def test_pause_resume_over_the_wire(golden_root, tmp_path):
+    server = make_server(golden_root, tmp_path, turns=10**9).start()
+    ctl = Controller(*server.address, want_flips=False)
+    states = []
+    done = threading.Event()
+
+    def watch():
+        for ev in ctl.events:
+            if isinstance(ev, StateChange):
+                states.append(ev.new_state)
+                if len(states) == 2:
+                    ctl.send_key("k")
+            if isinstance(ev, FinalTurnComplete):
+                pass
+        done.set()
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    ctl.send_key("p")
+    time.sleep(0.3)
+    ctl.send_key("p")
+    assert done.wait(60)
+    assert states[:2] == [State.PAUSED, State.EXECUTING]
+    assert server.wait(30)
+    ctl.close()
+
+
+def test_controller_crash_is_survived(golden_root, tmp_path):
+    """A controller that vanishes without 'q' must not take the engine
+    down (the disappearing-component story, ref: README.md:232-233)."""
+    server = make_server(golden_root, tmp_path, turns=200, chunk=1).start()
+    ctl = Controller(*server.address, want_flips=True)
+    for ev in ctl.events:
+        if isinstance(ev, TurnComplete) and ev.completed_turns >= 2:
+            break
+    ctl._sock.close()  # simulated crash: no 'q', no goodbye
+    assert not server.done.is_set()
+    # Engine finishes the run and the flips tax is dropped after detach.
+    assert server.wait(120)
+    assert server.engine.completed_turns == 200
+    assert server.engine.error is None
