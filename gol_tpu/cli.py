@@ -235,6 +235,9 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
                 s = str(ev)
                 if s:
                     print(f"Completed Turns {ev.completed_turns:<8}{s}")
+            if ctl.board is None and not ctl.detached.is_set():
+                print("engine run ended before the attach completed",
+                      file=sys.stderr)
         else:
             from gol_tpu.visual import run_loop
 

@@ -50,10 +50,19 @@ class Controller:
         self.synced = threading.Event()
         self.detached = threading.Event()
         self._send_lock = threading.Lock()
+        # The timeout covers the whole handshake (connect + hello + first
+        # reply), not just the TCP connect — a wedged server must not
+        # hang the constructor. Streaming afterwards is untimed.
         self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.settimeout(None)
         wire.send_msg(self._sock, {"t": "hello", "want_flips": want_flips})
-        first = wire.recv_msg(self._sock)
+        try:
+            first = wire.recv_msg(self._sock)
+        except TimeoutError:
+            self.close()
+            raise ConnectionError(
+                f"no reply from {host}:{port} within {timeout}s"
+            ) from None
+        self._sock.settimeout(None)
         if first is not None and first.get("t") == "error":
             self.close()
             raise ServerBusyError(first.get("reason", "rejected"))

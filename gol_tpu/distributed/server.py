@@ -232,15 +232,22 @@ class EngineServer:
                 continue
             if conn is None:
                 flips.clear()
+                if isinstance(ev, BoardSync):
+                    # Sync requested by a controller that vanished with
+                    # nobody now attached: drop the stale enable_flips so
+                    # a detached engine pays zero diff tax.
+                    self.engine.emit_flips = False
                 continue
             try:
                 if isinstance(ev, BoardSync):
                     if ev.token != conn.token:
                         # Sync for a controller that vanished before it
-                        # was serviced; re-assert the current conn's
-                        # subscription (a stale enable_flips may have
-                        # turned diffs on for nobody).
-                        self.engine.emit_flips = conn.want_flips and conn.synced
+                        # was serviced. Re-assert the *current* conn's
+                        # subscription — by want_flips alone: its own
+                        # sync may still be queued behind this one, and
+                        # keying off conn.synced here would freeze its
+                        # diffs forever.
+                        self.engine.emit_flips = conn.want_flips
                         continue
                     flips.clear()  # the sync supersedes any batched diff
                     conn.send(wire.board_to_msg(ev.completed_turns, ev.world,

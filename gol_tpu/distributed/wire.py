@@ -105,8 +105,13 @@ def event_to_msg(ev: Event) -> dict:
     if isinstance(ev, TurnComplete):
         return {"t": "ev", "k": "turn", "turn": ev.completed_turns}
     if isinstance(ev, FinalTurnComplete):
+        # The alive set can be millions of cells (a 5120^2 board at 25%
+        # density is ~6.5M) — plain JSON pairs would blow MAX_FRAME, so
+        # the coordinates ride as zlib(int32 x,y pairs) like board rasters.
+        coords = np.asarray([[c.x, c.y] for c in ev.alive], np.int32).reshape(-1, 2)
+        packed = base64.b64encode(zlib.compress(coords.tobytes(), 1))
         return {"t": "ev", "k": "final", "turn": ev.completed_turns,
-                "alive": [[c.x, c.y] for c in ev.alive]}
+                "alive_z": packed.decode("ascii")}
     if isinstance(ev, CellFlipped):  # normally batched into "flips"
         return {"t": "flips", "turn": ev.completed_turns,
                 "cells": [[ev.cell.x, ev.cell.y]]}
@@ -132,7 +137,10 @@ def msg_to_events(msg: dict) -> list[Event]:
     if k == "turn":
         return [TurnComplete(turn)]
     if k == "final":
-        return [FinalTurnComplete(turn, [Cell(x, y) for x, y in msg["alive"]])]
+        coords = np.frombuffer(
+            zlib.decompress(base64.b64decode(msg["alive_z"])), np.int32
+        ).reshape(-1, 2)
+        return [FinalTurnComplete(turn, [Cell(int(x), int(y)) for x, y in coords])]
     raise TypeError(f"unknown event kind {k!r}")
 
 

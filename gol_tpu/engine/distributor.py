@@ -303,6 +303,11 @@ class Engine:
 
         self._ticker_stop.set()
         self._last_pair = (turn, int(self._committed[2]))
+        # Serve any sync request that arrived during the last dispatch
+        # BEFORE the tail events are queued, so a just-attached
+        # subscriber gets its BoardSync and then the final events instead
+        # of a silently empty stream.
+        self._service_requests()
 
         if self._stop_reason == "stop":
             # Programmatic stop (Engine.stop / atexit): no snapshot, just

@@ -1,0 +1,109 @@
+"""Pallas TPU kernel for the Game of Life step — the hot-op fast path.
+
+The XLA path (`ops/life.py`) runs one fused elementwise program per turn
+inside `lax.fori_loop`; each turn still reads and writes the board in
+HBM. This kernel keeps the whole board resident in VMEM and runs the
+entire K-turn chunk inside ONE kernel invocation — per turn: four
+`pltpu.roll`s (toroidal separable 3-sum) plus the B/S combine, all on
+the VPU, zero HBM traffic between turns. The board makes exactly one
+HBM→VMEM→HBM round trip per chunk.
+
+Correctness is identical by construction (same integer stencil, same
+rule combine as `ops/life.apply_rule`); tests run the kernel in
+interpreter mode on CPU against the XLA path and the golden boards.
+
+Eligibility (`fits_pallas`): board + working set within a VMEM budget
+and TPU-friendly shape (sublane multiple of 8, lane multiple of 128).
+Callers fall back to the XLA path otherwise; oversized boards get the
+XLA path's sharded/tiled treatment instead (parallel/halo.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gol_tpu.models.rules import LIFE, Rule
+from gol_tpu.ops.life import apply_rule, from_bits, to_bits
+
+#: VMEM working-set budget: board (int32 in-kernel) x ~5 live temporaries,
+#: kept well under the ~16 MB/core ceiling.
+VMEM_BUDGET_BYTES = 12 << 20
+
+
+def fits_pallas(height: int, width: int) -> bool:
+    """Whole-board-in-VMEM eligibility (shape tiling + memory budget)."""
+    if height % 8 != 0 or width % 128 != 0:
+        return False
+    return height * width * 4 * 5 <= VMEM_BUDGET_BYTES
+
+
+def _roll(x, shift: int, axis: int):
+    # pltpu.roll rejects negative shifts; a circular shift by -1 is a
+    # shift by dim-1.
+    return pltpu.roll(x, shift % x.shape[axis], axis)
+
+
+def _make_kernel(n_turns: int, rule: Rule):
+    # The rule combine as pure int32 arithmetic — mosaic rejects the
+    # narrow-int truncations `apply_rule`'s bool/uint8 dance produces, so
+    # membership in the static birth/survive sets becomes a sum of
+    # (counts == k) indicators and the select becomes a multiply:
+    #   next = alive * survive(counts) + (1 - alive) * birth(counts)
+    def indicator(counts, ns):
+        if not ns:
+            return jnp.zeros_like(counts)
+        return sum((counts == k).astype(jnp.int32) for k in sorted(ns))
+
+    def kernel(in_ref, out_ref):
+        def turn(_, bits):
+            v = bits + _roll(bits, 1, 0) + _roll(bits, -1, 0)
+            counts = v + _roll(v, 1, 1) + _roll(v, -1, 1) - bits
+            surv = indicator(counts, rule.survive)
+            born = indicator(counts, rule.birth)
+            return bits * surv + (1 - bits) * born
+
+        out_ref[:] = lax.fori_loop(0, n_turns, turn, in_ref[:])
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("n", "rule", "interpret"))
+def step_n_pallas(
+    world: jax.Array,
+    n: int,
+    rule: Rule = LIFE,
+    interpret: bool = False,
+) -> jax.Array:
+    """`n` turns on a {0,255} uint8 world, whole chunk in one kernel.
+
+    Mirrors `ops.life.step_n` (serial sweep analog,
+    ref: gol/distributor.go:350-379 — done as a resident-VMEM kernel)."""
+    h, w = world.shape
+    bits = to_bits(world).astype(jnp.int32)
+    out = pl.pallas_call(
+        _make_kernel(n, rule),
+        out_shape=jax.ShapeDtypeStruct((h, w), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(bits)
+    return from_bits(out)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "rule", "interpret"))
+def step_n_counted_pallas(
+    world: jax.Array,
+    n: int,
+    rule: Rule = LIFE,
+    interpret: bool = False,
+):
+    """`n` turns + alive count — drop-in for `ops.life.step_n_counted`;
+    XLA fuses the count reduction onto the kernel's output."""
+    new = step_n_pallas(world, n, rule, interpret)
+    return new, jnp.sum(new != 0, dtype=jnp.int32)

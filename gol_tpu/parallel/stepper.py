@@ -13,6 +13,7 @@ request to what the hardware and the grid height allow.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Callable, Optional
 
 import jax
@@ -67,6 +68,60 @@ def _single_device(rule: Rule, device=None) -> Stepper:
     )
 
 
+def _single_device_packed(rule: Rule, height: int, device=None) -> Stepper:
+    """Bit-packed single-device backend (ops/bitlife.py): the device
+    state is the packed uint32 board and stays packed across dispatches —
+    pack on `put`, unpack only on `fetch`/diffs. ~16x the dense path on
+    TPU (VPU-bound SWAR instead of one lane per cell)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gol_tpu.ops import bitlife
+
+    dev = device or jax.devices()[0]
+
+    @jax.jit
+    def _pack(world):
+        return bitlife.pack(bitlife.to_bits(world))
+
+    @jax.jit
+    def _unpack(p):
+        return bitlife.from_bits(bitlife.unpack(p, height))
+
+    @jax.jit
+    def _count(p):
+        return jnp.sum(lax.population_count(p).astype(jnp.int32), dtype=jnp.int32)
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def _step_n(p, n):
+        p = lax.fori_loop(0, n, lambda _, q: bitlife.step_packed(q, rule), p)
+        return p, _count(p)
+
+    @jax.jit
+    def _step_with_diff(p):
+        new = bitlife.step_packed(p, rule)
+        # Diff mask unpacked to dense (H, W) bool for cells_from_mask.
+        mask = bitlife.unpack(p ^ new, height) != 0
+        return new, mask, _count(new)
+
+    def _fetch(arr):
+        # Worlds are packed uint32; diff masks are already dense bool.
+        if arr.dtype == jnp.uint32:
+            return np.asarray(_unpack(arr))
+        return np.asarray(arr)
+
+    return Stepper(
+        name="single-packed",
+        shards=1,
+        put=lambda w: _pack(jax.device_put(np.asarray(w, np.uint8), dev)),
+        fetch=_fetch,
+        step=lambda p: bitlife.step_packed(p, rule),
+        step_n=lambda p, n: _step_n(p, int(n)),
+        step_with_diff=_step_with_diff,
+        alive_count_async=_count,
+    )
+
+
 def shard_count(requested: int, height: int, n_devices: int) -> int:
     """Largest feasible shard count ≤ requested: must not exceed device
     count and must divide the grid height evenly (halo exchange needs
@@ -92,6 +147,10 @@ def make_stepper(
     devs = devices if devices is not None else jax.devices()
     k = shard_count(threads, height, len(devs))
     if k <= 1:
+        from gol_tpu.ops.bitlife import packable
+
+        if packable(height, width):
+            return _single_device_packed(rule, height, devs[0])
         return _single_device(rule, devs[0])
     from gol_tpu.parallel.halo import sharded_stepper
 

@@ -1,0 +1,119 @@
+"""Fast-path kernels vs the dense reference path — bit-packed SWAR and
+the pallas VMEM-resident kernel must be cell-for-cell identical to
+`ops/life.py` (which is itself pinned to the golden boards)."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.models.rules import LIFE, get_rule
+from gol_tpu.ops import bitlife, life
+from gol_tpu.ops.pallas_life import fits_pallas, step_n_pallas
+from gol_tpu.parallel.stepper import make_stepper
+
+
+def random_world(h, w, seed=0):
+    return life.random_world(h, w, density=0.3, seed=seed)
+
+
+# --- bit-packed path ---
+
+
+def test_pack_unpack_roundtrip():
+    bits = (random_world(96, 64, 3) != 0).astype(np.uint8)
+    got = np.asarray(bitlife.unpack(bitlife.pack(bits), 96))
+    np.testing.assert_array_equal(got, bits)
+
+
+def test_packable_gate():
+    assert bitlife.packable(512, 512)
+    assert bitlife.packable(64, 17)  # width is unconstrained
+    assert not bitlife.packable(16, 512)  # under one word
+    assert not bitlife.packable(48, 512)  # partial word
+
+
+@pytest.mark.parametrize("size", [(32, 48), (64, 64), (96, 128)])
+@pytest.mark.parametrize("turns", [1, 7, 64])
+def test_packed_matches_dense(size, turns):
+    h, w = size
+    world = random_world(h, w, seed=h + turns)
+    got = np.asarray(bitlife.step_n_packed(world, turns))
+    want = np.asarray(life.step_n(world, turns))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_packed_counted_matches(golden_root):
+    from gol_tpu.io.pgm import read_pgm
+
+    world = read_pgm(golden_root / "images" / "64x64.pgm")
+    got, count = bitlife.step_n_counted_packed(world, 100)
+    golden = read_pgm(golden_root / "check" / "images" / "64x64x100.pgm")
+    np.testing.assert_array_equal(np.asarray(got), golden)
+    assert int(count) == int(np.count_nonzero(golden))
+
+
+def test_packed_generic_rule():
+    hl = get_rule("B36/S23")
+    world = random_world(64, 64, seed=9)
+    got = np.asarray(bitlife.step_n_packed(world, 30, rule=hl))
+    want = np.asarray(life.step_n(world, 30, rule=hl))
+    np.testing.assert_array_equal(got, want)
+    # And differs from plain Life on the same seed (B6 births happen).
+    assert (got != np.asarray(bitlife.step_n_packed(world, 30))).any()
+
+
+def test_packed_stepper_selected_and_correct(golden_root):
+    from gol_tpu.io.pgm import read_pgm
+
+    stepper = make_stepper(threads=1, height=64, width=64, rule=LIFE)
+    assert stepper.name == "single-packed"
+    world = read_pgm(golden_root / "images" / "64x64.pgm")
+    p = stepper.put(world)
+    p, count = stepper.step_n(p, 100)
+    golden = read_pgm(golden_root / "check" / "images" / "64x64x100.pgm")
+    np.testing.assert_array_equal(stepper.fetch(p), golden)
+    assert int(count) == int(np.count_nonzero(golden))
+    assert int(stepper.alive_count_async(p)) == int(count)
+
+
+def test_packed_stepper_diff_path():
+    stepper = make_stepper(threads=1, height=32, width=32, rule=LIFE)
+    world = random_world(32, 32, seed=4)
+    p = stepper.put(world)
+    new, mask, count = stepper.step_with_diff(p)
+    dense_new = np.asarray(life.step(world))
+    np.testing.assert_array_equal(stepper.fetch(new), dense_new)
+    np.testing.assert_array_equal(
+        np.asarray(mask), (np.asarray(world) != 0) != (dense_new != 0)
+    )
+    assert int(count) == int(np.count_nonzero(dense_new))
+
+
+def test_small_board_falls_back_to_dense():
+    assert make_stepper(threads=1, height=16, width=16).name == "single"
+
+
+# --- pallas kernel (interpret mode on CPU; compiled path exercised on TPU
+# by bench/production use) ---
+
+
+def test_fits_pallas_gate():
+    assert fits_pallas(512, 512)
+    assert not fits_pallas(500, 512)  # sublane misalignment
+    assert not fits_pallas(512, 500)  # lane misalignment
+    assert not fits_pallas(4096, 4096)  # VMEM budget
+
+
+@pytest.mark.parametrize("turns", [1, 33])
+def test_pallas_matches_dense_interpret(turns):
+    world = random_world(64, 128, seed=turns)
+    got = np.asarray(step_n_pallas(world, turns, interpret=True))
+    want = np.asarray(life.step_n(world, turns))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_generic_rule_interpret():
+    hl = get_rule("B36/S23")
+    world = random_world(64, 128, seed=77)
+    got = np.asarray(step_n_pallas(world, 20, rule=hl, interpret=True))
+    want = np.asarray(life.step_n(world, 20, rule=hl))
+    np.testing.assert_array_equal(got, want)
