@@ -53,6 +53,10 @@ def build_parser() -> argparse.ArgumentParser:
     # TPU-native extensions.
     ap.add_argument("--rule", default="B3/S23",
                     help="cellular-automaton rule in B/S notation")
+    ap.add_argument("--backend", default="auto",
+                    choices=("auto", "packed", "dense", "pallas"),
+                    help="single-device kernel family (default auto: "
+                         "bit-packed SWAR when the grid allows)")
     ap.add_argument("--chunk", type=int, default=None, metavar="K",
                     help="turns fused per device dispatch when no per-turn "
                          "consumer is attached (default: 1 visualising, "
@@ -114,6 +118,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         image_width=args.w,
         image_height=args.h,
         rule=args.rule,
+        backend=args.backend,
         chunk=chunk,
         tick_seconds=args.tick,
         image_dir=args.images,

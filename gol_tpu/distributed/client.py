@@ -52,15 +52,16 @@ class Controller:
         self._send_lock = threading.Lock()
         # The timeout covers the whole handshake (connect + hello + first
         # reply), not just the TCP connect — a wedged server must not
-        # hang the constructor. Streaming afterwards is untimed.
+        # hang the constructor. Streaming afterwards is untimed. Any
+        # handshake failure closes the socket and the event stream.
         self._sock = socket.create_connection((host, port), timeout=timeout)
-        wire.send_msg(self._sock, {"t": "hello", "want_flips": want_flips})
         try:
+            wire.send_msg(self._sock, {"t": "hello", "want_flips": want_flips})
             first = wire.recv_msg(self._sock)
-        except TimeoutError:
+        except (TimeoutError, wire.WireError, OSError) as e:
             self.close()
             raise ConnectionError(
-                f"no reply from {host}:{port} within {timeout}s"
+                f"handshake with {host}:{port} failed: {e}"
             ) from None
         self._sock.settimeout(None)
         if first is not None and first.get("t") == "error":

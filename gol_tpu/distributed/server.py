@@ -189,6 +189,15 @@ class EngineServer:
                 self.engine.emit_flips = False
         conn.close()
 
+    def _refresh_flips(self) -> None:
+        """Re-derive engine.emit_flips from the currently attached
+        connection, atomically against attach/detach — the single writer
+        discipline that keeps broadcaster-side corrections from racing a
+        concurrent _detach or a fresh attach."""
+        with self._conn_lock:
+            cur = self._conn
+            self.engine.emit_flips = cur is not None and cur.want_flips
+
     # --- controller → engine ---
 
     def _reader_loop(self, conn: _Conn) -> None:
@@ -233,21 +242,21 @@ class EngineServer:
             if conn is None:
                 flips.clear()
                 if isinstance(ev, BoardSync):
-                    # Sync requested by a controller that vanished with
-                    # nobody now attached: drop the stale enable_flips so
-                    # a detached engine pays zero diff tax.
-                    self.engine.emit_flips = False
+                    # Sync requested by a controller that vanished: drop
+                    # the stale enable_flips so a detached engine pays
+                    # zero diff tax (re-derived under the lock — a new
+                    # controller may have just attached).
+                    self._refresh_flips()
                 continue
             try:
                 if isinstance(ev, BoardSync):
                     if ev.token != conn.token:
                         # Sync for a controller that vanished before it
-                        # was serviced. Re-assert the *current* conn's
-                        # subscription — by want_flips alone: its own
-                        # sync may still be queued behind this one, and
-                        # keying off conn.synced here would freeze its
-                        # diffs forever.
-                        self.engine.emit_flips = conn.want_flips
+                        # was serviced; re-derive the subscription from
+                        # the *current* connection (by want_flips alone —
+                        # its own sync may still be queued behind this
+                        # one, so keying off synced would freeze it).
+                        self._refresh_flips()
                         continue
                     flips.clear()  # the sync supersedes any batched diff
                     conn.send(wire.board_to_msg(ev.completed_turns, ev.world,

@@ -108,7 +108,10 @@ def event_to_msg(ev: Event) -> dict:
         # The alive set can be millions of cells (a 5120^2 board at 25%
         # density is ~6.5M) — plain JSON pairs would blow MAX_FRAME, so
         # the coordinates ride as zlib(int32 x,y pairs) like board rasters.
-        coords = np.asarray([[c.x, c.y] for c in ev.alive], np.int32).reshape(-1, 2)
+        # Cell is a NamedTuple, so asarray builds the (N, 2) x,y array
+        # directly — no per-cell intermediate lists on multi-million-cell
+        # finals.
+        coords = np.asarray(ev.alive, np.int32).reshape(-1, 2)
         packed = base64.b64encode(zlib.compress(coords.tobytes(), 1))
         return {"t": "ev", "k": "final", "turn": ev.completed_turns,
                 "alive_z": packed.decode("ascii")}

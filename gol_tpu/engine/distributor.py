@@ -34,6 +34,7 @@ from __future__ import annotations
 import atexit
 import queue
 import threading
+import time
 import weakref
 from typing import Iterator, Optional
 
@@ -132,6 +133,7 @@ class Engine:
         start_turn: int = 0,
         io_service: Optional[IOService] = None,
         stepper=None,
+        timeline=None,
     ):
         self.p = params
         self.events = events if events is not None else EventQueue()
@@ -151,6 +153,7 @@ class Engine:
             height=params.image_height,
             width=params.image_width,
             rule=params.rule,
+            backend=params.backend,
         )
         # Atomically published (completed_turns, device_world, device_count);
         # the mutex-free replacement for ref: gol/distributor.go:34-36.
@@ -172,6 +175,12 @@ class Engine:
         # always-consistent fallback for timed-out requests.
         self._last_pair = (0, 0)
         self._finished = threading.Event()
+        #: Optional utils.trace.Timeline recording one span per dispatch.
+        #: Profiling realizes each chunk's count so spans measure true
+        #: device time, at the cost of serializing the dispatch pipeline
+        #: (the usual observer tax; ref analog: wrapping the whole run in
+        #: runtime/trace, trace_test.go:19-27).
+        self.timeline = timeline
         #: Exception that killed the engine thread, if any.
         self.error: Optional[BaseException] = None
 
@@ -285,16 +294,27 @@ class Engine:
             if self._stop_reason is not None:
                 break
             if self.emit_flips:
+                tick = time.perf_counter() if self.timeline else 0.0
                 new_world, mask, count = self.stepper.step_with_diff(world)
                 turn += 1
                 for cell in cells_from_mask(self.stepper.fetch(mask)):
                     self.events.put(CellFlipped(turn, cell))
+                if self.timeline:
+                    self.timeline.record(
+                        turn, 1, time.perf_counter() - tick, "diff"
+                    )
                 world = new_world
                 self._commit(turn, world, count)
                 self.events.put(TurnComplete(turn))
             else:
                 k = min(p.chunk, p.turns - turn)
+                tick = time.perf_counter() if self.timeline else 0.0
                 world, count = self.stepper.step_n(world, k)
+                if self.timeline:
+                    int(count)  # realize: spans measure true device time
+                    self.timeline.record(
+                        turn + k, k, time.perf_counter() - tick, "chunk"
+                    )
                 first = turn + 1
                 turn += k
                 self._commit(turn, world, count)

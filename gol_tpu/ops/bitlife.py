@@ -117,13 +117,23 @@ def step_packed(p: jax.Array, rule: Rule = LIFE) -> jax.Array:
     return (p & survive) | (~p & birth)
 
 
+def step_n_packed_raw(p: jax.Array, n: int, rule: Rule = LIFE) -> jax.Array:
+    """`n` turns, packed in / packed out — the loop the packed stepper
+    and the world-level wrappers share."""
+    return lax.fori_loop(0, n, lambda _, q: step_packed(q, rule), p)
+
+
+def count_packed(p: jax.Array) -> jax.Array:
+    """Alive count of a packed board (popcount reduction)."""
+    return jnp.sum(lax.population_count(p).astype(jnp.int32), dtype=jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("n", "rule"))
 def step_n_packed(world: jax.Array, n: int, rule: Rule = LIFE) -> jax.Array:
     """`n` turns on a {0,255} uint8 world via the packed representation —
     drop-in for `ops.life.step_n` when `packable(H, W)`."""
     h = world.shape[0]
-    p = pack(to_bits(world))
-    p = lax.fori_loop(0, n, lambda _, q: step_packed(q, rule), p)
+    p = step_n_packed_raw(pack(to_bits(world)), n, rule)
     return from_bits(unpack(p, h))
 
 
@@ -131,9 +141,5 @@ def step_n_packed(world: jax.Array, n: int, rule: Rule = LIFE) -> jax.Array:
 def step_n_counted_packed(world: jax.Array, n: int, rule: Rule = LIFE):
     """`n` turns + alive count (popcount over the packed words)."""
     h = world.shape[0]
-    p = pack(to_bits(world))
-    p = lax.fori_loop(0, n, lambda _, q: step_packed(q, rule), p)
-    count = jnp.sum(
-        lax.population_count(p).astype(jnp.int32), dtype=jnp.int32
-    )
-    return from_bits(unpack(p, h)), count
+    p = step_n_packed_raw(pack(to_bits(world)), n, rule)
+    return from_bits(unpack(p, h)), count_packed(p)

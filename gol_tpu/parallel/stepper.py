@@ -74,7 +74,6 @@ def _single_device_packed(rule: Rule, height: int, device=None) -> Stepper:
     pack on `put`, unpack only on `fetch`/diffs. ~16x the dense path on
     TPU (VPU-bound SWAR instead of one lane per cell)."""
     import jax.numpy as jnp
-    from jax import lax
 
     from gol_tpu.ops import bitlife
 
@@ -90,12 +89,12 @@ def _single_device_packed(rule: Rule, height: int, device=None) -> Stepper:
 
     @jax.jit
     def _count(p):
-        return jnp.sum(lax.population_count(p).astype(jnp.int32), dtype=jnp.int32)
+        return bitlife.count_packed(p)
 
     @functools.partial(jax.jit, static_argnames=("n",))
     def _step_n(p, n):
-        p = lax.fori_loop(0, n, lambda _, q: bitlife.step_packed(q, rule), p)
-        return p, _count(p)
+        p = bitlife.step_n_packed_raw(p, n, rule)
+        return p, bitlife.count_packed(p)
 
     @jax.jit
     def _step_with_diff(p):
@@ -134,24 +133,81 @@ def shard_count(requested: int, height: int, n_devices: int) -> int:
     return 1
 
 
+def _single_device_pallas(rule: Rule, device=None) -> Stepper:
+    """Whole-board-in-VMEM pallas kernel backend (ops/pallas_life.py).
+    Measured equal to XLA's own VMEM-resident loop on TPU and well below
+    the packed path — selectable for comparison and as the pallas
+    reference implementation, not picked by "auto"."""
+    from gol_tpu.ops import pallas_life
+
+    dev = device or jax.devices()[0]
+    interpret = dev.platform == "cpu"  # no mosaic off-TPU
+
+    def _step_n(w, n):
+        new, count = pallas_life.step_n_counted_pallas(
+            w, n, rule=rule, interpret=interpret
+        )
+        return new, count
+
+    @jax.jit
+    def _diff(w, new):
+        return w != new
+
+    def _step_with_diff(w):
+        new, count = _step_n(w, 1)
+        return new, _diff(w, new), count
+
+    return Stepper(
+        name="single-pallas",
+        shards=1,
+        put=lambda w: jax.device_put(np.asarray(w, np.uint8), dev),
+        fetch=lambda w: np.asarray(w),
+        step=lambda w: pallas_life.step_n_pallas(w, 1, rule=rule,
+                                                 interpret=interpret),
+        step_n=lambda w, n: _step_n(w, int(n)),
+        step_with_diff=_step_with_diff,
+        alive_count_async=life.alive_count,
+    )
+
+
+BACKENDS = ("auto", "packed", "dense", "pallas")
+
+
 def make_stepper(
     threads: int = 1,
     height: int = 512,
     width: int = 512,
     rule: Rule | str = LIFE,
     devices: Optional[list] = None,
+    backend: str = "auto",
 ) -> Stepper:
     """Build the best stepper for the request (the dispatch analog of
-    ref: gol/distributor.go:93,116 picking serial vs row-farm)."""
+    ref: gol/distributor.go:93,116 picking serial vs row-farm).
+
+    `backend` picks the single-device kernel family: "auto" (packed when
+    the grid allows, else dense), or an explicit "packed" / "dense" /
+    "pallas". Sharded runs (threads > 1 with multiple devices) always
+    use the dense ring-halo path."""
+    if backend not in BACKENDS:
+        raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
     rule = get_rule(rule) if isinstance(rule, str) else rule
     devs = devices if devices is not None else jax.devices()
     k = shard_count(threads, height, len(devs))
-    if k <= 1:
-        from gol_tpu.ops.bitlife import packable
+    if k > 1:
+        from gol_tpu.parallel.halo import sharded_stepper
 
-        if packable(height, width):
-            return _single_device_packed(rule, height, devs[0])
-        return _single_device(rule, devs[0])
-    from gol_tpu.parallel.halo import sharded_stepper
+        return sharded_stepper(rule, devs[:k], height)
 
-    return sharded_stepper(rule, devs[:k], height)
+    from gol_tpu.ops.bitlife import packable
+    from gol_tpu.ops.pallas_life import fits_pallas
+
+    if backend == "packed" or (backend == "auto" and packable(height, width)):
+        if not packable(height, width):
+            raise ValueError(f"grid {height}x{width} is not packable")
+        return _single_device_packed(rule, height, devs[0])
+    if backend == "pallas":
+        if not fits_pallas(height, width):
+            raise ValueError(f"grid {height}x{width} does not fit the "
+                             "pallas VMEM kernel")
+        return _single_device_pallas(rule, devs[0])
+    return _single_device(rule, devs[0])

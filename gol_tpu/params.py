@@ -36,6 +36,9 @@ class Params:
     # Alive-count telemetry cadence in seconds (ref ticker: 2s,
     # gol/distributor.go:285).
     tick_seconds: float = 2.0
+    # Single-device kernel family: auto | packed | dense | pallas
+    # (parallel/stepper.py BACKENDS).
+    backend: str = "auto"
     # Directory containing <W>x<H>.pgm inputs (ref: gol/io.go:39) and the
     # output directory (ref: gol/io.go:43).
     image_dir: str = "images"
@@ -52,6 +55,8 @@ class Params:
             raise ValueError("chunk must be >= 1")
         if self.tick_seconds <= 0:
             raise ValueError("tick_seconds must be > 0")
+        if self.backend not in ("auto", "packed", "dense", "pallas"):
+            raise ValueError(f"unknown backend {self.backend!r}")
 
     @property
     def input_name(self) -> str:

@@ -1,0 +1,106 @@
+"""Tracing & profiling — the analog of the reference's runtime/trace
+harness (ref: trace_test.go:12-29, artifact trace.out, inspected with
+`go tool trace` per README.md:89).
+
+Two complementary layers:
+
+- `device_trace(dir)`: wraps `jax.profiler.trace` — captures XLA/TPU
+  device activity into a Perfetto/TensorBoard trace directory, the
+  direct stand-in for trace.out (view with Perfetto instead of
+  `go tool trace`).
+- `Timeline`: a lock-free host-side span recorder the engine feeds one
+  record per device dispatch (chunk of turns). Where the Go trace shows
+  goroutine spawn/steal patterns of the per-turn worker farm
+  (ref: gol/distributor.go:116-173), this shows the engine's dispatch
+  cadence: turns per chunk, dispatch wall time, turns/sec — queryable
+  in-process and dumpable to JSON for offline analysis.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: str) -> Iterator[None]:
+    """Capture a device profile for the enclosed block (trace.out analog)."""
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """One engine dispatch: `turns` turns committed ending at `turn`."""
+
+    turn: int
+    turns: int
+    seconds: float
+    kind: str  # "chunk" (fused fori_loop) or "diff" (per-turn with flips)
+
+    @property
+    def turns_per_sec(self) -> float:
+        return self.turns / self.seconds if self.seconds > 0 else float("inf")
+
+
+class Timeline:
+    """Per-dispatch span log. Appends are single-writer (engine thread);
+    reads take a snapshot copy, so no lock is needed (the reference's
+    ticker read its turn counter unlocked and raced, SURVEY.md §2; here
+    the list append is atomic under the GIL and readers never mutate)."""
+
+    def __init__(self, capacity: int = 100_000):
+        self.capacity = capacity
+        self._spans: list[Span] = []
+        self._t0 = time.perf_counter()
+
+    # -- engine side --
+
+    def record(self, turn: int, turns: int, seconds: float, kind: str) -> None:
+        if len(self._spans) < self.capacity:
+            self._spans.append(Span(turn, turns, seconds, kind))
+
+    # -- reader side --
+
+    @property
+    def spans(self) -> list[Span]:
+        return list(self._spans)
+
+    def summary(self) -> dict:
+        spans = self.spans
+        total_turns = sum(s.turns for s in spans)
+        total_s = sum(s.seconds for s in spans)
+        return {
+            "dispatches": len(spans),
+            "turns": total_turns,
+            "busy_seconds": round(total_s, 6),
+            "wall_seconds": round(time.perf_counter() - self._t0, 6),
+            "turns_per_sec": round(total_turns / total_s, 1) if total_s else None,
+        }
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(
+                {"summary": self.summary(),
+                 "spans": [dataclasses.asdict(s) for s in self.spans]},
+                f,
+            )
+
+
+def profile_run(params, trace_dir: Optional[str] = None, **engine_kwargs):
+    """Run one engine to completion under a Timeline (and optionally a
+    device trace), returning (engine, timeline) — the TestTrace analog
+    as a library call (ref: trace_test.go:12-29)."""
+    from gol_tpu.engine.distributor import Engine
+
+    timeline = Timeline()
+    engine = Engine(params, timeline=timeline, **engine_kwargs)
+    ctx = device_trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    with ctx:
+        engine.run()
+    return engine, timeline

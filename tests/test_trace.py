@@ -1,0 +1,65 @@
+"""Tracing tests — the TestTrace analog (ref: trace_test.go:12-29): wrap
+a small run in the profiler and assert the artifacts exist and the
+dispatch timeline is coherent."""
+
+import json
+
+import pytest
+
+from gol_tpu.params import Params
+from gol_tpu.utils.trace import Timeline, profile_run
+
+
+def make_params(golden_root, tmp_path, **kw):
+    defaults = dict(
+        turns=10, threads=4, image_width=64, image_height=64,
+        image_dir=str(golden_root / "images"), out_dir=str(tmp_path / "out"),
+        tick_seconds=60.0,
+    )
+    defaults.update(kw)
+    return Params(**defaults)
+
+
+def test_timeline_records_per_turn_diff_spans(golden_root, tmp_path):
+    """The reference traces a 64x64, 10-turn, 4-worker run
+    (ref: trace_test.go:13-18); same shape here, diff path."""
+    p = make_params(golden_root, tmp_path)
+    engine, tl = profile_run(p, emit_flips=True)
+    assert engine.error is None
+    spans = tl.spans
+    assert [s.turn for s in spans] == list(range(1, 11))
+    assert all(s.kind == "diff" and s.turns == 1 and s.seconds > 0 for s in spans)
+    s = tl.summary()
+    assert s["dispatches"] == 10 and s["turns"] == 10
+    assert 0 < s["busy_seconds"] <= s["wall_seconds"]
+
+
+def test_timeline_records_chunk_spans_and_dump(golden_root, tmp_path):
+    p = make_params(golden_root, tmp_path, turns=20, threads=1, chunk=8)
+    engine, tl = profile_run(p, emit_flips=False)
+    assert engine.error is None
+    assert [(s.turn, s.turns) for s in tl.spans] == [(8, 8), (16, 8), (20, 4)]
+    assert all(s.kind == "chunk" for s in tl.spans)
+    out = tmp_path / "timeline.json"
+    tl.dump(str(out))
+    loaded = json.loads(out.read_text())
+    assert loaded["summary"]["turns"] == 20
+    assert len(loaded["spans"]) == 3
+
+
+def test_device_trace_writes_artifact(golden_root, tmp_path):
+    """jax.profiler trace artifacts land in the given dir — the
+    trace.out analog, viewable in Perfetto/TensorBoard."""
+    trace_dir = tmp_path / "trace"
+    p = make_params(golden_root, tmp_path, turns=5, threads=1, chunk=5)
+    engine, tl = profile_run(p, trace_dir=str(trace_dir), emit_flips=False)
+    assert engine.error is None
+    produced = list(trace_dir.rglob("*"))
+    assert any(f.is_file() for f in produced), "no trace artifacts written"
+
+
+def test_timeline_capacity_cap():
+    tl = Timeline(capacity=3)
+    for i in range(5):
+        tl.record(i + 1, 1, 0.001, "chunk")
+    assert len(tl.spans) == 3  # bounded memory on infinite runs
