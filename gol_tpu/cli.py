@@ -27,7 +27,7 @@ from typing import Optional
 
 from gol_tpu.engine.distributor import Engine
 from gol_tpu.events import FinalTurnComplete
-from gol_tpu.params import Params
+from gol_tpu.params import BACKENDS, Params
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -53,10 +53,10 @@ def build_parser() -> argparse.ArgumentParser:
     # TPU-native extensions.
     ap.add_argument("--rule", default="B3/S23",
                     help="cellular-automaton rule in B/S notation")
-    ap.add_argument("--backend", default="auto",
-                    choices=("auto", "packed", "dense", "pallas"),
-                    help="single-device kernel family (default auto: "
-                         "bit-packed SWAR when the grid allows)")
+    ap.add_argument("--backend", default="auto", choices=BACKENDS,
+                    help="kernel family (default auto: bit-packed SWAR "
+                         "when the grid allows, single-device or "
+                         "sharded; pallas is single-device only)")
     ap.add_argument("--chunk", type=int, default=None, metavar="K",
                     help="turns fused per device dispatch when no per-turn "
                          "consumer is attached (default: 1 visualising, "

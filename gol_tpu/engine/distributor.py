@@ -297,12 +297,15 @@ class Engine:
                 tick = time.perf_counter() if self.timeline else 0.0
                 new_world, mask, count = self.stepper.step_with_diff(world)
                 turn += 1
-                for cell in cells_from_mask(self.stepper.fetch(mask)):
-                    self.events.put(CellFlipped(turn, cell))
+                host_mask = self.stepper.fetch(mask)
                 if self.timeline:
+                    # fetch(mask) synced the dispatch: the span measures
+                    # device time, not the host event fan-out below.
                     self.timeline.record(
                         turn, 1, time.perf_counter() - tick, "diff"
                     )
+                for cell in cells_from_mask(host_mask):
+                    self.events.put(CellFlipped(turn, cell))
                 world = new_world
                 self._commit(turn, world, count)
                 self.events.put(TurnComplete(turn))

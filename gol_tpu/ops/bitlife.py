@@ -104,9 +104,12 @@ def _rule_mask(count_bits, ns) -> jax.Array:
     return mask
 
 
-def step_packed(p: jax.Array, rule: Rule = LIFE) -> jax.Array:
-    """One turn on a packed board."""
-    up, down = _shift_up(p), _shift_down(p)
+def combine_packed(p: jax.Array, up: jax.Array, down: jax.Array,
+                   rule: Rule) -> jax.Array:
+    """Horizontal rolls + CSA count + rule combine, given the two
+    vertically-shifted bitboards. The single definition of the packed
+    rule engine — the single-chip path supplies toroidal shifts, the
+    sharded path supplies halo-carried ones (parallel/packed_halo.py)."""
     left = functools.partial(jnp.roll, shift=1, axis=1)
     right = functools.partial(jnp.roll, shift=-1, axis=1)
     neigh = [up, down, left(p), right(p),
@@ -115,6 +118,11 @@ def step_packed(p: jax.Array, rule: Rule = LIFE) -> jax.Array:
     survive = _rule_mask(counts, rule.survive)
     birth = _rule_mask(counts, rule.birth)
     return (p & survive) | (~p & birth)
+
+
+def step_packed(p: jax.Array, rule: Rule = LIFE) -> jax.Array:
+    """One turn on a packed board."""
+    return combine_packed(p, _shift_up(p), _shift_down(p), rule)
 
 
 def step_n_packed_raw(p: jax.Array, n: int, rule: Rule = LIFE) -> jax.Array:

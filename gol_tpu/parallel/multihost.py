@@ -1,0 +1,84 @@
+"""Multi-host initialization — the jax.distributed story.
+
+Topology (SURVEY.md §2 "Distributed / multi-node DP"): the reference
+spec's controller ⇄ broker ⇄ workers over net/rpc maps onto two planes:
+
+- **data plane**: every host process runs the SAME jitted step over a
+  global mesh spanning all hosts' devices; halo `ppermute`s ride ICI
+  within a slice and DCN between slices, inserted by XLA from the same
+  `shard_map` program used single-host (parallel/halo.py,
+  parallel/packed_halo.py — nothing changes in the kernels).
+- **control plane**: the engine server (distributed/server.py) runs on
+  the coordinator process only; controllers attach to it over TCP/DCN
+  exactly as in the single-host split. IO (PGM read/write) and the
+  event stream are coordinator-only; worker processes just execute the
+  SPMD program.
+
+This module owns process bootstrap: `initialize()` wraps
+`jax.distributed.initialize` (env-var driven, harmless single-process),
+`global_ring_mesh()` builds the 1-D row mesh over every device in the
+job, and `is_coordinator()` gates the control plane.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from gol_tpu.parallel.halo import AXIS
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join (or create) a multi-host JAX job.
+
+    Arguments default to the standard env vars (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID); with none set this is a no-op so
+    the same entry point serves laptops and pods. Call before any other
+    jax API touches the backend."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None:
+        if num_processes is not None or process_id is not None:
+            raise ValueError(
+                "num_processes/process_id given without a coordinator "
+                "address — set coordinator_address or "
+                "JAX_COORDINATOR_ADDRESS"
+            )
+        return  # single-process run
+    kwargs: dict = {"coordinator_address": coordinator_address}
+    if num_processes is not None or os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs["num_processes"] = int(
+            num_processes
+            if num_processes is not None
+            else os.environ["JAX_NUM_PROCESSES"]
+        )
+    if process_id is not None or os.environ.get("JAX_PROCESS_ID"):
+        kwargs["process_id"] = int(
+            process_id if process_id is not None else os.environ["JAX_PROCESS_ID"]
+        )
+    jax.distributed.initialize(**kwargs)
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns IO, events, and the engine server."""
+    return jax.process_index() == 0
+
+
+def global_ring_mesh() -> Mesh:
+    """1-D mesh over every device in the job, ordered so ring neighbours
+    are physically adjacent where possible (jax.devices() enumerates
+    devices grouped by process, which keeps intra-host hops on ICI)."""
+    return Mesh(np.asarray(jax.devices()), (AXIS,))
+
+
+def device_count() -> int:
+    return jax.device_count()

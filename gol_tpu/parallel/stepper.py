@@ -21,6 +21,7 @@ import numpy as np
 
 from gol_tpu.models.rules import LIFE, Rule, get_rule
 from gol_tpu.ops import life
+from gol_tpu.params import BACKENDS
 
 
 @dataclasses.dataclass
@@ -170,9 +171,6 @@ def _single_device_pallas(rule: Rule, device=None) -> Stepper:
     )
 
 
-BACKENDS = ("auto", "packed", "dense", "pallas")
-
-
 def make_stepper(
     threads: int = 1,
     height: int = 512,
@@ -184,10 +182,12 @@ def make_stepper(
     """Build the best stepper for the request (the dispatch analog of
     ref: gol/distributor.go:93,116 picking serial vs row-farm).
 
-    `backend` picks the single-device kernel family: "auto" (packed when
-    the grid allows, else dense), or an explicit "packed" / "dense" /
-    "pallas". Sharded runs (threads > 1 with multiple devices) always
-    use the dense ring-halo path."""
+    `backend` picks the kernel family: "auto" (bit-packed when the grid
+    allows, else dense), or an explicit "packed" / "dense" / "pallas".
+    Sharded runs (threads > 1 with multiple devices) use the packed
+    ring-halo path when every strip is a whole number of 32-row words,
+    the dense ring-halo path otherwise ("dense" forces the latter;
+    "pallas" applies to single-device only)."""
     if backend not in BACKENDS:
         raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
     rule = get_rule(rule) if isinstance(rule, str) else rule
@@ -195,7 +195,21 @@ def make_stepper(
     k = shard_count(threads, height, len(devs))
     if k > 1:
         from gol_tpu.parallel.halo import sharded_stepper
+        from gol_tpu.parallel.packed_halo import (
+            packable_sharded,
+            packed_sharded_stepper,
+        )
 
+        # Explicit impossible requests fail loudly, like single-device.
+        if backend == "pallas":
+            raise ValueError("pallas backend is single-device only")
+        if backend == "packed" and not packable_sharded(height, k):
+            raise ValueError(
+                f"grid height {height} over {k} shards is not packable "
+                f"(strips must be whole 32-row words)"
+            )
+        if backend != "dense" and packable_sharded(height, k):
+            return packed_sharded_stepper(rule, devs[:k], height)
         return sharded_stepper(rule, devs[:k], height)
 
     from gol_tpu.ops.bitlife import packable

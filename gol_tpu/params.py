@@ -6,6 +6,9 @@ from __future__ import annotations
 
 import dataclasses
 
+#: Kernel families selectable via Params.backend / make_stepper / --backend.
+BACKENDS = ("auto", "packed", "dense", "pallas")
+
 
 @dataclasses.dataclass(frozen=True)
 class Params:
@@ -36,8 +39,8 @@ class Params:
     # Alive-count telemetry cadence in seconds (ref ticker: 2s,
     # gol/distributor.go:285).
     tick_seconds: float = 2.0
-    # Single-device kernel family: auto | packed | dense | pallas
-    # (parallel/stepper.py BACKENDS).
+    # Kernel family (see BACKENDS — the one authoritative list, shared
+    # with parallel/stepper.py and the CLI).
     backend: str = "auto"
     # Directory containing <W>x<H>.pgm inputs (ref: gol/io.go:39) and the
     # output directory (ref: gol/io.go:43).
@@ -55,7 +58,7 @@ class Params:
             raise ValueError("chunk must be >= 1")
         if self.tick_seconds <= 0:
             raise ValueError("tick_seconds must be > 0")
-        if self.backend not in ("auto", "packed", "dense", "pallas"):
+        if self.backend not in BACKENDS:
             raise ValueError(f"unknown backend {self.backend!r}")
 
     @property

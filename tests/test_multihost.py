@@ -1,0 +1,45 @@
+"""Multi-host bootstrap tests — what is testable single-process: the
+no-op path, argument validation, the global ring mesh shape, and that
+mesh devices drive the sharded steppers (the same SPMD program a real
+multi-host job runs; only the process count differs)."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.ops import life
+from gol_tpu.parallel import multihost
+from gol_tpu.parallel.halo import AXIS
+from gol_tpu.parallel.packed_halo import packed_sharded_stepper
+from gol_tpu.models.rules import LIFE
+
+
+def test_initialize_is_noop_without_coordinator(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    multihost.initialize()  # must not raise or touch jax.distributed
+
+
+def test_initialize_rejects_partial_args(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    with pytest.raises(ValueError):
+        multihost.initialize(num_processes=4)
+    with pytest.raises(ValueError):
+        multihost.initialize(process_id=1)
+
+
+def test_single_process_identity():
+    assert multihost.is_coordinator()
+    assert multihost.device_count() == 8  # virtual CPU mesh (conftest)
+
+
+def test_global_ring_mesh_drives_sharded_stepper():
+    mesh = multihost.global_ring_mesh()
+    assert mesh.axis_names == (AXIS,)
+    devices = list(mesh.devices.flat)
+    assert len(devices) == 8
+    s = packed_sharded_stepper(LIFE, devices, height=256)
+    world = life.random_world(256, 64, density=0.3, seed=5)
+    p = s.put(world)
+    p, count = s.step_n(p, 11)
+    want = np.asarray(life.step_n(world, 11))
+    np.testing.assert_array_equal(s.fetch(p), want)
+    assert int(count) == int(np.count_nonzero(want))
