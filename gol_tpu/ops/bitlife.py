@@ -125,6 +125,31 @@ def step_packed(p: jax.Array, rule: Rule = LIFE) -> jax.Array:
     return combine_packed(p, _shift_up(p), _shift_down(p), rule)
 
 
+def make_codec(height: int):
+    """Jitted (pack_world, unpack_world, fetch) trio shared by the packed
+    stepper backends: pack a {0,255} world to words, unpack words back,
+    and a host `fetch` that dispatches on dtype (packed uint32 worlds are
+    unpacked; anything else — e.g. dense bool diff masks — passes
+    through). One definition, so the wire convention cannot diverge
+    between the single-device and sharded packed paths."""
+    import numpy as _np
+
+    @jax.jit
+    def pack_world(world):
+        return pack(to_bits(world))
+
+    @jax.jit
+    def unpack_world(p):
+        return from_bits(unpack(p, height))
+
+    def fetch(arr):
+        if arr.dtype == jnp.uint32:
+            return _np.asarray(unpack_world(arr))
+        return _np.asarray(arr)
+
+    return pack_world, unpack_world, fetch
+
+
 def step_n_packed_raw(p: jax.Array, n: int, rule: Rule = LIFE) -> jax.Array:
     """`n` turns, packed in / packed out — the loop the packed stepper
     and the world-level wrappers share."""

@@ -37,16 +37,46 @@ from gol_tpu.ops.life import apply_rule, from_bits, to_bits
 AXIS = "rows"
 
 
+def ring_perms(n: int) -> tuple[list, list]:
+    """(down, up) permutation pairs of the closed n-ring — the single
+    definition of ring orientation for every halo path."""
+    down = [(i, (i + 1) % n) for i in range(n)]
+    up = [(i, (i - 1) % n) for i in range(n)]
+    return down, up
+
+
+def edge_exchange(p: jax.Array, axis: str = AXIS):
+    """ppermute this shard's first/last slice rows around the ring;
+    returns (row owned by the shard above, row owned by the shard
+    below). Works for dense bit rows and packed word rows alike."""
+    down, up = ring_perms(lax.axis_size(axis))
+    above_last = lax.ppermute(p[-1:], axis, down)
+    below_first = lax.ppermute(p[:1], axis, up)
+    return above_last, below_first
+
+
+def cpu_serializing_sync(devices: list):
+    """On the CPU backend (virtual test meshes), concurrent in-flight
+    programs containing collectives starve each other's rendezvous when
+    host cores are scarce — intra-program collectives are fine, so the
+    fix is to keep at most one program in flight by blocking on each
+    dispatch. Real TPU streams don't have this hazard; dispatch stays
+    fully async there."""
+    if devices[0].platform == "cpu":
+        return jax.block_until_ready
+
+    def _passthrough(x):
+        return x
+
+    return _passthrough
+
+
 def halo_step_bits(block: jax.Array, rule: Rule, axis: str = AXIS) -> jax.Array:
     """One turn on a local {0,1} row strip, exchanging one-row halos with
     ring neighbours over `axis`. Runs inside `shard_map`."""
-    n = lax.axis_size(axis)
     # My bottom row is the upper halo of the shard below me; my top row is
     # the lower halo of the shard above me. Closed ring => toroidal wrap.
-    down = [(i, (i + 1) % n) for i in range(n)]
-    up = [(i, (i - 1) % n) for i in range(n)]
-    halo_top = lax.ppermute(block[-1:], axis, down)
-    halo_bottom = lax.ppermute(block[:1], axis, up)
+    halo_top, halo_bottom = edge_exchange(block, axis)
     ext = jnp.concatenate([halo_top, block, halo_bottom], axis=0)
     # Vertical 3-sum over the extended strip (valid region = my rows),
     # then horizontal toroidal 3-sum, minus centre — same separable
@@ -100,17 +130,7 @@ def sharded_stepper(rule: Rule, devices: list, height: int):
     def count(world):
         return jnp.sum(world != 0, dtype=jnp.int32)
 
-    # On the CPU backend (virtual test meshes), concurrent in-flight
-    # programs containing collectives starve each other's rendezvous when
-    # host cores are scarce — intra-program collectives are fine, so the
-    # fix is to keep at most one program in flight by blocking on each
-    # dispatch. Real TPU streams don't have this hazard; dispatch stays
-    # fully async there.
-    if devices[0].platform == "cpu":
-        _sync = jax.block_until_ready
-    else:
-        def _sync(x):
-            return x
+    _sync = cpu_serializing_sync(devices)
 
     return Stepper(
         name=f"halo-ring-{n}",

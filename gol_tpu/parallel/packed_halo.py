@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from gol_tpu.models.rules import Rule
 from gol_tpu.ops import bitlife
 from gol_tpu.ops.bitlife import WORD
-from gol_tpu.parallel.halo import AXIS
+from gol_tpu.parallel.halo import AXIS, cpu_serializing_sync, edge_exchange
 
 
 def packable_sharded(height: int, shards: int) -> bool:
@@ -40,16 +40,15 @@ def packable_sharded(height: int, shards: int) -> bool:
     )
 
 
-def _edge_exchange(p: jax.Array, axis: str = AXIS):
-    """ppermute this shard's edge word-rows around the ring; returns
-    (word row owned by the shard above, word row owned by the shard
-    below) — same ring wiring as halo.halo_step_bits."""
-    n = lax.axis_size(axis)
-    down = [(i, (i + 1) % n) for i in range(n)]
-    up = [(i, (i - 1) % n) for i in range(n)]
-    above_last = lax.ppermute(p[-1:], axis, down)  # from shard above me
-    below_first = lax.ppermute(p[:1], axis, up)  # from shard below me
-    return above_last, below_first
+def packed_shard_count(requested: int, height: int, n_devices: int) -> int:
+    """Largest packed-feasible shard count ≤ requested (cf.
+    stepper.shard_count, with the extra whole-words-per-strip
+    constraint). 1 when only the single-device packed path fits."""
+    limit = max(1, min(requested, n_devices))
+    for k in range(limit, 0, -1):
+        if packable_sharded(height, k):
+            return k
+    return 1 if bitlife.packable(height, 0) else 0
 
 
 def halo_step_packed(p: jax.Array, rule: Rule, axis: str = AXIS) -> jax.Array:
@@ -58,7 +57,7 @@ def halo_step_packed(p: jax.Array, rule: Rule, axis: str = AXIS) -> jax.Array:
     Shift semantics mirror bitlife._shift_up/_shift_down, except the
     cross-word carry at the strip edges comes from the exchanged halo
     words instead of this shard's own wraparound."""
-    above_last, below_first = _edge_exchange(p, axis)
+    above_last, below_first = edge_exchange(p, axis)
 
     # result[y] = orig[y-1]: carry word for word-row r is word-row r-1;
     # for r=0 it is the upper neighbour's last word-row.
@@ -105,23 +104,13 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int):
     def step(p):
         return step_n(p, 1)[0]
 
+    _pack, _unpack_world, fetch = bitlife.make_codec(height)
+
     @jax.jit
     def step_with_diff(p):
         new, count = step_n(p, 1)
-        mask = _unpack(p ^ new) != 0
+        mask = bitlife.unpack(p ^ new, height) != 0
         return new, mask, count
-
-    @jax.jit
-    def _pack(world):
-        return bitlife.pack(bitlife.to_bits(world))
-
-    @jax.jit
-    def _unpack(p):
-        return bitlife.unpack(p, height)
-
-    @jax.jit
-    def _unpack_world(p):
-        return bitlife.from_bits(bitlife.unpack(p, height))
 
     @jax.jit
     def count(p):
@@ -131,18 +120,7 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int):
         world = jax.device_put(np.asarray(w, np.uint8))
         return jax.device_put(_pack(world), sharding)
 
-    def fetch(arr):
-        if arr.dtype == jnp.uint32:
-            return np.asarray(_unpack_world(arr))
-        return np.asarray(arr)
-
-    # Same CPU-backend serialization note as halo.sharded_stepper: keep
-    # one collective program in flight on virtual meshes.
-    if devices[0].platform == "cpu":
-        _sync = jax.block_until_ready
-    else:
-        def _sync(x):
-            return x
+    _sync = cpu_serializing_sync(devices)
 
     return Stepper(
         name=f"packed-halo-ring-{n}",

@@ -21,6 +21,7 @@ from gol_tpu.events import (
     TurnComplete,
 )
 from gol_tpu.io.pgm import alive_cells_from_pgm, read_pgm
+from gol_tpu.utils.check import assert_equal_board
 
 
 def drain(events):
@@ -63,7 +64,7 @@ def test_gol_final_board(golden_root, tmp_path, size, turns, threads):
     assert final.completed_turns == turns
     want = set(alive_cells_from_pgm(
         golden_root / "check" / "images" / f"{size}x{size}x{turns}.pgm"))
-    assert set(final.alive) == want
+    assert_equal_board(final.alive, want, size, size)
 
 
 @pytest.mark.parametrize("threads", [1, 8])
@@ -74,7 +75,7 @@ def test_gol_final_board_512(golden_root, tmp_path, threads):
     )
     _, final = drain(run(p, emit_flips=False))
     want = set(alive_cells_from_pgm(golden_root / "check" / "images" / "512x512x100.pgm"))
-    assert set(final.alive) == want
+    assert_equal_board(final.alive, want, 512, 512)
 
 
 # --- TestPgm analog (ref: pgm_test.go:10-42) ---

@@ -1,0 +1,106 @@
+"""Concurrency stress — the `go test -race` discipline analog
+(ref: README.md:129 "free of deadlocks and race conditions"; SURVEY.md §4
+'Race/deadlock'). The host-side threading surface is deliberately small
+(engine thread + requester protocol); this hammers every cross-thread
+entry point at once and requires a clean, consistent finish."""
+
+import queue
+import random
+import threading
+
+import numpy as np
+
+from gol_tpu.engine.distributor import Engine
+from gol_tpu.events import FinalTurnComplete, StateChange, State
+from gol_tpu.io.pgm import read_pgm
+from gol_tpu.ops import life
+from gol_tpu.params import Params
+
+
+def test_concurrent_requesters_and_keys(golden_root, tmp_path):
+    p = Params(
+        turns=400, threads=2, image_width=64, image_height=64, chunk=1,
+        image_dir=str(golden_root / "images"), out_dir=str(tmp_path / "out"),
+        tick_seconds=0.05,  # aggressive ticker
+    )
+    keys: queue.Queue = queue.Queue()
+    engine = Engine(p, keypresses=keys, emit_flips=True)
+    engine.start()
+
+    stop = threading.Event()
+    errors: list = []
+
+    def requester(seed):
+        rng = random.Random(seed)
+        last_turn = 0
+        while not stop.is_set():
+            turn, count = engine.alive_count_now(timeout=10.0)
+            if turn < last_turn:
+                errors.append(f"turn went backwards: {last_turn} -> {turn}")
+                return
+            last_turn = turn
+            if count < 0 or count > 64 * 64:
+                errors.append(f"impossible count {count}")
+                return
+            if rng.random() < 0.01:
+                keys.put("s")
+
+    def pauser():
+        rng = random.Random(99)
+        while not stop.is_set():
+            keys.put("p")
+            keys.put("p")
+            stop.wait(rng.random() * 0.05)
+
+    workers = [threading.Thread(target=requester, args=(i,), daemon=True)
+               for i in range(4)]
+    workers.append(threading.Thread(target=pauser, daemon=True))
+    for t in workers:
+        t.start()
+
+    final = None
+    evs = []
+    for ev in engine.events:
+        evs.append(ev)
+        if isinstance(ev, FinalTurnComplete):
+            final = ev
+    stop.set()
+    engine.join(60)
+    for t in workers:
+        t.join(10)
+
+    assert not errors, errors
+    assert engine.error is None
+    assert final is not None and final.completed_turns == 400
+    # Despite the chaos, the result is exactly the serial answer.
+    world = read_pgm(golden_root / "images" / "64x64.pgm")
+    want = np.asarray(life.step_n(world, 400))
+    got = {(c.x, c.y) for c in final.alive}
+    assert got == {(int(x), int(y)) for y, x in zip(*np.nonzero(want))}
+    # Pause chaos produced balanced state events ending in QUITTING.
+    states = [e.new_state for e in evs if isinstance(e, StateChange)]
+    assert states[-1] == State.QUITTING
+
+
+def test_many_engines_in_parallel(golden_root, tmp_path):
+    """Several engines sharing the process (and the virtual mesh) must
+    not wedge each other's collectives or event streams."""
+    engines = []
+    for i in range(3):
+        p = Params(
+            turns=40, threads=1, image_width=64, image_height=64, chunk=8,
+            image_dir=str(golden_root / "images"),
+            out_dir=str(tmp_path / f"out{i}"), tick_seconds=60.0,
+        )
+        engines.append(Engine(p, emit_flips=False).start())
+    world = read_pgm(golden_root / "images" / "64x64.pgm")
+    want = {(int(x), int(y))
+            for y, x in zip(*np.nonzero(np.asarray(life.step_n(world, 40))))}
+    for eng in engines:
+        final = None
+        for ev in eng.events:
+            if isinstance(ev, FinalTurnComplete):
+                final = ev
+        eng.join(60)
+        assert eng.error is None
+        assert final is not None and {(c.x, c.y) for c in final.alive} == want
