@@ -67,30 +67,6 @@ def _shift_down(p: jax.Array) -> jax.Array:
     return (p >> jnp.uint32(1)) | carry
 
 
-def _full_add(a, b, c):
-    """Bitwise full adder: (sum, carry) per bit position."""
-    ab = a ^ b
-    return ab ^ c, (a & b) | (c & ab)
-
-
-def _count_bits(neigh: list[jax.Array]) -> tuple[jax.Array, ...]:
-    """Carry-save adder tree: 8 one-bit addends -> 4 count bit-slices."""
-    s1, c1 = _full_add(neigh[0], neigh[1], neigh[2])
-    s2, c2 = _full_add(neigh[3], neigh[4], neigh[5])
-    s3 = neigh[6] ^ neigh[7]
-    c3 = neigh[6] & neigh[7]
-    # Bit 0: sum of the three partial sums.
-    b0, ca = _full_add(s1, s2, s3)
-    # Bit 1: the three carries plus ca.
-    s4, c4 = _full_add(c1, c2, c3)
-    b1 = s4 ^ ca
-    cb = s4 & ca
-    # Bit 2/3.
-    b2 = c4 ^ cb
-    b3 = c4 & cb
-    return b0, b1, b2, b3
-
-
 def _rule_mask(count_bits, ns) -> jax.Array:
     """OR of 4-variable minterms for each count in the static set."""
     b0, b1, b2, b3 = count_bits
@@ -105,16 +81,40 @@ def _rule_mask(count_bits, ns) -> jax.Array:
 
 
 def combine_packed(p: jax.Array, up: jax.Array, down: jax.Array,
-                   rule: Rule) -> jax.Array:
+                   rule: Rule, roll=None) -> jax.Array:
     """Horizontal rolls + CSA count + rule combine, given the two
     vertically-shifted bitboards. The single definition of the packed
     rule engine — the single-chip path supplies toroidal shifts, the
-    sharded path supplies halo-carried ones (parallel/packed_halo.py)."""
-    left = functools.partial(jnp.roll, shift=1, axis=1)
-    right = functools.partial(jnp.roll, shift=-1, axis=1)
-    neigh = [up, down, left(p), right(p),
-             left(up), right(up), left(down), right(down)]
-    counts = _count_bits(neigh)
+    sharded path supplies halo-carried ones (parallel/packed_halo.py),
+    and the pallas kernels supply `roll` (pltpu.roll) to stay on the VPU.
+
+    Column-sum form: the 8-neighbour count is (left column sum) +
+    (right column sum) + (up + down), where each column sum is the 2-bit
+    CSA of a vertical triple — 4 lane rolls (of the two column-sum bit
+    slices) instead of 6 (of p/up/down), and a 3x2-bit adder instead of
+    an 8x1-bit one."""
+    if roll is None:
+        roll = jnp.roll
+    # Vertical triple (up + p + down) as 2 bit slices.
+    upd = up ^ down
+    vs = upd ^ p
+    vc = (up & down) | (p & upd)
+    ls, lc = roll(vs, 1, 1), roll(vc, 1, 1)
+    w = p.shape[1]
+    rs, rc = roll(vs, w - 1, 1), roll(vc, w - 1, 1)
+    # count = (ls,lc) + (rs,rc) + (up+down as (upd, up&down)).
+    x = ls ^ rs
+    b0 = x ^ upd
+    k0 = (ls & rs) | (upd & x)          # carry out of bit 0
+    pc = up & down
+    y = lc ^ rc
+    t1 = y ^ pc                          # sum of the bit-1 slices
+    k1 = (lc & rc) | (pc & y)            # their carry into bit 2
+    b1 = t1 ^ k0
+    k2 = t1 & k0
+    b2 = k1 ^ k2
+    b3 = k1 & k2
+    counts = (b0, b1, b2, b3)
     survive = _rule_mask(counts, rule.survive)
     birth = _rule_mask(counts, rule.birth)
     return (p & survive) | (~p & birth)

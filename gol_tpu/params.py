@@ -7,7 +7,9 @@ from __future__ import annotations
 import dataclasses
 
 #: Kernel families selectable via Params.backend / make_stepper / --backend.
-BACKENDS = ("auto", "packed", "dense", "pallas")
+#: "pallas-packed" is the VMEM-resident packed kernel (whole-board or
+#: strip-tiled, ops/pallas_bitlife.py); "auto" prefers it on TPU.
+BACKENDS = ("auto", "packed", "dense", "pallas", "pallas-packed")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -119,6 +119,95 @@ def test_pallas_generic_rule_interpret():
     np.testing.assert_array_equal(got, want)
 
 
+# --- packed pallas kernels (whole-board VMEM-resident + strip-tiled) ---
+
+
+def test_fits_pallas_packed_gates():
+    from gol_tpu.ops.pallas_bitlife import (
+        fits_pallas_packed,
+        fits_pallas_packed_tiled,
+    )
+
+    assert fits_pallas_packed(512, 512)  # 16x512 words, well under budget
+    assert not fits_pallas_packed(500, 512)  # partial words
+    assert not fits_pallas_packed(4096, 4096)  # over VMEM budget
+    assert fits_pallas_packed_tiled(4096, 4096)  # but the tiled form fits
+    assert not fits_pallas_packed_tiled(4096, 4000)  # lane misalignment
+
+
+@pytest.mark.parametrize("turns", [1, 31, 33, 100])
+def test_pallas_packed_tiled_matches_dense_interpret(turns):
+    """The tiled kernel's 1-word-row halo must stay exact across the
+    32-turn light-cone boundary (turns 31/32/33) and strip seams:
+    768 rows = 24 word rows at strip_rows=8 forces 3 strips, so the
+    cross-strip halo index_map (including the toroidal wrap at strips
+    0 and 2) is genuinely exercised."""
+    from gol_tpu.ops.pallas_bitlife import step_n_packed_pallas_tiled_raw
+
+    world = random_world(768, 128, seed=turns)
+    p = bitlife.pack(life.to_bits(world))
+    got = np.asarray(
+        bitlife.unpack(
+            step_n_packed_pallas_tiled_raw(
+                p, turns, interpret=True, strip_rows=8
+            ),
+            768,
+        )
+    )
+    want = np.asarray(life.to_bits(life.step_n(world, turns)))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("turns", [1, 50])
+def test_pallas_packed_whole_matches_dense_interpret(turns):
+    from gol_tpu.ops.pallas_bitlife import step_n_pallas_packed
+
+    world = random_world(256, 128, seed=turns)
+    got = np.asarray(step_n_pallas_packed(world, turns, interpret=True))
+    want = np.asarray(life.step_n(world, turns))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_packed_generic_rule_interpret():
+    from gol_tpu.ops.pallas_bitlife import step_n_pallas_packed
+
+    hl = get_rule("B36/S23")
+    world = random_world(256, 128, seed=5)
+    got = np.asarray(step_n_pallas_packed(world, 20, rule=hl, interpret=True))
+    want = np.asarray(life.step_n(world, 20, rule=hl))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_packed_stepper_explicit(golden_root):
+    from gol_tpu.io.pgm import read_pgm
+
+    s = make_stepper(threads=1, height=256, width=128,
+                     backend="pallas-packed")
+    assert s.name == "single-pallas-packed"
+    world = random_world(256, 128, seed=2)
+    p = s.put(world)
+    new, count = s.step_n(p, 5)
+    want = np.asarray(life.step_n(world, 5))
+    np.testing.assert_array_equal(s.fetch(new), want)
+    assert int(count) == int(np.count_nonzero(want))
+    n2, mask, c2 = s.step_with_diff(new)
+    np.testing.assert_array_equal(
+        np.asarray(mask),
+        (s.fetch(new) != 0) != (s.fetch(n2) != 0),
+    )
+    assert int(s.alive_count_async(n2)) == int(c2)
+
+
+def test_pallas_packed_auto_is_cpu_gated():
+    # On the CPU test platform "auto" must not pick the interpreter-mode
+    # pallas kernels; on TPU it prefers them (asserted in bench).
+    assert make_stepper(threads=1, height=512, width=512).name == "single-packed"
+    with pytest.raises(ValueError):
+        make_stepper(threads=1, height=50, width=50, backend="pallas-packed")
+    with pytest.raises(ValueError):
+        make_stepper(threads=8, height=512, width=512, backend="pallas-packed")
+
+
 # --- backend selection (Params.backend -> make_stepper) ---
 
 
