@@ -141,6 +141,11 @@ def _tiled_call(p: jax.Array, k_turns: int, rule: Rule, interpret: bool,
                 strip_rows: int | None = None):
     rows, width = p.shape
     r = strip_rows or _strip_rows(rows, width)
+    if rows % r != 0 or r % 8 != 0:
+        raise ValueError(
+            f"strip_rows={r} must divide the packed row count {rows} and "
+            "be a multiple of 8"
+        )
     nstrips = rows // r
     blocks = r // 8  # halo fetches are single 8-sublane blocks, so the
     # neighbour strips cost 8 rows of HBM traffic each, not r rows.

@@ -86,13 +86,60 @@ def halo_step_bits(block: jax.Array, rule: Rule, axis: str = AXIS) -> jax.Array:
     return apply_rule(block, counts, rule)
 
 
+def halo_step_bits_uneven(
+    block: jax.Array, rule: Rule, n: int, height: int, axis: str = AXIS
+) -> jax.Array:
+    """One turn on a local {0,1} row strip when the grid height does not
+    divide the shard count (SURVEY §7 'pad/mask under uneven shards').
+
+    Balanced layout: every shard's physical block is S = ceil(H/n) rows;
+    shard i really owns S rows if i < H mod n, else S-1 (the classic
+    balanced split — no shard idles, unlike padding the tail). The
+    shard-local deviations from the even path, driven by
+    `lax.axis_index`:
+
+    - each shard sends its last *real* row (index real-1, not S-1) down
+      the ring as its neighbour's upper halo;
+    - the wrap row arriving from below is spliced in directly after the
+      last real row, so the seam stencil sees the true ring neighbour
+      instead of padding;
+    - after the rule combine, padding rows are forced dead (they border
+      live cells at the seam, so births could otherwise appear there).
+    """
+    S = block.shape[0]
+    r = height % n  # > 0: the uneven case
+    idx = lax.axis_index(axis)
+    real = jnp.where(idx < r, S, S - 1)
+    down, up = ring_perms(n)
+    send_down = lax.dynamic_slice(
+        block, (real - 1, jnp.int32(0)), (1, block.shape[1])
+    )
+    halo_top = lax.ppermute(send_down, axis, down)
+    halo_bottom = lax.ppermute(block[:1], axis, up)
+    ext = jnp.concatenate([halo_top, block, halo_bottom], axis=0)
+    ext = lax.dynamic_update_slice(ext, halo_bottom, (real + 1, jnp.int32(0)))
+    v = ext[:-2] + ext[1:-1] + ext[2:]
+    counts = v + jnp.roll(v, 1, 1) + jnp.roll(v, -1, 1) - block
+    new = apply_rule(block, counts, rule)
+    row_ids = lax.broadcasted_iota(jnp.int32, (S, 1), 0)
+    return jnp.where(row_ids < real, new, jnp.zeros_like(new))
+
+
 def sharded_stepper(rule: Rule, devices: list, height: int):
-    """Build a Stepper whose world lives row-sharded across `devices`."""
+    """Build a Stepper whose world lives row-sharded across `devices`.
+
+    Any (height, shard-count) pair is accepted: when `height % n != 0`
+    every shard still owns an equal ceil(height/n)-row block, with the
+    balanced split's short shards (index >= height % n) carrying one
+    dead padding row each, kept dead by `halo_step_bits_uneven` — so
+    the ring program stays SPMD and every device works, the analog of
+    the reference's row-farm accepting any worker count
+    (ref: gol/distributor.go:124-155)."""
     from gol_tpu.parallel.stepper import Stepper
 
     n = len(devices)
     if height % n != 0:
-        raise ValueError(f"height {height} not divisible by {n} shards")
+        return _sharded_stepper_uneven(rule, devices, height)
     mesh = Mesh(np.asarray(devices), (AXIS,))
     sharding = NamedSharding(mesh, P(AXIS, None))
     spec = P(AXIS, None)
@@ -137,6 +184,84 @@ def sharded_stepper(rule: Rule, devices: list, height: int):
         shards=n,
         put=lambda w: jax.device_put(np.asarray(w, np.uint8), sharding),
         fetch=lambda w: np.asarray(w),
+        step=lambda w: _sync(step(w)),
+        step_n=lambda w, k: _sync(step_n(w, int(k))),
+        step_with_diff=lambda w: _sync(step_with_diff(w)),
+        alive_count_async=lambda w: _sync(count(w)),
+    )
+
+
+def _sharded_stepper_uneven(rule: Rule, devices: list, height: int):
+    """The `height % n != 0` variant of `sharded_stepper`: device state
+    is a (n * ceil(H/n), W) array holding each shard's real rows at the
+    top of its strip (balanced split: shard i owns ceil rows if
+    i < H mod n, else floor). `put`/`fetch` scatter/gather the real
+    rows, so callers never see the padding."""
+    from gol_tpu.parallel.stepper import Stepper
+
+    n = len(devices)
+    strip = -(-height // n)  # ceil
+    rem = height % n
+    real = [strip if i < rem else strip - 1 for i in range(n)]
+    offsets = np.concatenate([[0], np.cumsum(real)])
+    mesh = Mesh(np.asarray(devices), (AXIS,))
+    sharding = NamedSharding(mesh, P(AXIS, None))
+    spec = P(AXIS, None)
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def step_n(world, k):
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P())
+        )
+        def _many(block):
+            bits = to_bits(block)
+            bits = lax.fori_loop(
+                0, k,
+                lambda _, b: halo_step_bits_uneven(b, rule, n, height),
+                bits,
+            )
+            # Padding is kept dead by the step, so the plain local
+            # reduction + psum is already the exact global count.
+            count = lax.psum(jnp.sum(bits, dtype=jnp.int32), AXIS)
+            return from_bits(bits), count
+
+        return _many(world)
+
+    @jax.jit
+    def step(world):
+        return step_n(world, 1)[0]
+
+    @jax.jit
+    def step_with_diff(world):
+        new, count = step_n(world, 1)
+        return new, world != new, count
+
+    @jax.jit
+    def count(world):
+        return jnp.sum(world != 0, dtype=jnp.int32)
+
+    def put(w):
+        host = np.asarray(w, np.uint8)
+        padded = np.zeros((n * strip, host.shape[1]), np.uint8)
+        for i in range(n):
+            padded[i * strip : i * strip + real[i]] = (
+                host[offsets[i] : offsets[i + 1]]
+            )
+        return jax.device_put(padded, sharding)
+
+    def fetch(a):
+        host = np.asarray(a)
+        return np.concatenate(
+            [host[i * strip : i * strip + real[i]] for i in range(n)]
+        )
+
+    _sync = cpu_serializing_sync(devices)
+
+    return Stepper(
+        name=f"halo-ring-uneven-{n}",
+        shards=n,
+        put=put,
+        fetch=fetch,
         step=lambda w: _sync(step(w)),
         step_n=lambda w, k: _sync(step_n(w, int(k))),
         step_with_diff=lambda w: _sync(step_with_diff(w)),

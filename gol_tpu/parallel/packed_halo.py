@@ -40,17 +40,6 @@ def packable_sharded(height: int, shards: int) -> bool:
     )
 
 
-def packed_shard_count(requested: int, height: int, n_devices: int) -> int:
-    """Largest packed-feasible shard count ≤ requested (cf.
-    stepper.shard_count, with the extra whole-words-per-strip
-    constraint). 1 when only the single-device packed path fits."""
-    limit = max(1, min(requested, n_devices))
-    for k in range(limit, 0, -1):
-        if packable_sharded(height, k):
-            return k
-    return 1 if bitlife.packable(height, 0) else 0
-
-
 def halo_step_packed(p: jax.Array, rule: Rule, axis: str = AXIS) -> jax.Array:
     """One turn on a local packed strip, halos over `axis`.
 

