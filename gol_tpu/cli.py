@@ -80,6 +80,17 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--resume", default=None, metavar="SNAPSHOT.pgm",
                     help="(with --serve) resume from an out/ snapshot, "
                          "continuing at the turn encoded in its filename")
+    # Multi-host SPMD job membership (parallel/multihost.py). All three
+    # default to the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    # JAX_PROCESS_ID env vars; unset means single-process.
+    ap.add_argument("--mh-coordinator", default=None, metavar="HOST:PORT",
+                    help="jax.distributed coordinator address; non-zero "
+                         "process ids run as SPMD workers mirroring the "
+                         "coordinator's dispatches")
+    ap.add_argument("--mh-procs", type=int, default=None, metavar="N",
+                    help="total process count in the multi-host job")
+    ap.add_argument("--mh-id", type=int, default=None, metavar="I",
+                    help="this process's id (0 = coordinator)")
     return ap
 
 
@@ -102,6 +113,32 @@ def main(argv: Optional[list[str]] = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    # Join (or create) the multi-host job before anything touches the
+    # backend; a no-op unless flags/env vars name a coordinator.
+    from gol_tpu.parallel import multihost
+
+    try:
+        multihost.initialize(args.mh_coordinator, args.mh_procs, args.mh_id)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}") from None
+    import jax
+
+    # A flag mismatch between job processes would build divergent SPMD
+    # programs that deadlock at the first collective; fail fast instead.
+    multihost.verify_job_config(
+        args.w, args.h, args.t, args.rule, args.backend
+    )
+
+    if jax.process_count() > 1 and not multihost.is_coordinator():
+        # Worker process: no IO, no events, no server — just mirror the
+        # coordinator's dispatches over the global mesh until released.
+        from gol_tpu.parallel.stepper import make_stepper
+
+        s = make_stepper(threads=args.t, height=args.h, width=args.w,
+                         rule=args.rule, backend=args.backend)
+        multihost.spmd_worker_loop(s, args.h, args.w)
+        return 0
 
     # Banner (ref: main.go:48-50).
     print("Threads:", args.t)
@@ -169,6 +206,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             return 1
         return 0
     finally:
+        multihost.notify_stop()
         stop_keys.set()
         if saved_termios is not None:
             import termios
@@ -188,10 +226,15 @@ def _addr(spec: str, default_host: str = "127.0.0.1") -> tuple[str, int]:
 
 def _serve(args, params: Params) -> int:
     """Headless engine server (the reference's AWS-side node,
-    ref: README.md:157-175)."""
+    ref: README.md:157-175).
+
+    Binds loopback unless an explicit HOST is given: the control
+    protocol is unauthenticated (any peer that can connect may pull
+    board state or send the 'k' kill verb), so exposure must be a
+    deliberate choice, e.g. `--serve 0.0.0.0:8030`."""
     from gol_tpu.distributed import EngineServer
 
-    host, port = _addr(args.serve, default_host="0.0.0.0")
+    host, port = _addr(args.serve, default_host="127.0.0.1")
     server = EngineServer(params, host, port, resume_from=args.resume)
     print(f"engine serving on {server.address[0]}:{server.address[1]}")
     server.start()
@@ -200,6 +243,10 @@ def _serve(args, params: Params) -> int:
             pass
     except KeyboardInterrupt:
         server.shutdown()
+    finally:
+        from gol_tpu.parallel import multihost
+
+        multihost.notify_stop()
     if server.engine.error is not None:
         print(f"engine error: {server.engine.error!r}", file=sys.stderr)
         return 1
@@ -247,12 +294,17 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
             from gol_tpu.visual import run_loop
 
             # The engine's board size wins over local -w/-h flags: the
-            # attach sync carries the authoritative dimensions.
-            if ctl.wait_sync() and ctl.board is not None:
-                h, w = ctl.board.shape
-                params = dataclasses.replace(
-                    params, image_width=w, image_height=h
-                )
+            # attach sync carries the authoritative dimensions. Running
+            # with unconfirmed local dimensions would blow up on the
+            # first out-of-range flip, so a failed sync aborts instead.
+            if not (ctl.wait_sync() and ctl.board is not None):
+                print("error: no board sync from the engine (attach "
+                      "failed or run already over)", file=sys.stderr)
+                return 1
+            h, w = ctl.board.shape
+            params = dataclasses.replace(
+                params, image_width=w, image_height=h
+            )
             run_loop(params, ctl.events, wire_keys)
         return 0
     finally:

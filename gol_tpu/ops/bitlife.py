@@ -40,6 +40,31 @@ def packable(height: int, width: int) -> bool:
     return height % WORD == 0 and height >= WORD
 
 
+def pack_np(world) -> "np.ndarray":
+    """Host-side pack: {0,255} (H, W) uint8 -> uint32 (H/32, W). Mirrors
+    `pack(to_bits(...))` without touching a device — multihost `put`
+    packs on the host so each process can slice its own shard."""
+    import numpy as np
+
+    bits = (np.asarray(world) != 0).astype(np.uint32)
+    h, w = bits.shape
+    words = bits.reshape(h // WORD, WORD, w)
+    weights = (np.uint32(1) << np.arange(WORD, dtype=np.uint32))[None, :, None]
+    return (words * weights).sum(axis=1, dtype=np.uint32)
+
+
+def unpack_np(packed, height: int) -> "np.ndarray":
+    """Host-side unpack: uint32 (H/32, W) -> {0,255} uint8 (H, W)."""
+    import numpy as np
+
+    packed = np.asarray(packed)
+    shifts = np.arange(WORD, dtype=np.uint32)[None, :, None]
+    words = (packed[:, None, :] >> shifts) & np.uint32(1)
+    return (words.reshape(height, packed.shape[1]) * np.uint8(255)).astype(
+        np.uint8
+    )
+
+
 def pack(bits: jax.Array) -> jax.Array:
     """{0,1} (H, W) -> uint32 (H/32, W), bit i of word r = row 32r+i."""
     h, w = bits.shape

@@ -178,12 +178,13 @@ def sharded_stepper(rule: Rule, devices: list, height: int):
         return jnp.sum(world != 0, dtype=jnp.int32)
 
     _sync = cpu_serializing_sync(devices)
+    from gol_tpu.parallel.multihost import spmd_fetch, spmd_put
 
     return Stepper(
         name=f"halo-ring-{n}",
         shards=n,
-        put=lambda w: jax.device_put(np.asarray(w, np.uint8), sharding),
-        fetch=lambda w: np.asarray(w),
+        put=lambda w: spmd_put(sharding, np.asarray(w, np.uint8)),
+        fetch=spmd_fetch,
         step=lambda w: _sync(step(w)),
         step_n=lambda w, k: _sync(step_n(w, int(k))),
         step_with_diff=lambda w: _sync(step_with_diff(w)),
@@ -240,6 +241,8 @@ def _sharded_stepper_uneven(rule: Rule, devices: list, height: int):
     def count(world):
         return jnp.sum(world != 0, dtype=jnp.int32)
 
+    from gol_tpu.parallel.multihost import spmd_fetch, spmd_put
+
     def put(w):
         host = np.asarray(w, np.uint8)
         padded = np.zeros((n * strip, host.shape[1]), np.uint8)
@@ -247,10 +250,10 @@ def _sharded_stepper_uneven(rule: Rule, devices: list, height: int):
             padded[i * strip : i * strip + real[i]] = (
                 host[offsets[i] : offsets[i + 1]]
             )
-        return jax.device_put(padded, sharding)
+        return spmd_put(sharding, padded)
 
     def fetch(a):
-        host = np.asarray(a)
+        host = spmd_fetch(a)
         return np.concatenate(
             [host[i * strip : i * strip + real[i]] for i in range(n)]
         )

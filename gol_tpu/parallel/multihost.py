@@ -73,6 +73,36 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+def is_multiprocess_mesh(devices) -> bool:
+    """True when `devices` spans processes, i.e. arrays sharded over them
+    are not fully addressable here and transfers must go through the
+    multihost paths below."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in devices)
+
+
+def spmd_put(sharding, host) -> jax.Array:
+    """Host array -> global array under `sharding`, valid whether or not
+    the sharding spans processes: every process holds the full host copy
+    (the coordinator broadcasts it first — see SPMDDriver.put) and each
+    device picks out its own shard."""
+    host = np.asarray(host)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx]
+    )
+
+
+def spmd_fetch(arr) -> np.ndarray:
+    """Global (possibly non-fully-addressable) array -> full host copy
+    on every process. All processes must call this together (it is an
+    allgather); single-process it is a plain transfer."""
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
 def global_ring_mesh() -> Mesh:
     """1-D mesh over every device in the job, ordered so ring neighbours
     are physically adjacent where possible (jax.devices() enumerates
@@ -82,3 +112,160 @@ def global_ring_mesh() -> Mesh:
 
 def device_count() -> int:
     return jax.device_count()
+
+
+# --- SPMD dispatch mirroring -------------------------------------------------
+#
+# Under jax.distributed every jitted computation over the global mesh
+# must be entered by EVERY process, in the same order, with the same
+# static arguments (the SPMD contract). The engine runs on the
+# coordinator and makes data/time-dependent dispatch choices (chunk
+# sizes, diff-vs-fused paths, snapshot fetches), so the coordinator
+# broadcasts a tiny command tuple before each dispatch and worker
+# processes replay it against their own reference to the same global
+# arrays. This is the worker entry point the reference's spec-level
+# "broker ⇄ workers" topology implies (ref: README.md:157-233), done
+# the JAX way: the data plane is the jitted program itself; the command
+# channel only carries opcodes.
+
+_OP_PUT, _OP_STEP, _OP_STEP_N, _OP_DIFF, _OP_COUNT = 0, 1, 2, 3, 4
+_OP_FETCH_WORLD, _OP_FETCH_MASK, _OP_STOP = 5, 6, 7
+
+
+def _bcast(value: np.ndarray) -> np.ndarray:
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(
+            value, is_source=is_coordinator()
+        )
+    )
+
+
+def _bcast_cmd(op: int, arg: int = 0) -> tuple[int, int]:
+    got = _bcast(np.asarray([op, arg], np.int32))
+    return int(got[0]), int(got[1])
+
+
+def round_robin_devices() -> list:
+    """Global device list reordered round-robin across processes, so a
+    k-device prefix spans as many hosts as possible (jax.devices()'s
+    process-grouped order would leave whole hosts idle whenever k fits
+    on the first host)."""
+    by_proc: dict[int, list] = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, []).append(d)
+    groups = [by_proc[p] for p in sorted(by_proc)]
+    out = []
+    for i in range(max(len(g) for g in groups)):
+        for g in groups:
+            if i < len(g):
+                out.append(g[i])
+    return out
+
+
+def verify_job_config(*fields) -> None:
+    """Fail fast when the processes of a multi-host job were launched
+    with different run parameters: a mismatch would otherwise build
+    divergent SPMD programs whose first collective deadlocks with no
+    diagnostic. The coordinator broadcasts its config; every process
+    asserts equality."""
+    if jax.process_count() == 1:
+        return
+    mine = ",".join(str(f) for f in fields).encode()
+    buf = np.zeros(256, np.uint8)
+    buf[: len(mine)] = np.frombuffer(mine, np.uint8)
+    got = _bcast(buf)
+    theirs = bytes(got[got != 0]).decode()
+    if theirs != mine.decode():
+        raise ValueError(
+            f"multi-host config mismatch: coordinator has [{theirs}], "
+            f"process {jax.process_index()} has [{mine.decode()}] — all "
+            "processes must be launched with identical -w/-h/-t/--rule/"
+            "--backend"
+        )
+
+
+def spmd_stepper(inner, height: int, width: int):
+    """Coordinator-side wrapper: a Stepper whose every dispatch first
+    broadcasts (opcode, arg) so workers running `spmd_worker_loop` on
+    the same inner stepper co-execute it in lockstep.
+
+    Contract (which the engine satisfies): dispatches are linear in the
+    current world — each step consumes the array the previous one
+    produced, `fetch` is called on either the current world or the mask
+    from the latest `step_with_diff` (told apart by dtype: masks are
+    bool)."""
+    from gol_tpu.parallel.stepper import Stepper
+
+    def put(world):
+        _bcast_cmd(_OP_PUT)
+        host = _bcast(np.asarray(world, np.uint8))
+        return inner.put(host)
+
+    def step(world):
+        _bcast_cmd(_OP_STEP)
+        return inner.step(world)
+
+    def step_n(world, k):
+        _bcast_cmd(_OP_STEP_N, int(k))
+        return inner.step_n(world, int(k))
+
+    def step_with_diff(world):
+        _bcast_cmd(_OP_DIFF)
+        return inner.step_with_diff(world)
+
+    def alive_count_async(world):
+        _bcast_cmd(_OP_COUNT)
+        return inner.alive_count_async(world)
+
+    def fetch(arr):
+        if getattr(arr, "dtype", None) == np.bool_:
+            _bcast_cmd(_OP_FETCH_MASK)
+        else:
+            _bcast_cmd(_OP_FETCH_WORLD)
+        return inner.fetch(arr)
+
+    return Stepper(
+        name=f"spmd-{inner.name}",
+        shards=inner.shards,
+        put=put,
+        fetch=fetch,
+        step=step,
+        step_n=step_n,
+        step_with_diff=step_with_diff,
+        alive_count_async=alive_count_async,
+    )
+
+
+def spmd_worker_loop(inner, height: int, width: int) -> None:
+    """Run on every non-coordinator process: replay the coordinator's
+    dispatch sequence against the same global arrays until _OP_STOP (or
+    the coordinator exits, which tears down the distributed client)."""
+    state = None
+    mask = None
+    while True:
+        op, arg = _bcast_cmd(_OP_STOP)
+        if op == _OP_PUT:
+            host = _bcast(np.zeros((height, width), np.uint8))
+            state = inner.put(host)
+        elif op == _OP_STEP:
+            state = inner.step(state)
+        elif op == _OP_STEP_N:
+            state, _ = inner.step_n(state, arg)
+        elif op == _OP_DIFF:
+            state, mask, _ = inner.step_with_diff(state)
+        elif op == _OP_COUNT:
+            inner.alive_count_async(state)
+        elif op == _OP_FETCH_WORLD:
+            inner.fetch(state)
+        elif op == _OP_FETCH_MASK:
+            inner.fetch(mask)
+        elif op == _OP_STOP:
+            return
+
+
+def notify_stop() -> None:
+    """Coordinator-side: release workers from `spmd_worker_loop`."""
+    if jax.process_count() > 1 and is_coordinator():
+        _bcast_cmd(_OP_STOP)

@@ -93,7 +93,7 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int):
     def step(p):
         return step_n(p, 1)[0]
 
-    _pack, _unpack_world, fetch = bitlife.make_codec(height)
+    from gol_tpu.parallel.multihost import spmd_fetch, spmd_put
 
     @jax.jit
     def step_with_diff(p):
@@ -106,8 +106,15 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int):
         return bitlife.count_packed(p)
 
     def put(w):
-        world = jax.device_put(np.asarray(w, np.uint8))
-        return jax.device_put(_pack(world), sharding)
+        # Pack on the host so every process can slice its own shard of
+        # the packed words (device-side packing would need the dense
+        # board as a global array first).
+        return spmd_put(sharding, bitlife.pack_np(w))
+
+    def fetch(arr):
+        if getattr(arr, "dtype", None) == jnp.uint32:
+            return bitlife.unpack_np(spmd_fetch(arr), height)
+        return spmd_fetch(arr)
 
     _sync = cpu_serializing_sync(devices)
 

@@ -211,8 +211,23 @@ def make_stepper(
     if backend not in BACKENDS:
         raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
     rule = get_rule(rule) if isinstance(rule, str) else rule
-    devs = devices if devices is not None else jax.devices()
+    multiprocess = devices is None and jax.process_count() > 1
+    if multiprocess:
+        # Round-robin across processes so the k-shard prefix spans every
+        # host; process-grouped order would leave whole hosts silently
+        # idle whenever k fits on the coordinator.
+        from gol_tpu.parallel.multihost import round_robin_devices
+
+        devs = round_robin_devices()
+    else:
+        devs = devices if devices is not None else jax.devices()
     k = shard_count(threads, height, len(devs))
+    if multiprocess and k < jax.process_count():
+        raise ValueError(
+            f"threads={threads} shards cannot span the "
+            f"{jax.process_count()}-process job — every process must own "
+            "at least one shard (raise -t or shrink the job)"
+        )
     if k > 1:
         from gol_tpu.parallel.halo import sharded_stepper
         from gol_tpu.parallel.packed_halo import (
@@ -229,8 +244,18 @@ def make_stepper(
                 f"(strips must be whole 32-row words)"
             )
         if backend != "dense" and packable_sharded(height, k):
-            return packed_sharded_stepper(rule, devs[:k], height)
-        return sharded_stepper(rule, devs[:k], height)
+            s = packed_sharded_stepper(rule, devs[:k], height)
+        else:
+            s = sharded_stepper(rule, devs[:k], height)
+        from gol_tpu.parallel import multihost
+
+        if multihost.is_multiprocess_mesh(devs[:k]):
+            # The mesh spans processes: the coordinator's dispatches must
+            # be mirrored on every worker (SPMD contract). Workers get
+            # the inner stepper and replay via spmd_worker_loop.
+            if multihost.is_coordinator():
+                return multihost.spmd_stepper(s, height, width)
+        return s
 
     from gol_tpu.ops.bitlife import packable
     from gol_tpu.ops.pallas_bitlife import (

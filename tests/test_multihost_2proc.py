@@ -1,0 +1,167 @@
+"""Real two-process `jax.distributed` execution on CPU (VERDICT r1
+Missing #5): a coordinator + worker pair, each owning 4 of the 8
+virtual devices, run the sharded ring-halo program over the GLOBAL mesh
+— `jax.distributed.initialize` actually executes, the halo `ppermute`s
+cross the process boundary over the Gloo transport, and put/fetch go
+through the multihost paths (`make_array_from_callback` /
+`process_allgather`). Results are compared against the single-process
+golden path. Ref topology: the reference README's controller⇄workers
+AWS layout (SURVEY §2 C11) — here the data plane is one SPMD program.
+"""
+
+import pathlib
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+SCRIPT = r"""
+import sys
+pid = int(sys.argv[1])
+port = sys.argv[2]
+size = int(sys.argv[3])
+turns = 100
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from gol_tpu.parallel import multihost
+
+multihost.initialize(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+import numpy as np
+from gol_tpu.io.pgm import read_pgm
+from gol_tpu.parallel.stepper import make_stepper
+
+root = os.environ["GOL_FIXTURES"]
+world = read_pgm(os.path.join(root, "images", f"{size}x{size}.pgm"))
+golden_path = os.path.join(root, "check", "images", f"{size}x{size}x{turns}.pgm")
+if os.path.exists(golden_path):
+    golden = np.asarray(read_pgm(golden_path))
+else:
+    # No golden at this size: the serial dense path (itself golden-pinned
+    # elsewhere) computed coordinator-locally is the expectation.
+    from gol_tpu.ops import life
+
+    golden = np.asarray(life.step_n(world, turns))
+
+s = make_stepper(threads=8, height=size, width=size)
+want_inner = "packed-halo-ring-8" if size % 256 == 0 else "halo-ring-8"
+if multihost.is_coordinator():
+    assert s.name == f"spmd-{want_inner}", s.name
+    p = s.put(world)
+    p, count = s.step_n(p, turns // 2)
+    new, mask, c2 = s.step_with_diff(p)      # diff path across processes
+    got_mask = s.fetch(mask)
+    p, count = s.step_n(new, turns // 2 - 1)
+    got = s.fetch(p)
+    assert np.array_equal(got, golden), "board mismatch"
+    assert int(count) == int(np.count_nonzero(golden)), "count"
+    assert got_mask.shape == (size, size)
+    multihost.notify_stop()
+    print("COORDINATOR_OK", flush=True)
+else:
+    multihost.spmd_worker_loop(s, size, size)
+    print("WORKER_OK", flush=True)
+"""
+
+
+@pytest.mark.parametrize(
+    "size",
+    [64,      # dense ring across the process boundary
+     256],    # packed ring: edge-word ppermute + host pack codec
+)
+def test_two_process_distributed_matches_golden(golden_root, tmp_path, size):
+    port = _free_port()
+    env = {
+        "PYTHONPATH": str(REPO),
+        "PATH": "/usr/bin:/bin",
+        "HOME": "/tmp",
+        "GOL_FIXTURES": str(golden_root),
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", SCRIPT, str(pid), str(port), str(size)],
+            env=env,
+            cwd=str(tmp_path),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("two-process run timed out (deadlock?)")
+        outs.append(out)
+    assert procs[0].returncode == 0, outs[0][-3000:]
+    assert procs[1].returncode == 0, outs[1][-3000:]
+    assert "COORDINATOR_OK" in outs[0]
+    assert "WORKER_OK" in outs[1]
+
+
+def test_two_process_cli_engine_golden(golden_root, tmp_path):
+    """The FULL product path across two processes: `python -m gol_tpu`
+    as coordinator (engine, IO, events) + worker (dispatch mirror),
+    sharing one global 8-device mesh. The coordinator's output PGM must
+    be byte-identical to the golden board — the reference's TestGol
+    contract, passing through jax.distributed."""
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    common = [
+        "-w", "64", "-h", "64", "-turns", "100", "-t", "8", "-noVis",
+        "--platform", "cpu", "--chunk", "16",
+        "--images", str(golden_root / "images"), "--out", str(out_dir),
+        "--mh-coordinator", f"localhost:{_free_port()}", "--mh-procs", "2",
+    ]
+    env = {
+        "PYTHONPATH": str(REPO),
+        "PATH": "/usr/bin:/bin",
+        "HOME": "/tmp",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "gol_tpu", *common, "--mh-id", str(pid)],
+            env=env,
+            cwd=str(tmp_path),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("two-process CLI run timed out")
+        outs.append(out)
+    assert procs[0].returncode == 0, outs[0][-3000:]
+    assert procs[1].returncode == 0, outs[1][-3000:]
+    got = (out_dir / "64x64x100.pgm").read_bytes()
+    want = (golden_root / "check" / "images" / "64x64x100.pgm").read_bytes()
+    assert got == want
