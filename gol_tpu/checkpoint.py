@@ -1,0 +1,51 @@
+"""Checkpoint discovery — PGM snapshots as the fault-tolerance store.
+
+A PGM snapshot is a complete checkpoint: the board is the whole state
+and the turn number is encoded in the filename `<W>x<H>x<T>.pgm`
+(filename convention ref: gol/distributor.go:181,230; PGM-as-checkpoint
+per SURVEY.md §5 "Checkpoint / resume"). The reference's fault-tolerance
+extension (ref: README.md:261-265) asks for runs that survive component
+death; here that is: periodic engine-side autosaves (Params.autosave_*),
+crash-atomic writes (io/pgm.py), and these helpers to find the newest
+complete checkpoint to resume from.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+_SNAP = re.compile(r"^(\d+)x(\d+)x(\d+)\.pgm$")
+
+
+def snapshot_turn(path: str | os.PathLike) -> int:
+    """Turn number encoded in a snapshot filename `<W>x<H>x<T>.pgm`."""
+    m = _SNAP.match(os.path.basename(os.fspath(path)))
+    if not m:
+        raise ValueError(f"not a snapshot filename: {path!r}")
+    return int(m.group(3))
+
+
+def latest_snapshot(
+    out_dir: str | os.PathLike, width: int, height: int
+) -> Optional[str]:
+    """Path of the highest-turn `<W>x<H>x<T>.pgm` in `out_dir`, or None.
+
+    Only complete snapshots are visible: in-flight writes live under a
+    dotted `.tmp` name until their atomic rename, so a run killed
+    mid-write never offers a truncated board here.
+    """
+    best_turn, best = -1, None
+    try:
+        names = os.listdir(out_dir)
+    except OSError:
+        return None
+    for name in names:
+        m = _SNAP.match(name)
+        if not m:
+            continue
+        w, h, turn = (int(g) for g in m.groups())
+        if (w, h) == (width, height) and turn > best_turn:
+            best_turn, best = turn, os.path.join(os.fspath(out_dir), name)
+    return best

@@ -67,6 +67,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="output image directory (default out/)")
     ap.add_argument("--tick", type=float, default=2.0, metavar="SEC",
                     help="AliveCellsCount cadence in seconds (default 2)")
+    ap.add_argument("--autosave-turns", type=int, default=0, metavar="N",
+                    help="auto-checkpoint the board to out/ every N "
+                         "completed turns (0 = off)")
+    ap.add_argument("--autosave-secs", type=float, default=0.0,
+                    metavar="SEC",
+                    help="auto-checkpoint the board to out/ every SEC "
+                         "seconds (0 = off)")
     ap.add_argument("--platform", default=None, metavar="NAME",
                     help="force a jax platform (e.g. cpu, tpu); some "
                          "site configs pin the platform so the "
@@ -78,8 +85,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--connect", default=None, metavar="HOST:PORT",
                     help="run as a controller attached to a remote engine")
     ap.add_argument("--resume", default=None, metavar="SNAPSHOT.pgm",
-                    help="(with --serve) resume from an out/ snapshot, "
-                         "continuing at the turn encoded in its filename")
+                    help="resume from an out/ snapshot, continuing at "
+                         "the turn encoded in its filename; 'latest' "
+                         "picks the newest matching snapshot in --out")
     # Multi-host SPMD job membership (parallel/multihost.py). All three
     # default to the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
     # JAX_PROCESS_ID env vars; unset means single-process.
@@ -160,10 +168,45 @@ def main(argv: Optional[list[str]] = None) -> int:
         tick_seconds=args.tick,
         image_dir=args.images,
         out_dir=args.out,
+        autosave_turns=args.autosave_turns,
+        autosave_seconds=args.autosave_secs,
     )
 
+    # Checkpoint restart (local or --serve): boot from a snapshot,
+    # continuing at the turn in its filename (SURVEY.md §5
+    # checkpoint/resume). A controller holds no board state, so
+    # --connect cannot resume — the engine server is where state lives.
+    resume_path = args.resume
+    if resume_path is not None and args.connect is not None:
+        raise SystemExit(
+            "error: --resume applies to the engine (local or --serve), "
+            "not to a --connect controller"
+        )
+    if resume_path == "latest":
+        from gol_tpu.checkpoint import latest_snapshot
+
+        resume_path = latest_snapshot(args.out, args.w, args.h)
+        if resume_path is None:
+            raise SystemExit(
+                f"error: no {args.w}x{args.h} snapshot found in {args.out}/"
+            )
+    if resume_path is not None:
+        from gol_tpu.checkpoint import snapshot_turn
+
+        try:
+            resume_turn = snapshot_turn(resume_path)
+        except ValueError as e:
+            raise SystemExit(
+                f"error: {e} — snapshots are named <W>x<H>x<TURN>.pgm"
+            ) from None
+        if resume_turn > args.turns:
+            raise SystemExit(
+                f"error: snapshot is at turn {resume_turn}, beyond "
+                f"-turns {args.turns}"
+            )
+
     if args.serve is not None:
-        return _serve(args, params)
+        return _serve(args, params, resume_path)
 
     keypresses: queue.Queue = queue.Queue()
     stop_keys = threading.Event()
@@ -183,8 +226,18 @@ def main(argv: Optional[list[str]] = None) -> int:
         if args.connect is not None:
             return _control(args, params, keypresses)
 
+        engine_kwargs = {}
+        if resume_path is not None:
+            from gol_tpu.checkpoint import snapshot_turn
+            from gol_tpu.io.pgm import read_pgm
+
+            engine_kwargs = {
+                "initial_world": read_pgm(resume_path),
+                "start_turn": snapshot_turn(resume_path),
+            }
         # Per-turn CellFlipped diffs only matter when something consumes them.
-        engine = Engine(params, keypresses=keypresses, emit_flips=not args.novis)
+        engine = Engine(params, keypresses=keypresses,
+                        emit_flips=not args.novis, **engine_kwargs)
         engine.start()
         try:
             if args.novis:
@@ -224,7 +277,7 @@ def _addr(spec: str, default_host: str = "127.0.0.1") -> tuple[str, int]:
         ) from None
 
 
-def _serve(args, params: Params) -> int:
+def _serve(args, params: Params, resume_path: Optional[str] = None) -> int:
     """Headless engine server (the reference's AWS-side node,
     ref: README.md:157-175).
 
@@ -235,7 +288,7 @@ def _serve(args, params: Params) -> int:
     from gol_tpu.distributed import EngineServer
 
     host, port = _addr(args.serve, default_host="127.0.0.1")
-    server = EngineServer(params, host, port, resume_from=args.resume)
+    server = EngineServer(params, host, port, resume_from=resume_path)
     print(f"engine serving on {server.address[0]}:{server.address[1]}")
     server.start()
     try:

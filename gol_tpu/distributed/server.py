@@ -28,26 +28,21 @@ from __future__ import annotations
 import contextlib
 import itertools
 import logging
-import os
 import queue
 import socket
 import threading
 from typing import Optional
 
+from gol_tpu.checkpoint import snapshot_turn
 from gol_tpu.distributed import wire
 from gol_tpu.engine.distributor import Engine
 from gol_tpu.events import BoardSync, CellFlipped, TurnComplete
 from gol_tpu.io.pgm import read_pgm
 from gol_tpu.params import Params
 
+__all__ = ["EngineServer", "snapshot_turn"]
+
 log = logging.getLogger(__name__)
-
-
-def snapshot_turn(path: str) -> int:
-    """Turn number encoded in a snapshot filename `<W>x<H>x<T>.pgm`
-    (ref: gol/distributor.go:230 filename convention)."""
-    stem = os.path.basename(path).rsplit(".", 1)[0]
-    return int(stem.split("x")[2])
 
 
 class _Conn:

