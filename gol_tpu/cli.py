@@ -190,6 +190,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             raise SystemExit(
                 f"error: no {args.w}x{args.h} snapshot found in {args.out}/"
             )
+    resume_turn = 0
     if resume_path is not None:
         from gol_tpu.checkpoint import snapshot_turn
 
@@ -228,12 +229,11 @@ def main(argv: Optional[list[str]] = None) -> int:
 
         engine_kwargs = {}
         if resume_path is not None:
-            from gol_tpu.checkpoint import snapshot_turn
             from gol_tpu.io.pgm import read_pgm
 
             engine_kwargs = {
                 "initial_world": read_pgm(resume_path),
-                "start_turn": snapshot_turn(resume_path),
+                "start_turn": resume_turn,
             }
         # Per-turn CellFlipped diffs only matter when something consumes them.
         engine = Engine(params, keypresses=keypresses,

@@ -86,3 +86,22 @@ def test_uneven_shard_names():
         s = make_stepper(threads=k, height=512, width=512)
         assert s.shards == k
         assert s.name == f"halo-ring-uneven-{k}"
+
+
+@pytest.mark.slow
+def test_stress_scale_5120(golden_root):
+    """The reference's stress-image size (a 5120x5120 PGM is linked for
+    scale testing, ref: README.md:209-211). No golden exists, so the
+    sharded packed ring (8 shards, 640 rows each) is checked bit-exactly
+    against the single-device dense engine on a random board."""
+    world = np.asarray(
+        life.random_world(5120, 5120, density=0.25, seed=7)
+    ).astype(np.uint8)
+    s = make_stepper(threads=8, height=5120, width=5120)
+    assert s.shards == 8
+    p = s.put(world)
+    p, count = s.step_n(p, 3)
+    got = s.fetch(p)
+    want = np.asarray(life.step_n(world, 3))
+    np.testing.assert_array_equal(got, want)
+    assert int(count) == int(np.count_nonzero(want))
