@@ -40,8 +40,8 @@ REPO = pathlib.Path(__file__).resolve().parent
 
 W = H = 512
 GATE_TURNS = 10_000  # extent of check/alive/512x512.csv
-TURNS = 1_000_000
-CHUNK = 45_000  # divides TURNS - GATE_TURNS exactly: 22 chained dispatches
+TURNS = 5_000_000
+CHUNK = 249_500  # divides TURNS - GATE_TURNS exactly: 20 chained dispatches
 BASELINE_TURNS = 40  # enough for a stable turns/s estimate (~2s scalar)
 
 
@@ -253,6 +253,30 @@ def measure_first_report() -> float:
     return float(line.split()[1])
 
 
+def measure_diff_rate(latency: float) -> dict:
+    """Live-view (per-turn diff) kernel rate: chained step_with_diff —
+    new world + flipped-cell mask + count every turn — realized once.
+    Quantifies the on-device cost of the SDL live view the reference
+    extension asks to measure (ref: README.md:257-259); shipping a mask
+    to the host adds one link round trip per rendered frame on top."""
+    import jax
+
+    from gol_tpu.parallel.stepper import make_stepper
+
+    stepper = make_stepper(threads=1, height=H, width=W,
+                           devices=[jax.devices()[0]])
+    p = stepper.put(_world(W))
+    turns = 2_000
+    p, mask, count = stepper.step_with_diff(p)  # warm
+    int(count)
+    t0 = time.perf_counter()
+    for _ in range(turns):
+        p, mask, count = stepper.step_with_diff(p)
+    int(count)
+    dt = time.perf_counter() - t0 - latency
+    return {"turns_per_sec": round(turns / dt, 1)}
+
+
 def expected_alive() -> int | None:
     csv = _golden(f"check/alive/{W}x{H}.csv")
     if csv is None:
@@ -311,6 +335,10 @@ def main() -> None:
         detail["engine_512x512"] = measure_engine_rate(tps)
     except Exception as e:
         detail["engine_512x512"] = {"error": repr(e)}
+    try:
+        detail["diff_kernel_512x512"] = measure_diff_rate(latency)
+    except Exception as e:
+        detail["diff_kernel_512x512"] = {"error": repr(e)}
     detail["first_alive_report_s"] = first_report
     # The pallas-packed vs XLA-packed-fori_loop ratio the README quotes.
     try:

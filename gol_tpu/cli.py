@@ -59,8 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "sharded; pallas is single-device only)")
     ap.add_argument("--chunk", type=int, default=None, metavar="K",
                     help="turns fused per device dispatch when no per-turn "
-                         "consumer is attached (default: 1 visualising, "
-                         "64 headless)")
+                         "consumer is attached; 0 auto-calibrates to ~0.1s "
+                         "per dispatch (default: 1 visualising, auto "
+                         "headless)")
     ap.add_argument("--images", default="images", metavar="DIR",
                     help="input image directory (default images/)")
     ap.add_argument("--out", default="out", metavar="DIR",
@@ -154,9 +155,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     print("Height:", args.h)
 
     # Headless engines (noVis drain or server) default to the fused-chunk
-    # fast path; a local visualiser needs per-turn diffs, so chunk 1.
+    # fast path with auto-calibrated chunk size; a local visualiser needs
+    # per-turn diffs, so chunk 1.
     headless = args.novis or args.serve is not None
-    chunk = args.chunk if args.chunk is not None else (64 if headless else 1)
+    chunk = args.chunk if args.chunk is not None else (0 if headless else 1)
     params = Params(
         turns=args.turns,
         threads=args.t,

@@ -172,7 +172,13 @@ class EngineServer:
         """Ask the engine to publish a BoardSync (and, if wanted, start
         per-turn flips) at its next dispatch boundary. Both ride the
         event stream, so the broadcaster delivers them in turn order —
-        no side-channel race between the sync and newer diffs."""
+        no side-channel race between the sync and newer diffs.
+
+        Per-turn TurnComplete events flow whenever ANY controller is
+        attached (flips or not — a headless controller still follows
+        progress, ref: sdl/loop.go:44-47 prints per-event); a detached
+        engine emits none and runs full-size fused chunks."""
+        self.engine.emit_turns = True
         self.engine.request_board_sync(
             enable_flips=conn.want_flips, token=conn.token
         )
@@ -182,16 +188,18 @@ class EngineServer:
             if self._conn is conn:
                 self._conn = None
                 self.engine.emit_flips = False
+                self.engine.emit_turns = False
         conn.close()
 
     def _refresh_flips(self) -> None:
-        """Re-derive engine.emit_flips from the currently attached
-        connection, atomically against attach/detach — the single writer
-        discipline that keeps broadcaster-side corrections from racing a
-        concurrent _detach or a fresh attach."""
+        """Re-derive engine.emit_flips/emit_turns from the currently
+        attached connection, atomically against attach/detach — the
+        single writer discipline that keeps broadcaster-side corrections
+        from racing a concurrent _detach or a fresh attach."""
         with self._conn_lock:
             cur = self._conn
             self.engine.emit_flips = cur is not None and cur.want_flips
+            self.engine.emit_turns = cur is not None
 
     # --- controller → engine ---
 

@@ -94,6 +94,14 @@ class EventQueue:
     def put(self, ev: Event) -> None:
         self._q.put(ev)
 
+    def qsize(self) -> int:
+        """Approximate backlog — the producer-side backpressure signal
+        (the reference throttles via its 1000-slot channel buffer,
+        ref: main.go:53; here the queue is unbounded so a blocked put
+        can never wedge shutdown, and the engine throttles itself on
+        this instead — see Engine._throttle_events)."""
+        return self._q.qsize()
+
     def close(self) -> None:
         self._closed.set()
         self._q.put(_CLOSE)
@@ -192,6 +200,9 @@ class Engine:
         self.timeline = timeline
         #: Exception that killed the engine thread, if any.
         self.error: Optional[BaseException] = None
+        #: The dispatch chunk actually in use (auto-calibration updates
+        #: it when Params.chunk == 0).
+        self.effective_chunk = max(params.chunk, 1) if params.chunk else 64
 
     # --- public api ---
 
@@ -307,6 +318,21 @@ class Engine:
         self._autosave_turn = self.start_turn
         self._autosave_time = time.monotonic()
 
+        # Auto-chunk calibration (Params.chunk == 0): starting at 64
+        # turns/dispatch, repeatedly (a) realize once after the first
+        # dispatch at the current size so compiles stay out of the
+        # measurement, (b) time a short window of warm dispatches,
+        # (c) grow to a power-of-two chunk worth ~0.1s at the measured
+        # rate. Stops when the chunk stops growing — each stage's rate
+        # includes per-dispatch overhead, so 2-3 stages converge (64 →
+        # dispatch-bound rate → kernel-bound rate). A fixed chunk of 64
+        # caps a tunnel-attached TPU at ~1% of the kernel rate; the cap
+        # of 2^18 keeps pause/key/snapshot response well under a second
+        # on any hardware.
+        chunk = 64 if p.chunk == 0 else p.chunk
+        cal = {"phase": "warm", "since": self.start_turn} if p.chunk == 0 else None
+        self.effective_chunk = chunk
+
         turn = self.start_turn
         while turn < p.turns and self._stop_reason is None:
             self._service_requests()
@@ -329,9 +355,45 @@ class Engine:
                 world = new_world
                 self._commit(turn, world, count)
                 self.events.put(TurnComplete(turn))
+                self._throttle_events()
                 self._maybe_autosave(turn, world)
             else:
-                k = min(p.chunk, p.turns - turn)
+                if cal is not None and not self.emit_turns:
+                    # Calibration only advances on an undisturbed engine:
+                    # an attached controller caps dispatches (and taxes
+                    # the loop), so locking a chunk from that rate would
+                    # strand the post-detach run undersized.
+                    if cal["phase"] == "warm":
+                        if turn > cal["since"]:
+                            int(self._committed[2])  # compile+1st chunk done
+                            cal = {"phase": "measure", "since": turn,
+                                   "t0": time.monotonic(),
+                                   "deadline": time.monotonic() + 0.3}
+                    elif time.monotonic() >= cal["deadline"]:
+                        int(self._committed[2])  # drain the queued chain
+                        elapsed = time.monotonic() - cal["t0"]
+                        if elapsed > 1.5:
+                            # Disturbed window (pause, verbs, host stall):
+                            # that rate is not the engine's — re-measure
+                            # instead of locking it in.
+                            cal = {"phase": "warm", "since": turn}
+                        else:
+                            rate = (turn - cal["since"]) / max(elapsed, 1e-6)
+                            target = max(64, min(1 << 18, int(rate * 0.1)))
+                            new_chunk = 1 << target.bit_length() - 1
+                            if new_chunk > chunk:
+                                chunk = new_chunk
+                                self.effective_chunk = chunk
+                                cal = {"phase": "warm", "since": turn}
+                            else:
+                                cal = None  # converged
+                # Snapshot the consumer state for THIS dispatch: an
+                # attached controller caps the dispatch size (bounded
+                # TurnComplete bursts, sub-second verb response), and a
+                # controller attaching mid-dispatch must not trigger a
+                # full-chunk burst of pre-sync events it would discard.
+                emit_now = self.emit_turns
+                k = min(chunk, 1024 if emit_now else chunk, p.turns - turn)
                 tick = time.perf_counter() if self.timeline else 0.0
                 world, count = self.stepper.step_n(world, k)
                 if self.timeline:
@@ -342,9 +404,10 @@ class Engine:
                 first = turn + 1
                 turn += k
                 self._commit(turn, world, count)
-                if self.emit_turns:
+                if emit_now:
                     for t in range(first, turn + 1):
                         self.events.put(TurnComplete(t))
+                    self._throttle_events()
                 self._maybe_autosave(turn, world)
 
         self._ticker_stop.set()
@@ -471,6 +534,25 @@ class Engine:
             self.events.put(
                 StateChange(turn, State.PAUSED if self._paused else State.EXECUTING)
             )
+
+    def _throttle_events(self) -> None:
+        """Producer-side backpressure: when an event consumer lags far
+        behind (an engine can emit millions of TurnCompletes/s; a wire
+        broadcaster drains tens of thousands), wait for the backlog to
+        drain before dispatching more turns. The reference gets this
+        from its 1000-slot channel buffer blocking the sender
+        (ref: main.go:53); here the wait loop stays interruptible —
+        stop/'q'/'k' and count requests are still serviced — so a
+        vanished consumer can never wedge shutdown the way a hard
+        blocking put would."""
+        while (
+            self.events.qsize() > 10_000
+            and self._stop_reason is None
+            and not self.events.closed
+        ):
+            self._service_requests()
+            self._poll_keys(self._committed[0])
+            time.sleep(0.005)
 
     def _maybe_autosave(self, turn: int, world) -> None:
         """Periodic auto-checkpoint between dispatches. Snapshot cadence

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Halo-exchange vs central-resync communication-overhead analysis.
+
+The reference's Halo Exchange extension (ref: README.md:239-245) notes
+that the easy distributed scheme — every worker resyncs the whole board
+with a central distributor node each iteration — has a heavy
+communication overhead "which you might be able to measure", and asks
+for a direct worker-to-worker halo scheme plus a performance comparison.
+
+This script is that measurement, TPU-native style, on a virtual
+8-device mesh (so it runs anywhere, like the test suite):
+
+- halo ring: the framework's sharded stepper — row strips stay on their
+  devices, one edge row (or packed edge word-row) ppermutes to each
+  ring neighbour per turn, chained dispatches realized once.
+- central resync: the same per-turn step, but the full board is pulled
+  to the host and re-distributed every turn (fetch + put) — the "resync
+  with a central node" scheme.
+
+Prints one JSON line with both rates and the ratio.
+
+Usage: python scripts/halo_vs_resync.py [side] [turns]
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+CHILD = r"""
+import json, sys, time
+import os
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, sys.argv[1])
+
+from gol_tpu.ops import life
+from gol_tpu.parallel.stepper import make_stepper
+
+side, turns = int(sys.argv[2]), int(sys.argv[3])
+world0 = life.random_world(side, side, density=0.25, seed=11)
+
+s = make_stepper(threads=8, height=side, width=side)
+assert s.shards == 8, s.shards
+
+# Halo ring: per-turn dispatches (k=1, the honest per-iteration cost),
+# board stays sharded on-device, one realization at the end.
+p = s.put(world0)
+p, c = s.step_n(p, 1)
+int(c)  # warm
+p = s.put(world0)
+t0 = time.perf_counter()
+for _ in range(turns):
+    p, c = s.step_n(p, 1)
+int(c)
+halo_s = time.perf_counter() - t0
+
+# Central resync: identical device step, but the whole board goes
+# host -> devices -> host every turn (the distributor-resync scheme).
+host = s.fetch(s.put(world0))
+t0 = time.perf_counter()
+for _ in range(turns):
+    p = s.put(host)
+    p, c = s.step_n(p, 1)
+    host = s.fetch(p)
+resync_s = time.perf_counter() - t0
+
+print(json.dumps({
+    "board": f"{side}x{side}",
+    "turns": turns,
+    "halo_ring_turns_per_sec": round(turns / halo_s, 1),
+    "central_resync_turns_per_sec": round(turns / resync_s, 1),
+    "halo_speedup": round(resync_s / halo_s, 2),
+}))
+"""
+
+
+def main() -> None:
+    side = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    turns = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    env = {**os.environ}
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", CHILD, str(REPO), str(side), str(turns)],
+        env=env, capture_output=True, text=True, timeout=1200,
+    )
+    if proc.returncode != 0:
+        raise SystemExit(f"analysis failed:\n{proc.stdout}\n{proc.stderr}")
+    print(proc.stdout.strip().splitlines()[-1])
+
+
+if __name__ == "__main__":
+    main()

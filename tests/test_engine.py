@@ -4,6 +4,7 @@ pgm_test.go, sdl_test.go, count_test.go). All runs go through the public
 `gol_tpu.run` surface with golden fixtures as ground truth."""
 
 import csv
+import time
 import queue
 
 import numpy as np
@@ -295,3 +296,43 @@ print("abandoning")
                        text=True, timeout=120)
     assert r.returncode == 0, r.stderr
     assert "abandoning" in r.stdout
+
+
+# --- auto-chunk calibration (Params.chunk == 0) ---
+
+
+def test_auto_chunk_golden(golden_root, tmp_path):
+    """chunk=0 (auto) must not change results: golden board at turn 100."""
+    p = make_params(golden_root, tmp_path, turns=100, threads=4,
+                    image_width=64, image_height=64, chunk=0)
+    engine = Engine(p, emit_flips=False)
+    engine.start()
+    engine.join(timeout=300)
+    assert engine.error is None
+    got = (tmp_path / "out" / "64x64x100.pgm").read_bytes()
+    want = (golden_root / "check" / "images" / "64x64x100.pgm").read_bytes()
+    assert got == want
+
+
+def test_auto_chunk_calibrates_up(golden_root, tmp_path):
+    """On a long run the calibrator must lock a chunk above the 64-turn
+    warm-up size (any platform steps a 64x64 board far faster than 640
+    turns/s) and turn accounting must stay consistent."""
+    p = make_params(golden_root, tmp_path, turns=10_000_000, threads=1,
+                    image_width=64, image_height=64, chunk=0,
+                    tick_seconds=0.2)
+    engine = Engine(p, emit_flips=False)
+    engine.start()
+    deadline = time.monotonic() + 60
+    try:
+        while time.monotonic() < deadline:
+            if getattr(engine, "effective_chunk", 64) > 64:
+                break
+            time.sleep(0.1)
+        assert engine.effective_chunk > 64, "calibration never locked"
+        turn, count = engine.alive_count_now(timeout=10.0)
+        assert turn > 0  # a consistent committed pair is being served
+    finally:
+        engine.stop()
+        engine.join(timeout=60)
+    assert engine.error is None
