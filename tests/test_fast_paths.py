@@ -322,3 +322,31 @@ def test_sharded_thin_strips_fall_back_to_dense():
     # And "dense" forces the dense path even when packing is possible.
     s = make_stepper(threads=8, height=512, width=512, backend="dense")
     assert s.name == "halo-ring-8"
+
+
+# --- communication-avoiding deep halos (parallel/packed_halo.py) ---
+
+
+@pytest.mark.parametrize("shards", [2, 4, 8])
+@pytest.mark.parametrize("turns", [32, 64, 100])
+def test_deep_halo_blocks_match_dense(golden_root, shards, turns):
+    """step_n >= 32 on the packed ring takes the deep-halo path (one
+    edge-word exchange per 32 local turns); results must stay bit-exact
+    vs the dense serial engine, including the 100 = 3x32 + 4 mixed
+    block/remainder case."""
+    import jax
+
+    from gol_tpu.io.pgm import read_pgm
+    from gol_tpu.parallel.packed_halo import packed_sharded_stepper
+
+    world = read_pgm(golden_root / "images" / "512x512.pgm")
+    s = packed_sharded_stepper(LIFE, jax.devices()[:shards], 512)
+    p = s.put(world)
+    p, count = s.step_n(p, turns)
+    got = s.fetch(p)
+    if turns == 100:
+        want = read_pgm(golden_root / "check" / "images" / "512x512x100.pgm")
+    else:
+        want = np.asarray(life.step_n(world, turns))
+    np.testing.assert_array_equal(got, want, err_msg=f"shards={shards}")
+    assert int(count) == int(np.count_nonzero(want))
