@@ -32,9 +32,18 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gol_tpu.models.rules import Rule
-from gol_tpu.ops.life import apply_rule, from_bits, to_bits
+from gol_tpu.ops.life import apply_rule, from_bits, step_bits, to_bits
 
 AXIS = "rows"
+
+#: Deep-halo depth cap for the dense ring: exchange K edge rows once,
+#: step K exact turns locally (validity shrinks one row per turn into
+#: the ghosts), slice the strip back out — K× fewer ring collectives
+#: for fused multi-turn dispatches. Same construction as the packed
+#: path's one-ghost-word blocks (parallel/packed_halo.py), with K
+#: bounded by the strip height (each ghost must come whole from ONE
+#: ring neighbour).
+DEEP_ROWS = 16
 
 
 def ring_perms(n: int) -> tuple[list, list]:
@@ -45,13 +54,15 @@ def ring_perms(n: int) -> tuple[list, list]:
     return down, up
 
 
-def edge_exchange(p: jax.Array, axis: str = AXIS):
-    """ppermute this shard's first/last slice rows around the ring;
-    returns (row owned by the shard above, row owned by the shard
-    below). Works for dense bit rows and packed word rows alike."""
+def edge_exchange(p: jax.Array, axis: str = AXIS, depth: int = 1):
+    """ppermute this shard's first/last `depth` slice rows around the
+    ring; returns (rows owned by the shard above, rows owned by the
+    shard below). Works for dense bit rows and packed word rows alike —
+    the single definition of ring orientation for per-turn halos
+    (depth=1) and deep-halo ghosts (depth=K) in both representations."""
     down, up = ring_perms(lax.axis_size(axis))
-    above_last = lax.ppermute(p[-1:], axis, down)
-    below_first = lax.ppermute(p[:1], axis, up)
+    above_last = lax.ppermute(p[-depth:], axis, down)
+    below_first = lax.ppermute(p[:depth], axis, up)
     return above_last, below_first
 
 
@@ -152,14 +163,30 @@ def sharded_stepper(rule: Rule, devices: list, height: int):
 
         return _one(world)
 
+    deep = min(DEEP_ROWS, height // n)
+
+    def deep_block(bits):
+        """One K-row exchange, K exact local turns (see DEEP_ROWS)."""
+        top_ghost, bottom_ghost = edge_exchange(bits, AXIS, depth=deep)
+        ext = jnp.concatenate([top_ghost, bits, bottom_ghost], axis=0)
+        # Plain toroidal stepping: the wrap only corrupts rows whose
+        # validity the one-row-per-turn shrink already wrote off.
+        ext = lax.fori_loop(0, deep, lambda _, b: step_bits(b, rule), ext)
+        return ext[deep:-deep]
+
     @functools.partial(jax.jit, static_argnames=("k",))
     def step_n(world, k):
+        blocks, rem = divmod(max(k, 0), deep)
+
         @functools.partial(
             jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P())
         )
         def _many(block):
             bits = to_bits(block)
-            bits = lax.fori_loop(0, k, lambda _, b: halo_step_bits(b, rule), bits)
+            bits = lax.fori_loop(0, blocks, lambda _, b: deep_block(b), bits)
+            bits = lax.fori_loop(
+                0, rem, lambda _, b: halo_step_bits(b, rule), bits
+            )
             # Local reduction + psum over the ring — the distributed
             # alive count (ref: gol/distributor.go:420-432), fused into
             # the same program as the turns.

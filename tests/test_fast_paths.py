@@ -350,3 +350,24 @@ def test_deep_halo_blocks_match_dense(golden_root, shards, turns):
         want = np.asarray(life.step_n(world, turns))
     np.testing.assert_array_equal(got, want, err_msg=f"shards={shards}")
     assert int(count) == int(np.count_nonzero(want))
+
+
+@pytest.mark.parametrize("shards", [2, 8])
+@pytest.mark.parametrize("turns", [16, 50])
+def test_deep_halo_dense_matches_dense(golden_root, shards, turns):
+    """The dense ring's deep path (K = min(16, strip) row ghosts, K
+    local turns per exchange) must stay bit-exact vs the serial engine,
+    including mixed block/remainder turn counts."""
+    import jax
+
+    from gol_tpu.io.pgm import read_pgm
+    from gol_tpu.parallel.halo import sharded_stepper
+
+    world = read_pgm(golden_root / "images" / "64x64.pgm")
+    s = sharded_stepper(LIFE, jax.devices()[:shards], 64)
+    p = s.put(world)
+    p, count = s.step_n(p, turns)
+    got = s.fetch(p)
+    want = np.asarray(life.step_n(world, turns))
+    np.testing.assert_array_equal(got, want, err_msg=f"shards={shards}")
+    assert int(count) == int(np.count_nonzero(want))
