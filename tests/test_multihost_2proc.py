@@ -120,19 +120,15 @@ def test_two_process_distributed_matches_golden(golden_root, tmp_path, size):
     assert "WORKER_OK" in outs[1]
 
 
-def test_two_process_cli_engine_golden(golden_root, tmp_path):
-    """The FULL product path across two processes: `python -m gol_tpu`
-    as coordinator (engine, IO, events) + worker (dispatch mirror),
-    sharing one global 8-device mesh. The coordinator's output PGM must
-    be byte-identical to the golden board — the reference's TestGol
-    contract, passing through jax.distributed."""
-    out_dir = tmp_path / "out"
-    out_dir.mkdir()
+def _run_cli_pair(golden_root, tmp_path, out_dir, extra):
+    """Launch coordinator + worker `python -m gol_tpu` processes over a
+    shared 8-device mesh and assert both exit cleanly."""
     common = [
-        "-w", "64", "-h", "64", "-turns", "100", "-t", "8", "-noVis",
+        "-w", "64", "-h", "64", "-t", "8", "-noVis",
         "--platform", "cpu", "--chunk", "16",
         "--images", str(golden_root / "images"), "--out", str(out_dir),
-        "--mh-coordinator", f"localhost:{_free_port()}", "--mh-procs", "2",
+        "--mh-coordinator", f"localhost:{_free_port()}",
+        "--mh-procs", "2", *extra,
     ]
     env = {
         "PYTHONPATH": str(REPO),
@@ -143,11 +139,8 @@ def test_two_process_cli_engine_golden(golden_root, tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, "-m", "gol_tpu", *common, "--mh-id", str(pid)],
-            env=env,
-            cwd=str(tmp_path),
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
+            env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for pid in (0, 1)
     ]
@@ -162,6 +155,42 @@ def test_two_process_cli_engine_golden(golden_root, tmp_path):
         outs.append(out)
     assert procs[0].returncode == 0, outs[0][-3000:]
     assert procs[1].returncode == 0, outs[1][-3000:]
+
+
+def test_two_process_cli_engine_golden(golden_root, tmp_path):
+    """The FULL product path across two processes: `python -m gol_tpu`
+    as coordinator (engine, IO, events) + worker (dispatch mirror),
+    sharing one global 8-device mesh. The coordinator's output PGM must
+    be byte-identical to the golden board — the reference's TestGol
+    contract, passing through jax.distributed."""
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    _run_cli_pair(golden_root, tmp_path, out_dir, ["-turns", "100"])
+    got = (out_dir / "64x64x100.pgm").read_bytes()
+    want = (golden_root / "check" / "images" / "64x64x100.pgm").read_bytes()
+    assert got == want
+
+
+def test_two_process_cli_autosave_and_resume(golden_root, tmp_path):
+    """Fault story x multihost SPMD: periodic auto-checkpoints during a
+    two-process run (each snapshot fetch is a mirrored dispatch), then a
+    fresh two-process job resumes from the latest checkpoint and lands
+    byte-exact on the golden board."""
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+
+    # Phase 1: run to turn 64 with a 30-turn autosave cadence. Final
+    # board at 64 plus the mid-run checkpoint at turn 32 (first dispatch
+    # boundary past the 30-turn cadence at chunk 16) must exist.
+    _run_cli_pair(golden_root, tmp_path, out_dir,
+                  ["-turns", "64", "--autosave-turns", "30"])
+    assert (out_dir / "64x64x64.pgm").exists()
+    assert (out_dir / "64x64x32.pgm").exists()
+
+    # Phase 2: fresh two-process job resumes from the latest snapshot
+    # (turn 64) and continues to 100.
+    _run_cli_pair(golden_root, tmp_path, out_dir,
+                  ["-turns", "100", "--resume", "latest"])
     got = (out_dir / "64x64x100.pgm").read_bytes()
     want = (golden_root / "check" / "images" / "64x64x100.pgm").read_bytes()
     assert got == want
