@@ -194,7 +194,11 @@ def measure_engine_rate(headline_tps: float) -> dict:
             raise engine.error
         return time.perf_counter() - t0
 
-    short_turns, long_turns = 200_000, 1_200_000
+    # The long run must dwarf the short one: the marginal rate divides
+    # by (t_long - t_short), and a small delta drowns in run-to-run
+    # noise (an early version with a 1M-turn spread measured a marginal
+    # above the kernel rate — impossible, pure noise).
+    short_turns, long_turns = 200_000, 4_200_000
     with tempfile.TemporaryDirectory() as out:
         one_run(short_turns, out)          # warm every program the engine uses
         t_short = one_run(short_turns, out)

@@ -1,23 +1,35 @@
 #!/usr/bin/env python
-"""Halo-exchange vs central-resync communication-overhead analysis.
+"""Distribution-scheme communication-overhead analysis.
 
 The reference's Halo Exchange extension (ref: README.md:239-245) notes
 that the easy distributed scheme — every worker resyncs the whole board
 with a central distributor node each iteration — has a heavy
 communication overhead "which you might be able to measure", and asks
-for a direct worker-to-worker halo scheme plus a performance comparison.
+for a direct worker-to-worker halo scheme plus a performance
+comparison.
 
-This script is that measurement, TPU-native style, on a virtual
-8-device mesh (so it runs anywhere, like the test suite):
+This script measures FOUR schemes on a virtual 8-device mesh (so it
+runs anywhere, like the test suite), each as (turns, turns_per_sec):
 
-- halo ring: the framework's sharded stepper — row strips stay on their
-  devices, one edge row (or packed edge word-row) ppermutes to each
-  ring neighbour per turn, chained dispatches realized once.
-- central resync: the same per-turn step, but the full board is pulled
-  to the host and re-distributed every turn (fetch + put) — the "resync
-  with a central node" scheme.
+- central_resync      per turn: full board host -> devices, one step,
+                      devices -> host (the distributor-resync scheme).
+- ring_per_dispatch   per turn: one jitted dispatch of the sharded
+                      step (edge rows ppermute to ring neighbours);
+                      board stays on-device, dispatches chained,
+                      realized once. Isolates per-dispatch overhead.
+- ring_fused          per-turn exchanges, but turns fused into
+                      31-turn dispatches (the packed ring's remainder
+                      path): same collective cadence, amortized
+                      dispatch cost.
+- ring_deep           32-turn deep-halo blocks (one ghost exchange
+                      per 32 local turns), same dispatch count as
+                      ring_fused — so ratios.deep_vs_fused isolates
+                      the communication-avoidance effect alone.
 
-Prints one JSON line with both rates and the ratio.
+Prints one JSON line: {"board", "schemes": {...}, "ratios": {...}}.
+ratios.ring_vs_resync compares the per-dispatch ring to the resync
+scheme; ratios.deep_vs_fused compares equal-dispatch-count fused runs
+(31 vs 32 turns per dispatch, every-turn vs once-per-32 exchanges).
 
 Usage: python scripts/halo_vs_resync.py [side] [turns]
 """
@@ -50,41 +62,60 @@ world0 = life.random_world(side, side, density=0.25, seed=11)
 s = make_stepper(threads=8, height=side, width=side)
 assert s.shards == 8, s.shards
 
-# Halo ring: per-turn dispatches (k=1, the honest per-iteration cost),
-# board stays sharded on-device, one realization at the end.
-p = s.put(world0)
-p, c = s.step_n(p, 1)
-int(c)  # warm
-p = s.put(world0)
-t0 = time.perf_counter()
-for _ in range(turns):
-    p, c = s.step_n(p, 1)
-int(c)
-halo_s = time.perf_counter() - t0
+schemes = {}
 
-# Central resync: identical device step, but the whole board goes
-# host -> devices -> host every turn (the distributor-resync scheme).
+
+def run(label, per_dispatch, dispatches):
+    # warm
+    p = s.put(world0)
+    p, c = s.step_n(p, per_dispatch)
+    int(c)
+    p = s.put(world0)
+    t0 = time.perf_counter()
+    for _ in range(dispatches):
+        p, c = s.step_n(p, per_dispatch)
+    int(c)
+    dt = time.perf_counter() - t0
+    done = per_dispatch * dispatches
+    schemes[label] = {"turns": done, "turns_per_sec": round(done / dt, 1)}
+
+
+# central resync: the board crosses the host boundary every turn.
 host = s.fetch(s.put(world0))
 t0 = time.perf_counter()
 for _ in range(turns):
     p = s.put(host)
     p, c = s.step_n(p, 1)
     host = s.fetch(p)
-resync_s = time.perf_counter() - t0
+schemes["central_resync"] = {
+    "turns": turns, "turns_per_sec": round(turns / (time.perf_counter() - t0), 1)
+}
+
+run("ring_per_dispatch", 1, turns)
+blocks = max(1, turns // 32)
+run("ring_fused", 31, blocks)   # every-turn exchange, fused dispatches
+run("ring_deep", 32, blocks)    # one exchange per 32 turns, same dispatches
 
 print(json.dumps({
     "board": f"{side}x{side}",
-    "turns": turns,
-    "halo_ring_turns_per_sec": round(turns / halo_s, 1),
-    "central_resync_turns_per_sec": round(turns / resync_s, 1),
-    "halo_speedup": round(resync_s / halo_s, 2),
+    "schemes": schemes,
+    "ratios": {
+        "ring_vs_resync": round(
+            schemes["ring_per_dispatch"]["turns_per_sec"]
+            / schemes["central_resync"]["turns_per_sec"], 2
+        ),
+        "deep_vs_fused": round(
+            schemes["ring_deep"]["turns_per_sec"]
+            / schemes["ring_fused"]["turns_per_sec"], 2
+        ),
+    },
 }))
 """
 
 
 def main() -> None:
     side = int(sys.argv[1]) if len(sys.argv) > 1 else 512
-    turns = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    turns = int(sys.argv[2]) if len(sys.argv) > 2 else 192
     env = {**os.environ}
     env.pop("JAX_PLATFORMS", None)
     proc = subprocess.run(
