@@ -326,7 +326,8 @@ def main() -> None:
     for side, turns in ((512, 1_000_000), (1024, 400_000),
                         (2048, 150_000), (4096, 100_000),
                         (5120, 60_000),   # the ref's stress-image size
-                        (8192, 25_000)):  # (README.md:209-211)
+                        (8192, 25_000),   # (README.md:209-211)
+                        (16384, 8_000)):  # 268M cells: strip-tiled scale
         try:
             detail["device_rates"][f"{side}x{side}"] = measure_device_rate(
                 side, turns, latency
