@@ -220,33 +220,17 @@ def measure_first_report() -> float:
     """Cold-start liveness at the reference cadence: seconds from engine
     construction to the first AliveCellsCount, in a FRESH process on
     this platform (so the 20-40s first compile is in the way, as in
-    real life). Reference watchdog: < 5s (ref: count_test.go:30-38)."""
+    real life). Reference watchdog: < 5s (ref: count_test.go:30-38).
+    The probe body is shared with tests/test_cadence.py
+    (scripts/first_report_probe.py)."""
     img_dir = _golden(f"images/{W}x{H}.pgm").parent
-    script = (
-        "import sys, time, queue\n"
-        "from gol_tpu.engine.distributor import Engine\n"
-        "from gol_tpu.events import AliveCellsCount\n"
-        "from gol_tpu.params import Params\n"
-        "p = Params(turns=10**8, threads=1, image_width=%d, image_height=%d,\n"
-        "           chunk=25_000, tick_seconds=2.0, image_dir=%r, out_dir='out')\n"
-        "t0 = time.perf_counter()\n"
-        "e = Engine(p, emit_flips=False)\n"
-        "e.start()\n"
-        "while True:\n"
-        "    ev = e.events.get(timeout=120)\n"
-        "    assert ev is not None\n"
-        "    if isinstance(ev, AliveCellsCount):\n"
-        "        print('FIRST_REPORT_S', time.perf_counter() - t0, flush=True)\n"
-        "        break\n"
-        "e.stop()\n"
-        "e.join(timeout=300)\n" % (W, H, str(img_dir))
-    )
     # Append to PYTHONPATH — replacing it would drop the site dir that
     # registers this environment's TPU plugin.
     pp = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
     env = {**os.environ, "PYTHONPATH": pp.rstrip(os.pathsep)}
     proc = subprocess.run(
-        [sys.executable, "-c", script],
+        [sys.executable, str(REPO / "scripts" / "first_report_probe.py"),
+         str(img_dir)],
         env=env, capture_output=True, text=True, timeout=600, cwd="/tmp",
     )
     if proc.returncode != 0:

@@ -90,6 +90,7 @@ class EventQueue:
     def __init__(self):
         self._q: queue.Queue = queue.Queue()
         self._closed = threading.Event()
+        self._consumed = 0
 
     def put(self, ev: Event) -> None:
         self._q.put(ev)
@@ -101,6 +102,13 @@ class EventQueue:
         can never wedge shutdown, and the engine throttles itself on
         this instead — see Engine._throttle_events)."""
         return self._q.qsize()
+
+    @property
+    def consumed(self) -> int:
+        """Monotone count of events handed to consumers — lets the
+        producer tell a *lagging* consumer (worth waiting for) from a
+        run with no consumer at all (must not be waited on)."""
+        return self._consumed
 
     def close(self) -> None:
         self._closed.set()
@@ -116,6 +124,7 @@ class EventQueue:
         if item is _CLOSE:
             self._q.put(_CLOSE)  # keep the sentinel for other consumers
             return None
+        self._consumed += 1
         return item
 
     def __iter__(self) -> Iterator[Event]:
@@ -124,6 +133,7 @@ class EventQueue:
             if item is _CLOSE:
                 self._q.put(_CLOSE)
                 return
+            self._consumed += 1
             yield item
 
 
@@ -203,6 +213,7 @@ class Engine:
         #: The dispatch chunk actually in use (auto-calibration updates
         #: it when Params.chunk == 0).
         self.effective_chunk = max(params.chunk, 1) if params.chunk else 64
+        self._throttle_disabled = False
 
     # --- public api ---
 
@@ -368,10 +379,12 @@ class Engine:
                             int(self._committed[2])  # compile+1st chunk done
                             cal = {"phase": "measure", "since": turn,
                                    "t0": time.monotonic(),
-                                   "deadline": time.monotonic() + 0.3}
+                                   "deadline": time.monotonic() + 0.3,
+                                   "retries": cal.get("retries", 0)}
                     elif time.monotonic() >= cal["deadline"]:
                         int(self._committed[2])  # drain the queued chain
                         elapsed = time.monotonic() - cal["t0"]
+                        retries = cal.get("retries", 0)
                         if elapsed > 1.5:
                             # Disturbed window (pause, verbs, host stall):
                             # that rate is not the engine's — re-measure
@@ -385,6 +398,16 @@ class Engine:
                                 chunk = new_chunk
                                 self.effective_chunk = chunk
                                 cal = {"phase": "warm", "since": turn}
+                            elif chunk == 64 and retries < 3:
+                                # Converging at the warm-up size usually
+                                # means a polluted first window (sub-1.5s
+                                # stall, brief attach) — a 10^10-turn run
+                                # must not be locked to ~1% of kernel
+                                # rate by it. Re-measure a few times; a
+                                # genuinely slow platform converges after
+                                # the retries.
+                                cal = {"phase": "warm", "since": turn,
+                                       "retries": retries + 1}
                             else:
                                 cal = None  # converged
                 # Snapshot the consumer state for THIS dispatch: an
@@ -394,6 +417,14 @@ class Engine:
                 # full-chunk burst of pre-sync events it would discard.
                 emit_now = self.emit_turns
                 k = min(chunk, 1024 if emit_now else chunk, p.turns - turn)
+                if p.autosave_turns > 0:
+                    # Honor the checkpoint cadence exactly: a dispatch
+                    # never overshoots the next autosave boundary, so a
+                    # kill loses at most one cadence interval even with
+                    # a user-set chunk far larger than the cadence.
+                    k = max(1, min(
+                        k, self._autosave_turn + p.autosave_turns - turn
+                    ))
                 tick = time.perf_counter() if self.timeline else 0.0
                 world, count = self.stepper.step_n(world, k)
                 if self.timeline:
@@ -544,7 +575,18 @@ class Engine:
         (ref: main.go:53); here the wait loop stays interruptible —
         stop/'q'/'k' and count requests are still serviced — so a
         vanished consumer can never wedge shutdown the way a hard
-        blocking put would."""
+        blocking put would.
+
+        A backlog with NO consumption progress is a run whose queue
+        nobody drains (library callers may drop the queue entirely) —
+        waiting on it would hang a run that used to complete, so after
+        5s without a single get() the throttle disarms for the rest of
+        the run and the queue just grows, the pre-backpressure
+        behavior."""
+        if self._throttle_disabled:
+            return
+        stalled_since = None
+        last_consumed = self.events.consumed
         while (
             self.events.qsize() > 10_000
             and self._stop_reason is None
@@ -553,6 +595,15 @@ class Engine:
             self._service_requests()
             self._poll_keys(self._committed[0])
             time.sleep(0.005)
+            consumed = self.events.consumed
+            if consumed != last_consumed:
+                last_consumed = consumed
+                stalled_since = None
+            elif stalled_since is None:
+                stalled_since = time.monotonic()
+            elif time.monotonic() - stalled_since > 5.0:
+                self._throttle_disabled = True
+                return
 
     def _maybe_autosave(self, turn: int, world) -> None:
         """Periodic auto-checkpoint between dispatches. Snapshot cadence

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Cold-start liveness probe: seconds from engine construction to the
+first AliveCellsCount, in THIS (fresh) process — so first compiles are
+in the way, as in real life. The reference's watchdog demands < 5s at
+the 2s ticker cadence (ref: count_test.go:30-38).
+
+Shared by `bench.py` (runs it on the default platform — the TPU — and
+records `first_alive_report_s`) and `tests/test_cadence.py` (runs it on
+cpu and asserts the 5s bound). Run via a fresh interpreter with the
+repo on PYTHONPATH:
+
+    python scripts/first_report_probe.py IMAGES_DIR [PLATFORM]
+
+Prints one line: `FIRST_REPORT_S <seconds>`.
+"""
+
+import sys
+import time
+
+
+def main() -> None:
+    images = sys.argv[1]
+    platform = sys.argv[2] if len(sys.argv) > 2 else ""
+    if platform:
+        import jax
+
+        # Site configs may pin the platform; config.update wins where
+        # the JAX_PLATFORMS env var is ignored.
+        jax.config.update("jax_platforms", platform)
+
+    from gol_tpu.engine.distributor import Engine
+    from gol_tpu.events import AliveCellsCount
+    from gol_tpu.params import Params
+
+    p = Params(
+        turns=10**8, threads=1, image_width=512, image_height=512,
+        chunk=25_000, tick_seconds=2.0, image_dir=images, out_dir="out",
+    )
+    t0 = time.perf_counter()
+    engine = Engine(p, emit_flips=False)
+    engine.start()
+    while True:
+        ev = engine.events.get(timeout=120)
+        assert ev is not None, "stream closed before any alive report"
+        if isinstance(ev, AliveCellsCount):
+            print(f"FIRST_REPORT_S {time.perf_counter() - t0:.3f}", flush=True)
+            break
+    engine.stop()
+    engine.join(timeout=300)
+
+
+if __name__ == "__main__":
+    main()

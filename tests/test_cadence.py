@@ -4,11 +4,12 @@ seconds of a cold engine start with the ticker at its default 2s
 (ref: count_test.go:30-38 watchdog; ticker cadence
 ref: gol/distributor.go:285).
 
-Run in a fresh subprocess so nothing is pre-compiled: the first fused
-dispatch (compile + 25k turns) far exceeds the watchdog, and the report
-must still arrive on time — the ticker falls back to the last committed
+Runs the shared probe (scripts/first_report_probe.py) in a fresh
+subprocess so nothing is pre-compiled: the first fused dispatch
+(compile + 25k turns) far exceeds the watchdog, and the report must
+still arrive on time — the ticker falls back to the last committed
 consistent (turn, count) pair instead of blocking behind the dispatch
-(engine/distributor.py _ticker). `bench.py` measures the same number on
+(engine/distributor.py _ticker). `bench.py` measures the same probe on
 the real TPU (BENCH_DETAIL "first_alive_report_s"), where the cold
 compile is 20-40s.
 """
@@ -20,36 +21,6 @@ import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
-SCRIPT = r"""
-import sys, time
-import jax
-jax.config.update("jax_platforms", "cpu")
-
-import queue
-from gol_tpu.engine.distributor import Engine
-from gol_tpu.events import AliveCellsCount
-from gol_tpu.params import Params
-
-images = sys.argv[1]
-
-p = Params(
-    turns=100_000_000, threads=1, image_width=512, image_height=512,
-    chunk=25_000, tick_seconds=2.0, image_dir=images, out_dir="out",
-)
-t0 = time.perf_counter()
-engine = Engine(p, emit_flips=False)
-engine.start()
-while True:
-    ev = engine.events.get(timeout=30)
-    assert ev is not None, "stream closed before any alive report"
-    if isinstance(ev, AliveCellsCount):
-        elapsed = time.perf_counter() - t0
-        print(f"FIRST_REPORT_S {elapsed:.3f}", flush=True)
-        break
-engine.stop()
-engine.join(timeout=120)
-"""
-
 
 def test_first_alive_report_within_5s_cold(golden_root, tmp_path):
     env = {
@@ -59,7 +30,8 @@ def test_first_alive_report_within_5s_cold(golden_root, tmp_path):
         "PYTHONPATH": str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", ""),
     }
     proc = subprocess.run(
-        [sys.executable, "-c", SCRIPT, str(golden_root / "images")],
+        [sys.executable, str(REPO / "scripts" / "first_report_probe.py"),
+         str(golden_root / "images"), "cpu"],
         env=env, cwd=str(tmp_path),
         capture_output=True, text=True, timeout=300,
     )

@@ -179,13 +179,15 @@ def test_two_process_cli_autosave_and_resume(golden_root, tmp_path):
     out_dir = tmp_path / "out"
     out_dir.mkdir()
 
-    # Phase 1: run to turn 64 with a 30-turn autosave cadence. Final
-    # board at 64 plus the mid-run checkpoint at turn 32 (first dispatch
-    # boundary past the 30-turn cadence at chunk 16) must exist.
+    # Phase 1: run to turn 64 with a 30-turn autosave cadence. The
+    # engine caps dispatches at cadence boundaries (bounded-loss
+    # guarantee), so checkpoints land exactly at turns 30 and 60, plus
+    # the final board at 64.
     _run_cli_pair(golden_root, tmp_path, out_dir,
                   ["-turns", "64", "--autosave-turns", "30"])
     assert (out_dir / "64x64x64.pgm").exists()
-    assert (out_dir / "64x64x32.pgm").exists()
+    assert (out_dir / "64x64x30.pgm").exists()
+    assert (out_dir / "64x64x60.pgm").exists()
 
     # Phase 2: fresh two-process job resumes from the latest snapshot
     # (turn 64) and continues to 100.
