@@ -336,3 +336,37 @@ def test_auto_chunk_calibrates_up(golden_root, tmp_path):
         engine.stop()
         engine.join(timeout=60)
     assert engine.error is None
+
+
+def test_auto_chunk_survives_pause_during_calibration(golden_root, tmp_path):
+    """A pause landing inside the calibration window must not lock the
+    warm-up chunk permanently (the disturbed-window guard + no-growth
+    retries): after resume the calibrator still locks a chunk above 64."""
+    keys: queue.Queue = queue.Queue()
+    p = make_params(golden_root, tmp_path, turns=10_000_000, threads=1,
+                    image_width=64, image_height=64, chunk=0,
+                    tick_seconds=60.0)
+    engine = Engine(p, keypresses=keys, emit_flips=False)
+    engine.start()
+    deadline = time.monotonic() + 60
+    try:
+        # Wait until dispatches are flowing (calibration in flight, past
+        # the warm-up trigger) so the pause genuinely lands inside a
+        # calibration window — a pause queued before start() would be
+        # consumed before calibration even begins.
+        while time.monotonic() < deadline and engine.completed_turns == 0:
+            time.sleep(0.01)
+        assert engine.completed_turns > 0
+        keys.put("p")
+        time.sleep(0.7)  # hold the pause across the 0.3s measure window
+        keys.put("p")    # resume
+        while time.monotonic() < deadline:
+            if engine.effective_chunk > 64:
+                break
+            time.sleep(0.1)
+        assert engine.effective_chunk > 64, (
+            "calibration stuck at warm-up chunk after a paused window")
+    finally:
+        engine.stop()
+        engine.join(timeout=60)
+    assert engine.error is None
