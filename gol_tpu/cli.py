@@ -261,7 +261,13 @@ def main(argv: Optional[list[str]] = None) -> int:
             return 1
         return 0
     finally:
-        multihost.notify_stop()
+        # On an exception path, skip releasing the workers: errors from
+        # config-identical code (e.g. stepper validation) raised on them
+        # too, and broadcasting to dead peers blocks forever — hiding
+        # the coordinator's own traceback. The distributed runtime tears
+        # down workers of an exited coordinator instead.
+        if sys.exc_info()[0] is None:
+            multihost.notify_stop()
         stop_keys.set()
         if saved_termios is not None:
             import termios

@@ -143,7 +143,10 @@ def _bcast(value: np.ndarray) -> np.ndarray:
 
 
 def _bcast_cmd(op: int, arg: int = 0) -> tuple[int, int]:
-    got = _bcast(np.asarray([op, arg], np.int32))
+    # int64: `arg` carries fused chunk sizes, and an int32 would wrap a
+    # user --chunk >= 2^31 into a different k on the workers than the
+    # coordinator runs — a silent ring deadlock.
+    got = _bcast(np.asarray([op, arg], np.int64))
     return int(got[0]), int(got[1])
 
 
@@ -168,25 +171,30 @@ def verify_job_config(*fields) -> None:
     """Fail fast when the processes of a multi-host job were launched
     with different run parameters: a mismatch would otherwise build
     divergent SPMD programs whose first collective deadlocks with no
-    diagnostic. The coordinator broadcasts its config; every process
-    asserts equality."""
+    diagnostic. Every process allgathers every config and every process
+    compares ALL of them — a one-way broadcast would let the
+    coordinator (whose config trivially equals its own broadcast) sail
+    past the check and hang at its first real collective while the
+    mismatched worker dies."""
     if jax.process_count() == 1:
         return
+    from jax.experimental import multihost_utils
+
     mine = ",".join(str(f) for f in fields).encode()
     buf = np.zeros(256, np.uint8)
     buf[: len(mine)] = np.frombuffer(mine, np.uint8)
-    got = _bcast(buf)
-    theirs = bytes(got[got != 0]).decode()
-    if theirs != mine.decode():
+    all_cfgs = np.asarray(
+        multihost_utils.process_allgather(buf)
+    ).reshape(jax.process_count(), -1)
+    configs = [bytes(row[row != 0]).decode() for row in all_cfgs]
+    if len(set(configs)) > 1:
         raise ValueError(
-            f"multi-host config mismatch: coordinator has [{theirs}], "
-            f"process {jax.process_index()} has [{mine.decode()}] — all "
-            "processes must be launched with identical -w/-h/-t/--rule/"
-            "--backend"
+            f"multi-host config mismatch: {configs} — all processes "
+            "must be launched with identical -w/-h/-t/--rule/--backend"
         )
 
 
-def spmd_stepper(inner, height: int, width: int):
+def spmd_stepper(inner):
     """Coordinator-side wrapper: a Stepper whose every dispatch first
     broadcasts (opcode, arg) so workers running `spmd_worker_loop` on
     the same inner stepper co-execute it in lockstep.
@@ -266,6 +274,12 @@ def spmd_worker_loop(inner, height: int, width: int) -> None:
 
 
 def notify_stop() -> None:
-    """Coordinator-side: release workers from `spmd_worker_loop`."""
+    """Coordinator-side: release workers from `spmd_worker_loop`.
+
+    Callers must skip this on an exception path whose error also raised
+    on the workers (identical configs fail identically): broadcasting
+    to dead peers blocks forever, hiding the diagnostic. Workers of an
+    exited coordinator are torn down by the distributed runtime
+    instead."""
     if jax.process_count() > 1 and is_coordinator():
         _bcast_cmd(_OP_STOP)

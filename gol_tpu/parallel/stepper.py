@@ -254,7 +254,7 @@ def make_stepper(
             # be mirrored on every worker (SPMD contract). Workers get
             # the inner stepper and replay via spmd_worker_loop.
             if multihost.is_coordinator():
-                return multihost.spmd_stepper(s, height, width)
+                return multihost.spmd_stepper(s)
         return s
 
     from gol_tpu.ops.bitlife import packable

@@ -196,3 +196,44 @@ def test_two_process_cli_autosave_and_resume(golden_root, tmp_path):
     got = (out_dir / "64x64x100.pgm").read_bytes()
     want = (golden_root / "check" / "images" / "64x64x100.pgm").read_bytes()
     assert got == want
+
+
+def test_two_process_config_mismatch_fails_fast_everywhere(golden_root, tmp_path):
+    """Processes launched with different board sizes must BOTH exit with
+    a diagnostic — the coordinator included. (A one-way broadcast check
+    let the coordinator sail past and hang at its first collective; and
+    a config-identical validation error must not hang the coordinator's
+    teardown broadcasting to an already-dead worker.)"""
+    env = {
+        "PYTHONPATH": str(REPO),
+        "PATH": "/usr/bin:/bin",
+        "HOME": "/tmp",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "gol_tpu",
+             "-w", str(width), "-h", "64", "-t", "8", "-noVis",
+             "-turns", "10", "--platform", "cpu",
+             "--images", str(golden_root / "images"),
+             "--out", str(tmp_path),
+             "--mh-coordinator", f"localhost:{port}",
+             "--mh-procs", "2", "--mh-id", str(pid)],
+            env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid, width in ((0, 64), (1, 128))
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("mismatched job hung instead of failing fast")
+        outs.append(out)
+    assert procs[0].returncode != 0, outs[0][-2000:]
+    assert procs[1].returncode != 0, outs[1][-2000:]
+    assert "config mismatch" in outs[0] + outs[1]
