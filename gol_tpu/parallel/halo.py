@@ -136,6 +136,40 @@ def halo_step_bits_uneven(
     return jnp.where(row_ids < real, new, jnp.zeros_like(new))
 
 
+def _ring_stepper(name: str, devices: list, step_n, put, fetch):
+    """Common wiring of both dense ring builders: single-turn wrappers
+    derived from `step_n`, the async count, CPU-mesh serialization, and
+    the Stepper assembly — one definition, so the even (deep-halo) and
+    uneven (balanced-split) variants cannot drift apart here."""
+    from gol_tpu.parallel.stepper import Stepper
+
+    @jax.jit
+    def step(world):
+        return step_n(world, 1)[0]
+
+    @jax.jit
+    def step_with_diff(world):
+        new, count = step_n(world, 1)
+        return new, world != new, count
+
+    @jax.jit
+    def count(world):
+        return jnp.sum(world != 0, dtype=jnp.int32)
+
+    _sync = cpu_serializing_sync(devices)
+
+    return Stepper(
+        name=name,
+        shards=len(devices),
+        put=put,
+        fetch=fetch,
+        step=lambda w: _sync(step(w)),
+        step_n=lambda w, k: _sync(step_n(w, int(k))),
+        step_with_diff=lambda w: _sync(step_with_diff(w)),
+        alive_count_async=lambda w: _sync(count(w)),
+    )
+
+
 def sharded_stepper(rule: Rule, devices: list, height: int):
     """Build a Stepper whose world lives row-sharded across `devices`.
 
@@ -146,22 +180,12 @@ def sharded_stepper(rule: Rule, devices: list, height: int):
     the ring program stays SPMD and every device works, the analog of
     the reference's row-farm accepting any worker count
     (ref: gol/distributor.go:124-155)."""
-    from gol_tpu.parallel.stepper import Stepper
-
     n = len(devices)
     if height % n != 0:
         return _sharded_stepper_uneven(rule, devices, height)
     mesh = Mesh(np.asarray(devices), (AXIS,))
     sharding = NamedSharding(mesh, P(AXIS, None))
     spec = P(AXIS, None)
-
-    @jax.jit
-    def step(world):
-        @functools.partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
-        def _one(block):
-            return from_bits(halo_step_bits(to_bits(block), rule))
-
-        return _one(world)
 
     deep = min(DEEP_ROWS, height // n)
 
@@ -195,27 +219,12 @@ def sharded_stepper(rule: Rule, devices: list, height: int):
 
         return _many(world)
 
-    @jax.jit
-    def step_with_diff(world):
-        new, count = step_n(world, 1)
-        return new, world != new, count
-
-    @jax.jit
-    def count(world):
-        return jnp.sum(world != 0, dtype=jnp.int32)
-
-    _sync = cpu_serializing_sync(devices)
     from gol_tpu.parallel.multihost import spmd_fetch, spmd_put
 
-    return Stepper(
-        name=f"halo-ring-{n}",
-        shards=n,
+    return _ring_stepper(
+        f"halo-ring-{n}", devices, step_n,
         put=lambda w: spmd_put(sharding, np.asarray(w, np.uint8)),
         fetch=spmd_fetch,
-        step=lambda w: _sync(step(w)),
-        step_n=lambda w, k: _sync(step_n(w, int(k))),
-        step_with_diff=lambda w: _sync(step_with_diff(w)),
-        alive_count_async=lambda w: _sync(count(w)),
     )
 
 
@@ -225,8 +234,6 @@ def _sharded_stepper_uneven(rule: Rule, devices: list, height: int):
     top of its strip (balanced split: shard i owns ceil rows if
     i < H mod n, else floor). `put`/`fetch` scatter/gather the real
     rows, so callers never see the padding."""
-    from gol_tpu.parallel.stepper import Stepper
-
     n = len(devices)
     strip = -(-height // n)  # ceil
     rem = height % n
@@ -255,19 +262,6 @@ def _sharded_stepper_uneven(rule: Rule, devices: list, height: int):
 
         return _many(world)
 
-    @jax.jit
-    def step(world):
-        return step_n(world, 1)[0]
-
-    @jax.jit
-    def step_with_diff(world):
-        new, count = step_n(world, 1)
-        return new, world != new, count
-
-    @jax.jit
-    def count(world):
-        return jnp.sum(world != 0, dtype=jnp.int32)
-
     from gol_tpu.parallel.multihost import spmd_fetch, spmd_put
 
     def put(w):
@@ -285,15 +279,4 @@ def _sharded_stepper_uneven(rule: Rule, devices: list, height: int):
             [host[i * strip : i * strip + real[i]] for i in range(n)]
         )
 
-    _sync = cpu_serializing_sync(devices)
-
-    return Stepper(
-        name=f"halo-ring-uneven-{n}",
-        shards=n,
-        put=put,
-        fetch=fetch,
-        step=lambda w: _sync(step(w)),
-        step_n=lambda w, k: _sync(step_n(w, int(k))),
-        step_with_diff=lambda w: _sync(step_with_diff(w)),
-        alive_count_async=lambda w: _sync(count(w)),
-    )
+    return _ring_stepper(f"halo-ring-uneven-{n}", devices, step_n, put, fetch)
