@@ -30,6 +30,7 @@ import itertools
 import logging
 import queue
 import socket
+import struct
 import threading
 from typing import Optional
 
@@ -52,6 +53,17 @@ class _Conn:
 
     def __init__(self, sock: socket.socket, want_flips: bool):
         self.sock = sock
+        # Send-side timeout only (SO_SNDTIMEO, not settimeout: the read
+        # side must keep blocking forever — controllers send verbs
+        # rarely). A stalled-but-open controller (SIGSTOP, dead network
+        # path) fills its TCP window and would otherwise block the
+        # broadcaster's sendall forever, wedging the whole event path;
+        # after 30s of no progress the send raises and the controller
+        # is detached like any dead peer.
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+            struct.pack("ll", 30, 0),
+        )
         self.want_flips = want_flips
         #: Matches this connection to the BoardSync it requested.
         self.token = _Conn._next_token()
@@ -162,6 +174,17 @@ class EngineServer:
                 sock.close()
                 continue
 
+            # Immediate ack: the controller's handshake timeout covers
+            # the first reply, and the BoardSync only arrives once the
+            # engine services the attach between dispatches — on a cold
+            # TPU that can be a 40s compile away. The ack lands within
+            # ms so attaches never time out behind a dispatch (clients
+            # ignore unknown message kinds, so old ones are unaffected).
+            try:
+                conn.send({"t": "attach-ack"})
+            except (wire.WireError, OSError):
+                self._detach(conn)
+                continue
             self._attach(conn)
             threading.Thread(
                 target=self._reader_loop, args=(conn,),

@@ -119,7 +119,10 @@ class EventQueue:
         return self._closed.is_set()
 
     def get(self, timeout: Optional[float] = None):
-        """Next event, or None once closed and drained."""
+        """Next event; None once the queue is closed and drained.
+        A `timeout` with no event raises `queue.Empty` (timeout is
+        distinguishable from closure on purpose — None always means
+        the stream ended)."""
         item = self._q.get(timeout=timeout)
         if item is _CLOSE:
             self._q.put(_CLOSE)  # keep the sentinel for other consumers
@@ -173,8 +176,9 @@ class Engine:
         if start_turn < 0 or start_turn > params.turns:
             raise ValueError("start_turn must be in [0, turns]")
         self.start_turn = start_turn
-        self.io = io_service or IOService(params.image_dir, params.out_dir)
-        self._own_io = io_service is None
+        # Stepper before IOService: make_stepper validates (and can
+        # raise on) the backend/grid combination, and the IO service
+        # spawns a live thread that a failed construction would leak.
         self.stepper = stepper or make_stepper(
             threads=params.threads,
             height=params.image_height,
@@ -182,6 +186,8 @@ class Engine:
             rule=params.rule,
             backend=params.backend,
         )
+        self.io = io_service or IOService(params.image_dir, params.out_dir)
+        self._own_io = io_service is None
         # Atomically published (completed_turns, device_world, device_count);
         # the mutex-free replacement for ref: gol/distributor.go:34-36.
         # ONLY the engine thread dispatches device work or realises device

@@ -3,12 +3,16 @@
 
 Each device owns a strip of H/n rows stored packed (strip_rows/32 word
 rows x W columns of uint32). Per turn each shard ppermutes its edge
-*word rows* to its ring neighbours — the neighbour only needs 1 bit of
-each 32-bit word (the boundary row), extracted after the exchange — then
-steps with the same carry-save adder as the single-chip packed path,
-with the cross-word vertical carries sourced from the halo words at the
-strip edges. Two one-word-row transfers per shard per turn over ICI,
-exactly like the dense halo path, on 32x less resident data.
+*word rows* to its ring neighbours, then steps with the same carry-save
+adder as the single-chip packed path, with the cross-word vertical
+carries sourced from the halo words at the strip edges. The per-turn
+message is a whole 32-row word-row (4W bytes) even though the
+single-turn step only consumes its boundary bit — deliberately: the
+word-row is exactly the ghost the 32-turn deep blocks below consume in
+full, one uint32 lane array needs no repacking on either side, and at
+these sizes ring transfers are latency-bound, not byte-bound (a 512-
+wide edge is 2 KB). Per-turn mode costs 4x the dense path's bytes; the
+deep path repays it 32x over.
 
 The torus closes because the ring does: shard 0's upper neighbour is
 shard n-1 (ref spec: README.md:239-245 — the halo-exchange extension the

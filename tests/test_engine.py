@@ -4,6 +4,7 @@ pgm_test.go, sdl_test.go, count_test.go). All runs go through the public
 `gol_tpu.run` surface with golden fixtures as ground truth."""
 
 import csv
+import threading
 import time
 import queue
 
@@ -370,3 +371,15 @@ def test_auto_chunk_survives_pause_during_calibration(golden_root, tmp_path):
         engine.stop()
         engine.join(timeout=60)
     assert engine.error is None
+
+
+def test_failed_engine_construction_leaks_no_io_thread(golden_root, tmp_path):
+    """A backend/grid validation error in Engine.__init__ must not
+    leave a live IOService thread behind (stepper validation runs
+    before the IO service spawns)."""
+    before = threading.active_count()
+    with pytest.raises(ValueError, match="not packable"):
+        Engine(make_params(golden_root, tmp_path, turns=1,
+                           image_width=100, image_height=100,
+                           backend="packed"))
+    assert threading.active_count() == before
