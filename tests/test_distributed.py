@@ -276,3 +276,39 @@ def test_controller_crash_is_survived(golden_root, tmp_path):
     assert server.wait(120)
     assert server.engine.completed_turns == 200
     assert server.engine.error is None
+
+
+def test_attach_during_long_dispatch_is_acked_immediately(golden_root, tmp_path):
+    """A controller attaching while the engine is stuck inside a long
+    dispatch (the cold-TPU first compile in real life) must complete its
+    handshake instantly via the server's attach-ack — the BoardSync
+    follows whenever the engine next services requests."""
+    import dataclasses as dc
+
+    from gol_tpu.parallel.stepper import make_stepper
+
+    real = make_stepper(threads=1, height=16, width=16)
+    stall = threading.Event()
+
+    def slow_step_n(p, k):
+        stall.set()
+        time.sleep(4.0)  # stand-in for a 40s cold compile
+        return real.step_n(p, k)
+
+    server = make_server(
+        golden_root, tmp_path, turns=1000, threads=1,
+        image_width=16, image_height=16, chunk=500,
+    )
+    server.engine.stepper = dc.replace(real, step_n=slow_step_n)
+    server.start()
+    try:
+        assert stall.wait(60), "engine never dispatched"
+        t0 = time.monotonic()
+        # Well under the 4s stall: only the ack can satisfy this.
+        ctl = Controller(*server.address, want_flips=False, timeout=2.0)
+        assert time.monotonic() - t0 < 2.0
+        assert ctl.wait_sync(60), "board sync never arrived after the stall"
+        assert ctl.board is not None and ctl.board.shape == (16, 16)
+        ctl.close()
+    finally:
+        server.shutdown()
