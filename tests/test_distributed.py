@@ -285,9 +285,11 @@ def test_attach_during_long_dispatch_is_acked_immediately(golden_root, tmp_path)
     follows whenever the engine next services requests."""
     import dataclasses as dc
 
-    from gol_tpu.parallel.stepper import make_stepper
-
-    real = make_stepper(threads=1, height=16, width=16)
+    server = make_server(
+        golden_root, tmp_path, turns=1000, threads=1,
+        image_width=16, image_height=16, chunk=500,
+    )
+    real = server.engine.stepper  # wrap the engine's own stepper
     stall = threading.Event()
 
     def slow_step_n(p, k):
@@ -295,10 +297,6 @@ def test_attach_during_long_dispatch_is_acked_immediately(golden_root, tmp_path)
         time.sleep(4.0)  # stand-in for a 40s cold compile
         return real.step_n(p, k)
 
-    server = make_server(
-        golden_root, tmp_path, turns=1000, threads=1,
-        image_width=16, image_height=16, chunk=500,
-    )
     server.engine.stepper = dc.replace(real, step_n=slow_step_n)
     server.start()
     try:
