@@ -5,16 +5,18 @@ The dense path (`ops/life.py`) spends one vector lane per cell. Packing
 into pure bitwise arithmetic on a 32x-smaller array: the 8 neighbour
 bitboards come from word shifts (vertical, with cross-word carries) and
 lane rolls (horizontal), and the neighbour count is computed in bit
-slices with a carry-save adder tree — ~50 bitwise ops per turn for the
+slices with a carry-save adder tree — ~35 bitwise ops per turn for the
 whole board instead of ~15 vector ops per *cell-lane*.
 
 Layout: `packed[r, x]` holds rows `32r .. 32r+31` of column `x`; bit `i`
 (LSB first) is row `32r + i`. Toroidal wrap in both axes falls out of
 `jnp.roll` on the word rows plus the cross-word carry bits.
 
-Rule-generic: the 4 count bits (0..8 needs 4) feed a minterm mask built
-from the static birth/survive sets — any B/S rule compiles to a handful
-of ANDs/ORs (B3/S23 is the reference rule, ref: gol/distributor.go:325-342).
+Rule-generic: the 4 count bits (0..8 needs 4) feed masks compiled at
+trace time by `ops/rulecomp.py` (Quine-McCluskey with counts 9..15 as
+don't-cares, shared products, subset-factored combine) — any B/S rule
+becomes a near-minimal fused bitwise expression (B3/S23 is the
+reference rule, ref: gol/distributor.go:325-342).
 
 Bit-exactness vs the dense path is asserted in tests; the automaton is
 integer-deterministic so equality is exact, not approximate.
@@ -29,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from gol_tpu.models.rules import LIFE, Rule
+from gol_tpu.ops import rulecomp
 from gol_tpu.ops.life import from_bits, to_bits
 
 WORD = 32
@@ -92,17 +95,50 @@ def _shift_down(p: jax.Array) -> jax.Array:
     return (p >> jnp.uint32(1)) | carry
 
 
-def _rule_mask(count_bits, ns) -> jax.Array:
-    """OR of 4-variable minterms for each count in the static set."""
-    b0, b1, b2, b3 = count_bits
-    full = ~jnp.uint32(0)
-    mask = jnp.zeros_like(b0)
-    for k in sorted(ns):
-        term = full
-        for bit, var in zip((b0, b1, b2, b3), (1, 2, 4, 8)):
-            term = term & (bit if k & var else ~bit)
-        mask = mask | term
-    return mask
+#: Sentinel for an all-ones mask (a cover containing the care-nothing
+#: implicant); compared with `is` — jax arrays overload `==`.
+_ONE = object()
+
+
+def _apply_plan(p: jax.Array, plan: rulecomp.RulePlan, bits: dict) -> jax.Array:
+    """Final combine of the minimized survive/birth masks with the
+    current board, in the cheapest form the plan classified (see
+    rulecomp.compile_rule). None means an identically-zero mask."""
+    cache: dict = {}
+
+    def mask(cover):
+        if rulecomp.is_full(cover):
+            return _ONE
+        return rulecomp.emit_mask(cover, bits, cache)
+
+    def AND(x, m):
+        if m is None:
+            return None
+        if m is _ONE:
+            return x
+        return x & m
+
+    def OR(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if a is _ONE or b is _ONE:
+            return _ONE
+        return a | b
+
+    survive, birth = mask(plan.survive), mask(plan.birth)
+    if plan.combine == "b_subset":
+        out = OR(birth, AND(p, survive))
+    elif plan.combine == "s_subset":
+        out = OR(survive, AND(~p, birth))
+    else:
+        out = OR(AND(p, survive), AND(~p, birth))
+    if out is None:
+        return p ^ p
+    if out is _ONE:
+        return ~(p ^ p)
+    return out
 
 
 def combine_packed(p: jax.Array, up: jax.Array, down: jax.Array,
@@ -117,32 +153,42 @@ def combine_packed(p: jax.Array, up: jax.Array, down: jax.Array,
     (right column sum) + (up + down), where each column sum is the 2-bit
     CSA of a vertical triple — 4 lane rolls (of the two column-sum bit
     slices) instead of 6 (of p/up/down), and a 3x2-bit adder instead of
-    an 8x1-bit one."""
+    an 8x1-bit one.
+
+    The rule itself is compiled by `ops/rulecomp.py`: Quine-McCluskey
+    minimized masks (counts 9..15 are don't-cares), shared products, the
+    subset-factored final combine, and count bit-slices materialized
+    only if some implicant reads them (B3/S23 never touches bit 3)."""
     if roll is None:
         roll = jnp.roll
+    plan = rulecomp.compile_rule(rule)
+    need = plan.needed
     # Vertical triple (up + p + down) as 2 bit slices.
     upd = up ^ down
+    pc = up & down
     vs = upd ^ p
-    vc = (up & down) | (p & upd)
+    vc = pc | (p & upd)
     ls, lc = roll(vs, 1, 1), roll(vc, 1, 1)
     w = p.shape[1]
     rs, rc = roll(vs, w - 1, 1), roll(vc, w - 1, 1)
-    # count = (ls,lc) + (rs,rc) + (up+down as (upd, up&down)).
+    # count = (ls,lc) + (rs,rc) + (up+down as (upd, pc)).
     x = ls ^ rs
-    b0 = x ^ upd
-    k0 = (ls & rs) | (upd & x)          # carry out of bit 0
-    pc = up & down
+    k0 = (ls & rs) | (upd & x)           # carry out of bit 0
     y = lc ^ rc
     t1 = y ^ pc                          # sum of the bit-1 slices
     k1 = (lc & rc) | (pc & y)            # their carry into bit 2
-    b1 = t1 ^ k0
-    k2 = t1 & k0
-    b2 = k1 ^ k2
-    b3 = k1 & k2
-    counts = (b0, b1, b2, b3)
-    survive = _rule_mask(counts, rule.survive)
-    birth = _rule_mask(counts, rule.birth)
-    return (p & survive) | (~p & birth)
+    bits: dict = {}
+    if 0 in need:
+        bits[0] = x ^ upd
+    if 1 in need:
+        bits[1] = t1 ^ k0
+    if 2 in need or 3 in need:
+        k2 = t1 & k0
+        if 2 in need:
+            bits[2] = k1 ^ k2
+        if 3 in need:
+            bits[3] = k1 & k2
+    return _apply_plan(p, plan, bits)
 
 
 def step_packed(p: jax.Array, rule: Rule = LIFE) -> jax.Array:

@@ -1,0 +1,95 @@
+"""The trace-time rule compiler (`ops/rulecomp.py`).
+
+Semantic ground truth is set membership: a minimized cover must accept
+exactly the counts in the rule's set for every REACHABLE count 0..8
+(patterns 9..15 are don't-cares and may go either way). The packed
+stepper built on top is then checked bit-exactly against the dense
+XLA path across random rules — the same cross-backend contract the
+reference pins with its golden boards (ref: gol_test.go:15-47)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from gol_tpu.models.rules import RULES, Rule
+from gol_tpu.ops import bitlife, life, rulecomp
+
+
+def _random_rule(rng) -> Rule:
+    birth = frozenset(k for k in range(9) if rng.random() < 0.4)
+    survive = frozenset(k for k in range(9) if rng.random() < 0.4)
+    name = ("B" + "".join(map(str, sorted(birth))) +
+            "/S" + "".join(map(str, sorted(survive))))
+    return Rule(name=name, birth=birth, survive=survive)
+
+
+def _all_subsets_sample(n=200, seed=7):
+    rng = random.Random(seed)
+    return [_random_rule(rng) for _ in range(n)]
+
+
+def test_minimized_covers_match_membership_exhaustive():
+    """Every subset of {0..8} minimizes to a cover that agrees with
+    membership on all reachable counts (512 subsets — exhaustive)."""
+    for mask in range(1 << 9):
+        counts = frozenset(k for k in range(9) if mask & (1 << k))
+        cover = rulecomp.minimize_counts(counts)
+        for c in range(9):
+            assert rulecomp.evaluate_cover(cover, c) == (c in counts), (
+                f"counts={sorted(counts)} cover={cover} at c={c}"
+            )
+
+
+def test_life_masks_are_small_and_skip_bit3():
+    plan = rulecomp.compile_rule(RULES["B3/S23"])
+    assert plan.combine == "b_subset"  # {3} ⊆ {2,3} → B | (p & S)
+    assert 3 not in plan.needed  # b3 never materialized for Life
+    # Survive {2,3} with don't-cares collapses to the single implicant
+    # x01x (b1 & ~b2); birth {3} to x011.
+    assert plan.survive == ((0b0010, 0b0110),)
+    assert plan.birth == ((0b0011, 0b0111),)
+    assert plan.mask_cost() <= 4
+
+
+@pytest.mark.parametrize("notation", sorted(RULES))
+def test_named_rules_packed_vs_dense(notation):
+    rule = RULES[notation]
+    world = life.random_world(64, 64, density=0.35, seed=11)
+    got = np.asarray(bitlife.step_n_packed(world, 16, rule=rule))
+    want = np.asarray(life.step_n(world, 16, rule=rule))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_random_rules_packed_vs_dense():
+    """40 random rules × 6 turns — the compiled plan (minimization,
+    lazy bits, subset factoring) agrees with the dense comparison rule
+    engine bit-for-bit."""
+    world = life.random_world(64, 64, density=0.35, seed=23)
+    for rule in _all_subsets_sample(n=40, seed=13):
+        got = np.asarray(bitlife.step_n_packed(world, 6, rule=rule))
+        want = np.asarray(life.step_n(world, 6, rule=rule))
+        np.testing.assert_array_equal(got, want, err_msg=rule.name)
+
+
+def test_degenerate_rules():
+    """Empty and full rule sets exercise the zero/one mask sentinels."""
+    world = life.random_world(32, 64, density=0.4, seed=3)
+    dead = Rule(name="B/S", birth=frozenset(), survive=frozenset())
+    assert not np.asarray(bitlife.step_n_packed(world, 1, rule=dead)).any()
+    everything = Rule(name="B012345678/S012345678",
+                      birth=frozenset(range(9)), survive=frozenset(range(9)))
+    got = np.asarray(bitlife.step_n_packed(world, 1, rule=everything))
+    assert (got == life.ALIVE).all()
+    # One-sided: births everywhere, no survival — and the reverse.
+    for rule in (Rule("B012345678/S", frozenset(range(9)), frozenset()),
+                 Rule("B/S012345678", frozenset(), frozenset(range(9)))):
+        got = np.asarray(bitlife.step_n_packed(world, 3, rule=rule))
+        want = np.asarray(life.step_n(world, 3, rule=rule))
+        np.testing.assert_array_equal(got, want, err_msg=rule.name)
+
+
+def test_plan_is_cached():
+    assert rulecomp.compile_rule(RULES["B3/S23"]) is rulecomp.compile_rule(
+        RULES["B3/S23"]
+    )
