@@ -140,37 +140,80 @@ def fits_pallas_packed_tiled(height: int, width: int) -> bool:
     return 10 * width * 4 * 10 <= VMEM_BUDGET_BYTES  # min strip (8+2 rows)
 
 
-#: Max turns per tiled kernel invocation: the 1-word-row (32-bit) halo
-#: absorbs exactly one bit of invalid-edge propagation per turn.
+#: Turns bought per halo word-row: the garbage frontier from the
+#: extended strip's edge advances one bit-row per turn, so an h-word
+#: halo keeps the strip interior exact for 32*h turns.
 TILE_TURNS = WORD
 
+#: Scoped-VMEM ceiling for the *tiled* working set. The hard scoped
+#: limit on this TPU generation is 16 MB (a 19.8 MB request fails with
+#: "exceeded scoped vmem limit 16.00M"); Mosaic keeps ~8.5 live
+#: word-arrays at the kernel's peak, so with the conservative 10x
+#: multiplier 15 MB leaves headroom while admitting deeper halos than
+#: the whole-board budget would.
+TILED_VMEM_LIMIT = 15 << 20
 
-def _make_tiled_kernel(k_turns: int, rule: Rule):
-    assert 1 <= k_turns <= TILE_TURNS
+#: Deepest supported halo: the neighbour-strip fetch is one 8-sublane
+#: block, so at most 8 word-rows of halo exist to read.
+MAX_HALO_WORDS = 8
+
+
+def _halo_words(strip_rows: int, width: int) -> int:
+    """Halo depth (word-rows per side, 32*h turns per HBM pass): the
+    deepest h whose extended-strip working set still fits scoped VMEM.
+    Deeper halos amortize the per-pallas_call launch cost; past the
+    VMEM knee the extra halo compute loses (measured: h=4 is ~7% over
+    h=1 at 4096², h=8 regresses everywhere)."""
+    for h in (4, 2, 1):
+        if (strip_rows + 2 * h) * width * 4 * 10 <= TILED_VMEM_LIMIT:
+            return h
+    return 1
+
+
+def _make_tiled_kernel(k_turns: int, rule: Rule, halo: int):
+    assert 1 <= k_turns <= TILE_TURNS * halo
+    assert 1 <= halo <= MAX_HALO_WORDS
 
     def kernel(up_ref, c_ref, dn_ref, out_ref):
-        # Strip + one halo word row from each neighbour strip. Vertical
-        # shifts inside the extended strip use wrapped rolls; the wrap
-        # feeds garbage into the halo's *outer* bit only, which crosses
-        # the 32-bit halo word in 32 turns — interior rows stay exact
-        # for k_turns <= 32 (the light-cone argument; tested bit-exact).
+        # Strip + `halo` word-rows from each neighbour strip's edge
+        # block. Vertical shifts inside the extended strip use wrapped
+        # rolls; the wrap feeds garbage into the outermost bit only,
+        # advancing one bit-row per turn — interior rows stay exact for
+        # k_turns <= 32*halo (the light-cone argument; tested bit-exact
+        # at the boundary turn counts).
         p_ext = jnp.concatenate(
-            [up_ref[-1:], c_ref[:], dn_ref[:1]], axis=0
+            [up_ref[8 - halo:], c_ref[:], dn_ref[:halo]], axis=0
         )
-        out_ref[:] = _run_turns(p_ext, k_turns, rule)[1:-1]
+        out_ref[:] = _run_turns(p_ext, k_turns, rule)[halo:-halo]
 
     return kernel
 
 
-def _tiled_call(p: jax.Array, k_turns: int, rule: Rule, interpret: bool,
-                strip_rows: int | None = None):
-    rows, width = p.shape
+def _tile_plan(rows: int, width: int, strip_rows: int | None,
+               halo_words: int | None) -> tuple:
+    """Resolve (strip height, halo depth) once — the chunk size and the
+    kernel's halo are always derived from the same pair."""
     r = strip_rows or _strip_rows(rows, width)
     if rows % r != 0 or r % 8 != 0:
         raise ValueError(
             f"strip_rows={r} must divide the packed row count {rows} and "
             "be a multiple of 8"
         )
+    if halo_words is None:
+        h = _halo_words(r, width)
+    elif not 1 <= halo_words <= MAX_HALO_WORDS:
+        raise ValueError(
+            f"halo_words={halo_words} must be in 1..{MAX_HALO_WORDS} "
+            "(the neighbour-strip fetch is one 8-sublane block)"
+        )
+    else:
+        h = halo_words
+    return r, h
+
+
+def _tiled_call(p: jax.Array, k_turns: int, rule: Rule, interpret: bool,
+                r: int, h: int):
+    rows, width = p.shape
     nstrips = rows // r
     blocks = r // 8  # halo fetches are single 8-sublane blocks, so the
     # neighbour strips cost 8 rows of HBM traffic each, not r rows.
@@ -179,7 +222,7 @@ def _tiled_call(p: jax.Array, k_turns: int, rule: Rule, interpret: bool,
     )
     dn_spec = pl.BlockSpec((8, width), lambda i: (((i + 1) % nstrips) * blocks, 0))
     return pl.pallas_call(
-        _make_tiled_kernel(k_turns, rule),
+        _make_tiled_kernel(k_turns, rule, h),
         grid=(nstrips,),
         in_specs=[up_spec, pl.BlockSpec((r, width), lambda i: (i, 0)), dn_spec],
         out_specs=pl.BlockSpec((r, width), lambda i: (i, 0)),
@@ -189,7 +232,8 @@ def _tiled_call(p: jax.Array, k_turns: int, rule: Rule, interpret: bool,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n", "rule", "interpret", "strip_rows")
+    jax.jit,
+    static_argnames=("n", "rule", "interpret", "strip_rows", "halo_words"),
 )
 def step_n_packed_pallas_tiled_raw(
     p: jax.Array,
@@ -197,22 +241,32 @@ def step_n_packed_pallas_tiled_raw(
     rule: Rule = LIFE,
     interpret: bool = False,
     strip_rows: int | None = None,
+    halo_words: int | None = None,
 ) -> jax.Array:
-    """`n` turns, packed in/out, strip-tiled: each kernel invocation
-    advances TILE_TURNS turns with one HBM round trip — 32x less HBM
-    traffic than a per-turn XLA loop on boards too big for the
-    whole-board kernel. `strip_rows` overrides the auto strip height
-    (must divide the packed row count and be a multiple of 8; tests use
-    it to force multi-strip seams on small boards)."""
-    whole, rem = divmod(n, TILE_TURNS)
+    """`n` turns, packed in/out, strip-tiled with deep halos: each
+    kernel invocation advances 32*h turns with one HBM round trip,
+    where the halo depth h (word-rows per side) is auto-sized to scoped
+    VMEM — 32-128x less HBM traffic than a per-turn XLA loop on boards
+    too big for the whole-board kernel, with h>1 also amortizing the
+    per-launch cost. `strip_rows`/`halo_words` override the auto
+    sizing (strip_rows must divide the packed row count and be a
+    multiple of 8; halo_words <= 8; tests use them to force
+    multi-strip seams and light-cone-boundary turn counts on small
+    boards)."""
+    rows, width = p.shape
+    r, h = _tile_plan(rows, width, strip_rows, halo_words)
+    k = TILE_TURNS * h
+    whole, rem = divmod(n, k)
     if whole:
         p = lax.fori_loop(
             0, whole,
-            lambda _, q: _tiled_call(q, TILE_TURNS, rule, interpret, strip_rows),
+            lambda _, q: _tiled_call(q, k, rule, interpret, r, h),
             p,
         )
     if rem:
-        p = _tiled_call(p, rem, rule, interpret, strip_rows)
+        # The remainder needs only enough halo for its own light cone.
+        h_rem = min(h, -(-rem // TILE_TURNS))
+        p = _tiled_call(p, rem, rule, interpret, r, h_rem)
     return p
 
 

@@ -126,9 +126,10 @@ def _single_device_pallas_packed(rule: Rule, height: int, width: int,
                                  device=None) -> Stepper:
     """Packed VMEM-resident pallas backend (ops/pallas_bitlife.py):
     multi-turn chunks run as one whole-board kernel when the packed
-    working set fits VMEM, else as the strip-tiled kernel (32 turns per
-    HBM round trip). Measured 1.3x-3x the XLA packed path on TPU at
-    512²..8192² (BENCH_DETAIL.json)."""
+    working set fits VMEM, else as the strip-tiled kernel (32*h turns
+    per HBM round trip, halo depth h auto-sized to VMEM — 128 on the
+    big-board configs). Measured 1.3x-3.6x the XLA packed path on TPU
+    at 512²..8192² (BENCH_DETAIL.json)."""
     from gol_tpu.ops import pallas_bitlife
 
     dev = device or jax.devices()[0]

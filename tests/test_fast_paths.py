@@ -135,13 +135,21 @@ def test_fits_pallas_packed_gates():
     assert not fits_pallas_packed_tiled(4096, 4000)  # lane misalignment
 
 
-@pytest.mark.parametrize("turns", [1, 31, 33, 100])
-def test_pallas_packed_tiled_matches_dense_interpret(turns):
-    """The tiled kernel's 1-word-row halo must stay exact across the
-    32-turn light-cone boundary (turns 31/32/33) and strip seams:
-    768 rows = 24 word rows at strip_rows=8 forces 3 strips, so the
-    cross-strip halo index_map (including the toroidal wrap at strips
-    0 and 2) is genuinely exercised."""
+@pytest.mark.parametrize("halo,turns", [
+    # Light-cone boundaries per halo depth: an h-word halo is exact for
+    # exactly 32*h turns per pass, so turns just below/at/above the
+    # boundary pin both the whole-chunk and remainder paths.
+    (1, 1), (1, 31), (1, 33), (1, 100),
+    (2, 63), (2, 64), (2, 65),
+    (4, 127), (4, 128), (4, 129),
+    (None, 100),  # auto halo depth
+])
+def test_pallas_packed_tiled_matches_dense_interpret(halo, turns):
+    """The tiled kernel's h-word-row halo must stay exact across the
+    32*h-turn light-cone boundary and strip seams: 768 rows = 24 word
+    rows at strip_rows=8 forces 3 strips, so the cross-strip halo
+    index_map (including the toroidal wrap at strips 0 and 2) is
+    genuinely exercised."""
     from gol_tpu.ops.pallas_bitlife import step_n_packed_pallas_tiled_raw
 
     world = random_world(768, 128, seed=turns)
@@ -149,7 +157,7 @@ def test_pallas_packed_tiled_matches_dense_interpret(turns):
     got = np.asarray(
         bitlife.unpack(
             step_n_packed_pallas_tiled_raw(
-                p, turns, interpret=True, strip_rows=8
+                p, turns, interpret=True, strip_rows=8, halo_words=halo
             ),
             768,
         )
