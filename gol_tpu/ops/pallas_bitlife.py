@@ -34,9 +34,16 @@ from gol_tpu.models.rules import LIFE, Rule
 from gol_tpu.ops.bitlife import WORD, combine_packed, pack, unpack
 from gol_tpu.ops.life import from_bits, to_bits
 
-#: VMEM budget for board + live CSA temporaries (the packed board is
-#: H*W/8 bytes; the adder tree keeps ~8 word-arrays live at peak).
-VMEM_BUDGET_BYTES = 12 << 20
+#: Working-set budget for board + live CSA temporaries, as
+#: rows*width*4 bytes x the 10x live-array multiplier. The hard scoped-
+#: VMEM limit on this TPU generation is 16 MB (a 19.8 MB request fails
+#: with "exceeded scoped vmem limit 16.00M") and Mosaic keeps ~8.5
+#: word-arrays live at the kernel's peak, so a 15 MB model budget
+#: (~12.7 MB real) leaves headroom; configs at the model's edge run
+#: clean on hardware. One constant for the whole-board and tiled
+#: kernels and the sharded ring's local blocks — the same kernel body
+#: must not be admitted by one gate and rejected by another.
+VMEM_BUDGET_BYTES = 15 << 20
 
 
 def fits_pallas_packed(height: int, width: int) -> bool:
@@ -116,11 +123,23 @@ def step_n_packed_pallas_raw(
     )(p)
 
 
+#: Hard cap on the tiled kernel's strip height (word rows). The grid
+#: pipeline double-buffers the strip-sized in/out blocks *on top of*
+#: the kernel's live temporaries, and that sum is what the 16 MB scoped
+#: limit sees: a 72-row strip with 4-word halos compiles to a 16.04 MB
+#: scoped allocation (fails by 44 KB) while every measured r <= 64
+#: config compiles clean — the budget model alone can't separate them
+#: across widths, so the knee is pinned empirically.
+STRIP_ROWS_CAP = 64
+
+
 def _strip_rows(total_rows: int, width: int) -> int:
     """Strip height (word rows) for the tiled kernel: largest divisor of
-    `total_rows` that is a multiple of 8 and keeps the strip working set
-    ((R+2) x width x ~10 live arrays) within budget."""
-    budget_rows = VMEM_BUDGET_BYTES // (width * 4 * 10) - 2
+    `total_rows` that is a multiple of 8, within the working-set budget
+    ((R+2) x width x ~10 live arrays), and under STRIP_ROWS_CAP."""
+    budget_rows = min(
+        VMEM_BUDGET_BYTES // (width * 4 * 10) - 2, STRIP_ROWS_CAP
+    )
     r = 8
     for cand in range(8, total_rows + 1, 8):
         if total_rows % cand == 0 and cand <= budget_rows:
@@ -145,14 +164,6 @@ def fits_pallas_packed_tiled(height: int, width: int) -> bool:
 #: halo keeps the strip interior exact for 32*h turns.
 TILE_TURNS = WORD
 
-#: Scoped-VMEM ceiling for the *tiled* working set. The hard scoped
-#: limit on this TPU generation is 16 MB (a 19.8 MB request fails with
-#: "exceeded scoped vmem limit 16.00M"); Mosaic keeps ~8.5 live
-#: word-arrays at the kernel's peak, so with the conservative 10x
-#: multiplier 15 MB leaves headroom while admitting deeper halos than
-#: the whole-board budget would.
-TILED_VMEM_LIMIT = 15 << 20
-
 #: Deepest supported halo: the neighbour-strip fetch is one 8-sublane
 #: block, so at most 8 word-rows of halo exist to read.
 MAX_HALO_WORDS = 8
@@ -165,7 +176,7 @@ def _halo_words(strip_rows: int, width: int) -> int:
     VMEM knee the extra halo compute loses (measured: h=4 is ~7% over
     h=1 at 4096², h=8 regresses everywhere)."""
     for h in (4, 2, 1):
-        if (strip_rows + 2 * h) * width * 4 * 10 <= TILED_VMEM_LIMIT:
+        if (strip_rows + 2 * h) * width * 4 * 10 <= VMEM_BUDGET_BYTES:
             return h
     return 1
 

@@ -22,12 +22,19 @@ Communication-avoiding deep halos: a ghost word-row is 32 complete
 rows, and the stencil corrupts validity inward by only one row per
 turn — so after ONE exchange of each edge word-row, a shard can step
 its ghost-extended block 32 turns locally and slice the exact strip
-back out. `step_n` uses these 32-turn blocks whenever it can, cutting
-ring collectives 32x vs the per-turn exchange (the classic
+back out. `step_n` uses these blocks whenever it can, cutting ring
+collectives 32x-128x vs the per-turn exchange (the classic
 communication-avoiding stencil, done with the packing's own geometry;
 per-turn stepping remains for diffs and turn remainders). The extended
 block is stepped with the plain toroidal kernel: its vertical wrap only
 touches rows whose validity the shrink analysis already wrote off.
+
+On TPU the local block stepping runs the VMEM-resident pallas kernel
+(ops/pallas_bitlife.py) with a 4-word ghost slab per side — one
+ppermute pair buys 128 exact local turns AND the local turns go at the
+single-chip fast-path rate instead of the XLA fori_loop rate. Where
+the extended block misses the kernel's tile alignment or VMEM budget
+(or off-TPU), the XLA one-word-ghost blocks remain the path.
 """
 
 from __future__ import annotations
@@ -76,9 +83,68 @@ def halo_step_packed(p: jax.Array, rule: Rule, axis: str = AXIS) -> jax.Array:
     return bitlife.combine_packed(p, up, down, rule)
 
 
-def packed_sharded_stepper(rule: Rule, devices: list, height: int):
+#: Ghost slab depth (word-rows per side) for the pallas local path —
+#: the single-chip kernels' measured sweet spot (ops/pallas_bitlife).
+DEEP_WORDS = 4
+
+
+def local_block_mode(strip_words: int, width: int, on_tpu: bool,
+                     force: bool | None = None) -> tuple:
+    """(ghost depth h, local stepping mode) for a shard's deep blocks.
+
+    'whole': the ghost-extended block fits VMEM — the single-chip
+    VMEM-resident pallas kernel steps it. 'tiled': too big for VMEM but
+    tile-aligned — the strip-tiled pallas kernel steps it (it is an
+    exact toroidal stepper, and the ext block's wrap garbage is the
+    same garbage the ghost analysis already wrote off); the ghost depth
+    is a ppermute slab, not an 8-row block fetch, so it searches deeper
+    ghosts for the ext row count whose inner strips tile efficiently.
+    'xla': the fori_loop fallback with one-word ghosts (off-TPU unless
+    `force`, or misaligned shapes)."""
+    from gol_tpu.ops import pallas_bitlife
+
+    if force is False:
+        return 1, "xla"
+    if width % 128 == 0 and (on_tpu or force):
+        ext = strip_words + 2 * DEEP_WORDS
+        if (ext % 8 == 0
+                and ext * width * 4 * 10 <= pallas_bitlife.VMEM_BUDGET_BYTES):
+            return DEEP_WORDS, "whole"
+        # Tiled local stepping: pick the ghost depth whose extended
+        # block wastes the least compute — outer waste strip/ext times
+        # inner waste r/(r + 2*h_inner) from the tiled kernel's own
+        # halos (e.g. a 128-word strip tiles at 47% efficiency with
+        # h=4 ghosts but 67% with h=16).
+        best = None
+        for h in (4, 8, 16, 32, 64):
+            if h >= strip_words:
+                break
+            e = strip_words + 2 * h
+            if (e % 8 != 0
+                    or not pallas_bitlife.fits_pallas_packed_tiled(
+                        e * WORD, width)):
+                continue
+            # The tiled kernel's own planner supplies (inner strip,
+            # inner halo) — the efficiency model scores the exact plan
+            # step_n_packed_pallas_tiled_raw will execute.
+            r, h_inner = pallas_bitlife._tile_plan(e, width, None, None)
+            eff = (strip_words / e) * (r / (r + 2 * h_inner))
+            if best is None or eff > best[0]:
+                best = (eff, h)
+        if best is not None:
+            return best[1], "tiled"
+    return 1, "xla"
+
+
+def packed_sharded_stepper(rule: Rule, devices: list, height: int,
+                           force_local_pallas: bool | None = None):
     """Stepper whose world lives packed AND row-sharded: (H/32, W) uint32
-    sharded into contiguous word-row strips across `devices`."""
+    sharded into contiguous word-row strips across `devices`.
+
+    `force_local_pallas` overrides the TPU-only gate on the pallas
+    local-block path (True runs it in interpreter mode on CPU meshes —
+    tests use this to exercise the pallas-inside-shard_map composition
+    without chips; False pins the XLA path)."""
     from gol_tpu.parallel.stepper import Stepper
 
     n = len(devices)
@@ -89,28 +155,69 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int):
     mesh = Mesh(np.asarray(devices), (AXIS,))
     sharding = NamedSharding(mesh, P(AXIS, None))
     spec = P(AXIS, None)
+    on_tpu = devices[0].platform == "tpu"
+    strip_words = (height // n) // WORD
 
-    def deep_block(block):
-        """One exchange, 32 exact local turns (see module docstring)."""
-        above_last, below_first = edge_exchange(block, AXIS)
+    def deep_block(block, h: int, mode: str, turns: int):
+        """One h-word exchange, `turns` (<= 32*h) exact local turns (see
+        module docstring and `local_block_mode`)."""
+        from gol_tpu.ops import pallas_bitlife
+
+        assert 1 <= turns <= WORD * h
+        above_last, below_first = edge_exchange(block, AXIS, depth=h)
         ext = jnp.concatenate([above_last, block, below_first], axis=0)
-        ext = lax.fori_loop(
-            0, WORD, lambda _, q: bitlife.step_packed(q, rule), ext
-        )
-        return ext[1:-1]
+        if mode == "whole":
+            # Pallas kernel bodies are traced under the shard_map
+            # context and pltpu.roll does not propagate the varying-axis
+            # tag, so the in-kernel loop carry would fail vma checking —
+            # pallas-mode programs run their shard_map with
+            # check_vma=False instead (see step_n), and correctness is
+            # pinned by the bit-exact cross-backend tests.
+            ext = pallas_bitlife.step_n_packed_pallas_raw(
+                ext, turns, rule, interpret=not on_tpu
+            )
+        elif mode == "tiled":
+            ext = pallas_bitlife.step_n_packed_pallas_tiled_raw(
+                ext, turns, rule, interpret=not on_tpu
+            )
+        else:
+            ext = lax.fori_loop(
+                0, turns, lambda _, q: bitlife.step_packed(q, rule), ext
+            )
+        return ext[h:-h]
 
     @functools.partial(jax.jit, static_argnames=("k",))
     def step_n(p, k):
         # divmod would floor a negative k into 31 remainder turns;
         # preserve the fori_loop contract that k <= 0 is a no-op.
-        blocks, rem = divmod(max(k, 0), WORD)
+        h, mode = local_block_mode(
+            strip_words, p.shape[1], on_tpu, force_local_pallas
+        )
+        big, k2 = divmod(max(k, 0), WORD * h)
+        if mode == "xla":
+            # One-word ghosts: 32-turn blocks, per-turn tail.
+            mid, rem = divmod(k2, WORD)
+        else:
+            # Pallas local blocks accept any turn count, so the whole
+            # tail runs as ONE partial block at the fast-path rate (its
+            # ghost depth is already aligned; a shallower one might not
+            # be) instead of per-turn XLA steps.
+            mid, rem = 0, 0
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P())
+            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P()),
+            # vma checking must be off when a pallas local path is in
+            # the program (see deep_block); every other variant keeps it.
+            check_vma=mode == "xla",
         )
         def _many(block):
             block = lax.fori_loop(
-                0, blocks, lambda _, q: deep_block(q), block
+                0, big, lambda _, q: deep_block(q, h, mode, WORD * h), block
+            )
+            if mode != "xla" and k2:
+                block = deep_block(block, h, mode, k2)
+            block = lax.fori_loop(
+                0, mid, lambda _, q: deep_block(q, 1, "xla", WORD), block
             )
             block = lax.fori_loop(
                 0, rem, lambda _, q: halo_step_packed(q, rule), block

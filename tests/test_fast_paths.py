@@ -360,6 +360,50 @@ def test_deep_halo_blocks_match_dense(golden_root, shards, turns):
     assert int(count) == int(np.count_nonzero(want))
 
 
+def test_local_block_mode_selection():
+    """The ghost-extended local block picks the right stepping engine:
+    whole-VMEM pallas when it fits, strip-tiled pallas when aligned but
+    big, XLA one-word ghosts off-TPU / when misaligned / when forced."""
+    from gol_tpu.parallel.packed_halo import local_block_mode
+
+    assert local_block_mode(8, 128, on_tpu=True) == (4, "whole")
+    # 256-word strip at 16384 wide: the ext block exceeds VMEM at any
+    # ghost depth, and the ghost-depth search lands on h=8 (ext 272 =
+    # 16x17 tiles into 16-row inner strips at 63% efficiency, beating
+    # h=4's degenerate 8-row strips at 48%).
+    assert local_block_mode(256, 16384, on_tpu=True) == (8, "tiled")
+    # Misaligned: ext = 12+8 = 20 word rows is not a multiple of 8.
+    assert local_block_mode(12, 128, on_tpu=True) == (1, "xla")
+    # Lane misalignment.
+    assert local_block_mode(8, 120, on_tpu=True) == (1, "xla")
+    # Off-TPU defaults to XLA; force flips it both ways.
+    assert local_block_mode(8, 128, on_tpu=False) == (1, "xla")
+    assert local_block_mode(8, 128, on_tpu=False, force=True) == (4, "whole")
+    assert local_block_mode(8, 128, on_tpu=True, force=False) == (1, "xla")
+
+
+def test_packed_sharded_pallas_local_blocks_match_dense():
+    """The TPU local-block fast path — the pallas kernel running inside
+    shard_map on the 4-word ghost-extended strip — forced on the CPU
+    mesh via interpreter mode. 1024 rows / 4 shards = 8 word-rows per
+    strip, so ext = 16 rows is tile-aligned and pallas-eligible; 165
+    turns = one 128-turn pallas block + one 32-turn XLA block + 5
+    per-turn steps, covering all three loops of step_n."""
+    import jax
+
+    from gol_tpu.parallel.packed_halo import packed_sharded_stepper
+
+    world = random_world(1024, 128, seed=6)
+    s = packed_sharded_stepper(
+        LIFE, jax.devices()[:4], 1024, force_local_pallas=True
+    )
+    p = s.put(world)
+    p, count = s.step_n(p, 165)
+    want = np.asarray(life.step_n(world, 165))
+    np.testing.assert_array_equal(s.fetch(p), want)
+    assert int(count) == int(np.count_nonzero(want))
+
+
 @pytest.mark.parametrize("shards", [2, 8])
 @pytest.mark.parametrize("turns", [16, 50])
 def test_deep_halo_dense_matches_dense(golden_root, shards, turns):
