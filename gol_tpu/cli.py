@@ -75,6 +75,14 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="SEC",
                     help="auto-checkpoint the board to out/ every SEC "
                          "seconds (0 = off)")
+    ap.add_argument("--cycle-detect", action="store_true",
+                    dest="cycle_detect",
+                    help="exact cycle fast-forward: once the board "
+                         "provably revisits a state, collapse the "
+                         "remaining turns modulo the period (bit-exact; "
+                         "makes the 10^10-turn default run finish). "
+                         "Only active on headless fused runs: pass "
+                         "-noVis, and detach any live controller")
     ap.add_argument("--platform", default=None, metavar="NAME",
                     help="force a jax platform (e.g. cpu, tpu); some "
                          "site configs pin the platform so the "
@@ -172,6 +180,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         out_dir=args.out,
         autosave_turns=args.autosave_turns,
         autosave_seconds=args.autosave_secs,
+        cycle_detect=args.cycle_detect,
     )
 
     # Checkpoint restart (local or --serve): boot from a snapshot,
@@ -238,6 +247,10 @@ def main(argv: Optional[list[str]] = None) -> int:
                 "start_turn": resume_turn,
             }
         # Per-turn CellFlipped diffs only matter when something consumes them.
+        if params.cycle_detect and not args.novis:
+            print("warning: --cycle-detect only engages on headless "
+                  "fused runs; pass -noVis for it to fire",
+                  file=sys.stderr)
         engine = Engine(params, keypresses=keypresses,
                         emit_flips=not args.novis, **engine_kwargs)
         engine.start()

@@ -1,0 +1,84 @@
+"""Exact cycle fast-forward for astronomically long runs.
+
+The reference's default run is 10^10 turns (ref: main.go:20 — the
+`-turns` default), which no engine steps one by one; yet finite Life
+boards are eventually periodic (the 512² golden board settles into a
+period-2 oscillation after ~turn 10,000, ref: count_test.go:45-51).
+Periodicity makes fast-forward *bit-exact with zero approximation*:
+if `world(t) == world(a)` then `world(t + k) == world(a + k)` for all
+k, so the remaining turns collapse modulo `m = t - a` and the final
+board is reached by stepping `remaining % m` more turns. Equality is a
+full device-side board compare (one fused reduce, no hashing) — a hit
+can never be spurious.
+
+Detection is a Brent-style anchor walk at dispatch granularity: hold
+an anchor state, compare the committed world against it at a wall-clock
+cadence (each compare costs one scalar realization — the same price as
+a ticker sample), and double the anchor's lease each refresh so some
+anchor eventually lands inside the cycle with a lease long enough to
+see a full period. Comparing at multiples of the dispatch chunk finds
+a *multiple* of the true period (chunks are powers of two, so any
+even-period oscillation — the overwhelmingly common case — is caught
+on the first in-cycle compare); a multiple is all fast-forward needs.
+
+Opt-in via Params.cycle_detect / `--cycle-detect`: the observable event
+stream (ticker samples, snapshots, the final board) stays exact, but
+turn numbers leap, which a consumer expecting dense TurnComplete
+cadence might not want — and the detector only ever runs on the fused
+headless path where no such consumer is attached.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+class CycleDetector:
+    """Feed `observe(turn, world)` after each committed dispatch; it
+    returns a period multiple `m` once `world` provably equals an
+    earlier committed state `m` turns back, else None."""
+
+    def __init__(self, interval_seconds: float = 2.0):
+        self.interval = interval_seconds
+        # In a multi-process SPMD job every device program must be
+        # broadcast to all workers (parallel/multihost.py mirrors the
+        # stepper's dispatches); the compare below is not mirrored, and
+        # an unmirrored program over a globally-sharded array would
+        # strand the other processes at a collective rendezvous. The
+        # detector therefore disarms itself off the single-process path.
+        self._disabled = jax.process_count() > 1
+        self._equal = jax.jit(lambda a, b: jnp.array_equal(a, b))
+        self._anchor = None
+        self._anchor_turn = -1
+        self._lease = 1  # compares until the anchor is replaced
+        self._used = 0
+        self._next_check = time.monotonic() + interval_seconds
+
+    def observe(self, turn: int, world) -> int | None:
+        # Re-checked live: jax.distributed.initialize() may run after
+        # this detector was constructed, and the armed path must never
+        # execute in a multi-process job (see __init__).
+        if self._disabled or jax.process_count() > 1:
+            return None
+        now = time.monotonic()
+        if now < self._next_check:
+            return None
+        self._next_check = now + self.interval
+        if self._anchor is None:
+            self._anchor, self._anchor_turn = world, turn
+            return None
+        # One scalar realization; the compare itself ran on device.
+        if bool(self._equal(self._anchor, world)):
+            return turn - self._anchor_turn
+        self._used += 1
+        if self._used >= self._lease:
+            # Brent doubling: a longer-lived anchor further along the
+            # orbit — eventually one sits inside the cycle with a lease
+            # covering a full period.
+            self._anchor, self._anchor_turn = world, turn
+            self._lease *= 2
+            self._used = 0
+        return None

@@ -40,6 +40,7 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from gol_tpu.engine.cycles import CycleDetector
 from gol_tpu.events import (
     AliveCellsCount,
     BoardSync,
@@ -156,6 +157,7 @@ class Engine:
         io_service: Optional[IOService] = None,
         stepper=None,
         timeline=None,
+        cycle_check_seconds: float = 2.0,
     ):
         self.p = params
         self.events = events if events is not None else EventQueue()
@@ -220,6 +222,13 @@ class Engine:
         #: it when Params.chunk == 0).
         self.effective_chunk = max(params.chunk, 1) if params.chunk else 64
         self._throttle_disabled = False
+        # Exact cycle fast-forward (Params.cycle_detect): detector state
+        # plus the turn count it skipped (surfaced for tests/telemetry).
+        self._cycles = (
+            CycleDetector(cycle_check_seconds) if params.cycle_detect
+            else None
+        )
+        self.skipped_turns = 0
 
     # --- public api ---
 
@@ -446,6 +455,23 @@ class Engine:
                         self.events.put(TurnComplete(t))
                     self._throttle_events()
                 self._maybe_autosave(turn, world)
+                # Gate on the LIVE consumer flag, not this dispatch's
+                # snapshot: a controller attaching mid-dispatch must not
+                # watch the turn counter leap right after its BoardSync.
+                if self._cycles is not None and not self.emit_turns:
+                    m = self._cycles.observe(turn, world)
+                    if m:
+                        # The board provably equals its state m turns
+                        # ago: the remaining turns collapse modulo m,
+                        # bit-exactly. One jump per run; the final
+                        # `remaining % m` turns step normally.
+                        skip = (p.turns - turn) // m * m
+                        if skip:
+                            turn += skip
+                            self.skipped_turns = skip
+                            self._commit(turn, world, count)
+                            self._autosave_turn = turn
+                        self._cycles = None
 
         self._ticker_stop.set()
         self._last_pair = (turn, int(self._committed[2]))

@@ -61,6 +61,14 @@ class Params:
     # the newest one with bounded turn loss (see gol_tpu/checkpoint.py).
     autosave_turns: int = 0
     autosave_seconds: float = 0.0
+    # Exact cycle fast-forward (engine/cycles.py): once the board
+    # provably revisits an earlier state (full device-side compare, no
+    # hashing), the remaining turns collapse modulo the revisit
+    # distance — the reference's infeasible 10^10-turn default run
+    # completes bit-exactly in seconds once the board goes periodic.
+    # Off by default: turn numbers leap when it fires, which per-turn
+    # consumers may not expect (the detector only runs headless).
+    cycle_detect: bool = False
 
     def __post_init__(self):
         if self.image_width <= 0 or self.image_height <= 0:

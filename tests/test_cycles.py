@@ -1,0 +1,107 @@
+"""Exact cycle fast-forward (`engine/cycles.py`, Params.cycle_detect).
+
+The reference's default run is 10^10 turns (ref: main.go:20); the
+detector makes such runs finish bit-exactly once the board goes
+periodic. Correctness contract: the final board and alive set must be
+IDENTICAL to plain stepping — fast-forward is a modulo collapse on a
+proven state revisit, never an approximation."""
+
+import numpy as np
+
+from gol_tpu.engine.cycles import CycleDetector
+from gol_tpu.engine.distributor import Engine
+from gol_tpu.events import FinalTurnComplete
+from gol_tpu.ops import life
+from gol_tpu.params import Params
+
+
+def blinker_world(h=64, w=64):
+    world = np.zeros((h, w), np.uint8)
+    world[10, 10:13] = life.ALIVE  # period-2 oscillator
+    world[30, 40:43] = life.ALIVE
+    return world
+
+
+def glider_world(h=64, w=64):
+    world = np.zeros((h, w), np.uint8)
+    for x, y in ((1, 0), (2, 1), (0, 2), (1, 2), (2, 2)):
+        world[y, x] = life.ALIVE  # translates: no state revisit soon
+    return world
+
+
+def run_engine(world, turns, cycle_detect, tmp_path, chunk=32):
+    p = Params(
+        turns=turns, threads=1,
+        image_width=world.shape[1], image_height=world.shape[0],
+        chunk=chunk, tick_seconds=60.0,
+        image_dir=str(tmp_path), out_dir=str(tmp_path / "out"),
+        cycle_detect=cycle_detect,
+    )
+    engine = Engine(p, emit_flips=False, initial_world=world,
+                    cycle_check_seconds=0.0)
+    engine.start()
+    final = None
+    for ev in engine.events:
+        if isinstance(ev, FinalTurnComplete):
+            final = ev
+    engine.join(timeout=300)
+    if engine.error is not None:
+        raise engine.error
+    return engine, final
+
+
+def test_detector_finds_even_period():
+    det = CycleDetector(interval_seconds=0.0)
+    a = np.zeros((4, 4), np.uint8)
+    b = np.ones((4, 4), np.uint8)
+    states = [a, b, a, b, a, b, a, b]
+    hits = [det.observe(t, s) for t, s in enumerate(states)]
+    found = [m for m in hits if m]
+    assert found and found[0] % 2 == 0
+
+
+def test_detector_never_false_positives():
+    det = CycleDetector(interval_seconds=0.0)
+    rng = np.random.default_rng(0)
+    for t in range(20):  # all-distinct states
+        assert det.observe(t, rng.integers(0, 2, (8, 8), np.uint8)) is None
+
+
+def test_engine_fast_forwards_periodic_board(tmp_path):
+    """A 10M-turn blinker run must finish promptly with the EXACT board
+    and turn count plain stepping would produce (blinker: state(N) =
+    state(N mod 2) from turn 0)."""
+    world = blinker_world()
+    turns = 10_000_001
+    engine, final = run_engine(world, turns, True, tmp_path)
+    assert engine.skipped_turns > 0
+    assert final is not None and final.completed_turns == turns
+    want = life.alive_cells(np.asarray(life.step_n(world, 1)))  # odd N
+    assert sorted(final.alive) == sorted(want)
+
+
+def test_engine_result_identical_with_and_without_detector(tmp_path):
+    """On a run short enough to step plainly, the detector must change
+    nothing observable (the jump is a modulo collapse, so both paths
+    land on the same board)."""
+    world = blinker_world()
+    _, plain = run_engine(world, 4001, False, tmp_path)
+    eng, fast = run_engine(world, 4001, True, tmp_path)
+    assert eng.skipped_turns > 0  # it did engage...
+    assert sorted(fast.alive) == sorted(plain.alive)  # ...invisibly
+    assert fast.completed_turns == plain.completed_turns == 4001
+
+
+def test_engine_no_jump_without_revisit(tmp_path):
+    """A translating glider never revisits a state in 200 turns: the
+    detector must stay silent and the result must match plain
+    stepping."""
+    world = glider_world()
+    engine, final = run_engine(world, 200, True, tmp_path)
+    assert engine.skipped_turns == 0
+    want = life.alive_cells(np.asarray(life.step_n(world, 200)))
+    assert sorted(final.alive) == sorted(want)
+
+
+def test_cycle_detect_off_by_default():
+    assert Params().cycle_detect is False
