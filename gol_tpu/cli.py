@@ -162,6 +162,21 @@ def main(argv: Optional[list[str]] = None) -> int:
     print("Width:", args.w)
     print("Height:", args.h)
 
+    # The live visualiser is two-state; a generations rule runs
+    # headless, and the decision must land BEFORE the chunk default so
+    # the run gets the fused/auto-calibrated fast path like any -noVis.
+    from gol_tpu.models.rules import GenRule, get_rule as _get_rule
+    try:
+        rule_obj = _get_rule(args.rule)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}") from None
+    if isinstance(rule_obj, GenRule) and not args.novis:
+        if args.serve is None and args.connect is None:
+            print("warning: the live visualiser is two-state; running "
+                  "the generations rule headless (as with -noVis)",
+                  file=sys.stderr)
+            args.novis = True
+
     # Headless engines (noVis drain or server) default to the fused-chunk
     # fast path with auto-calibrated chunk size; a local visualiser needs
     # per-turn diffs, so chunk 1.

@@ -332,7 +332,7 @@ class Engine:
         # Initial CellFlipped burst for every live cell
         # (ref: gol/distributor.go:72-80).
         if self.emit_flips:
-            for cell in life.alive_cells(host_world):
+            for cell in cells_from_mask(self._alive_mask(host_world)):
                 self.events.put(CellFlipped(self.start_turn, cell))
 
         self._commit(self.start_turn, world, self.stepper.alive_count_async(world))
@@ -500,13 +500,24 @@ class Engine:
         # Normal completion (ref: gol/distributor.go:180-206).
         self._write_snapshot(turn, world, wait=True)
         self.events.put(
-            FinalTurnComplete(turn, life.alive_cells(self.stepper.fetch(world)))
+            FinalTurnComplete(
+                turn,
+                cells_from_mask(self._alive_mask(self.stepper.fetch(world))),
+            )
         )
         self.io.check_idle()
         self.events.put(StateChange(turn, State.QUITTING))
         self.events.close()
 
     # --- services ---
+
+    def _alive_mask(self, host_world):
+        """Alive-cell mask of a fetched (gray-level) world for event
+        payloads: nonzero for two-state rules, the stepper's own notion
+        for multi-state backends where dying cells are nonzero grays."""
+        if self.stepper.alive_mask is not None:
+            return self.stepper.alive_mask(host_world)
+        return host_world
 
     def _commit(self, turn: int, world, count) -> None:
         self._committed = (turn, world, count)

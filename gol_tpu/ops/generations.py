@@ -1,0 +1,104 @@
+"""Generations (multi-state) step kernels — the B/S/C model family.
+
+State domain: uint8 0 (dead), 1 (alive), 2..C-1 (dying). One turn
+(ref semantics: the two-state reference rule is the C=2 special case
+of this, ref: gol/distributor.go:325-342):
+
+- neighbour counts see ONLY state-1 cells;
+- alive stays alive iff n ∈ survive, else it starts dying (state 2,
+  which for C=2 wraps straight to dead);
+- dead is born iff n ∈ birth;
+- dying ages by one per turn and wraps to dead at C.
+
+Everything is a fused elementwise combine over the same separable
+toroidal 3-sum as `ops/life.py` — one XLA kernel per turn, shape-
+polymorphic, `jit`/sharding-safe (under a `NamedSharding` the rolls
+lower to ring collectives exactly like the dense life path).
+
+On-disk/PGM representation: states map to gray levels — 0 -> 0,
+1 -> 255, dying s -> evenly spaced grays below 255 — injectively, so a
+PGM snapshot is a complete checkpoint for `--resume` just like the
+two-state board (SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gol_tpu.models.rules import GenRule
+from gol_tpu.ops.life import ALIVE, neighbour_counts
+
+
+def _member_mask(counts: jax.Array, ns: frozenset) -> jax.Array:
+    out = jnp.zeros(counts.shape, jnp.bool_)
+    for k in sorted(ns):
+        out = out | (counts == k)
+    return out
+
+
+def step_states(state: jax.Array, rule: GenRule) -> jax.Array:
+    """One Generations turn on a uint8 state grid (values 0..C-1)."""
+    alive = state == 1
+    n = neighbour_counts(alive.astype(jnp.uint8))
+    born = (state == 0) & _member_mask(n, rule.birth)
+    stays = alive & _member_mask(n, rule.survive)
+    # Non-surviving alive cells and dying cells both age; age wraps to
+    # dead at C (for C=2 an alive cell that fails survive dies at once).
+    aged = jnp.where(state > 0, state + 1, state)
+    aged = jnp.where(aged >= rule.states, 0, aged).astype(jnp.uint8)
+    return jnp.where(born | stays, jnp.uint8(1), aged)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "rule"))
+def step_n_states(state: jax.Array, n: int, rule: GenRule) -> jax.Array:
+    return lax.fori_loop(0, n, lambda _, s: step_states(s, rule), state)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "rule"))
+def step_n_counted_states(state: jax.Array, n: int, rule: GenRule):
+    """`n` turns plus the alive (state-1) count, one dispatch."""
+    s = step_n_states(state, n, rule)
+    return s, jnp.sum(s == 1, dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("rule",))
+def step_with_diff_states(state: jax.Array, rule: GenRule):
+    """One turn + changed-cell mask + alive count (the per-turn live
+    view; 'flipped' means any state change)."""
+    new = step_states(state, rule)
+    return new, state != new, jnp.sum(new == 1, dtype=jnp.int32)
+
+
+def levels(rule: GenRule) -> np.ndarray:
+    """state -> gray level LUT: 0->0, 1->255, dying states evenly
+    spaced below 255 — injective for the whole parseable range
+    2 <= C <= 255 (GenRule.parse enforces the bound; the spacing
+    255//C is >= 1 there and dying levels stay strictly inside
+    (0, 255))."""
+    lut = np.zeros(rule.states, np.uint8)
+    lut[1] = ALIVE
+    for s in range(2, rule.states):
+        lut[s] = ALIVE - (s - 1) * (ALIVE // rule.states)
+    return lut
+
+
+def states_from_levels(world, rule: GenRule) -> np.ndarray:
+    """Inverse of `levels` for PGM-roundtrip resume. Unknown levels
+    (e.g. a plain two-state board seeding a generations run) map via
+    nearest: 0 stays dead, anything else starts alive."""
+    lut = levels(rule)
+    world = np.asarray(world)
+    out = np.zeros(world.shape, np.uint8)
+    for s in range(rule.states - 1, 0, -1):
+        out[world == lut[s]] = s
+    out[(world != 0) & ~np.isin(world, lut)] = 1
+    return out
+
+
+def levels_from_states(state, rule: GenRule) -> np.ndarray:
+    return levels(rule)[np.asarray(state)]

@@ -19,7 +19,7 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
-from gol_tpu.models.rules import LIFE, Rule, get_rule
+from gol_tpu.models.rules import LIFE, GenRule, Rule, get_rule
 from gol_tpu.ops import life
 from gol_tpu.params import BACKENDS
 
@@ -49,6 +49,11 @@ class Stepper:
     step_with_diff: Callable
     #: world -> count device scalar (engine thread only)
     alive_count_async: Callable
+    #: host-levels -> bool mask of ALIVE cells for event payloads.
+    #: None = two-state convention (nonzero is alive); multi-state
+    #: backends (Generations) override it so dying cells — nonzero
+    #: gray levels — are not reported as alive.
+    alive_mask: Optional[Callable] = None
 
     def alive_count(self, world) -> int:
         return int(self.alive_count_async(world))
@@ -192,6 +197,59 @@ def _single_device_pallas(rule: Rule, device=None) -> Stepper:
     )
 
 
+def _gens_stepper(rule: GenRule, devices: list) -> Stepper:
+    """Generations (B/S/C multi-state) backend — dense uint8 state grid
+    (ops/generations.py). Device state holds states 0..C-1; `put` and
+    `fetch` translate to/from the injective gray-level representation
+    the PGM/event layer speaks, so snapshots remain complete resumable
+    checkpoints. Sharding is GSPMD: the state array carries a row-strip
+    `NamedSharding` and the step's toroidal rolls lower to ring
+    collectives under plain jit — no shard_map needed for a dense
+    elementwise kernel."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from gol_tpu.ops import generations as gens
+
+    n = len(devices)
+    if n > 1:
+        mesh = Mesh(np.asarray(devices), ("rows",))
+        sharding = NamedSharding(mesh, P("rows", None))
+    else:
+        sharding = devices[0]
+
+    @jax.jit
+    def _count(s):
+        return jnp.sum(s == 1, dtype=jnp.int32)
+
+    def put(w):
+        return jax.device_put(gens.states_from_levels(w, rule), sharding)
+
+    def fetch(s):
+        host = np.asarray(s)
+        if host.dtype == np.bool_:
+            return host  # diff masks pass through untranslated
+        return gens.levels_from_states(host, rule)
+
+    from gol_tpu.parallel.halo import cpu_serializing_sync
+
+    _sync = cpu_serializing_sync(devices)
+
+    return Stepper(
+        name=f"generations-{n}",
+        shards=n,
+        put=put,
+        fetch=fetch,
+        step=lambda s: _sync(gens.step_n_states(s, 1, rule)),
+        step_n=lambda s, k: _sync(
+            gens.step_n_counted_states(s, int(k), rule)
+        ),
+        step_with_diff=lambda s: _sync(gens.step_with_diff_states(s, rule)),
+        alive_count_async=lambda s: _sync(_count(s)),
+        alive_mask=lambda levels: np.asarray(levels) == life.ALIVE,
+    )
+
+
 def make_stepper(
     threads: int = 1,
     height: int = 512,
@@ -213,6 +271,20 @@ def make_stepper(
         raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
     rule = get_rule(rule) if isinstance(rule, str) else rule
     multiprocess = devices is None and jax.process_count() > 1
+    if isinstance(rule, GenRule):
+        # Multi-state rules run the dense generations kernel (states
+        # don't bit-pack); GSPMD shards it across devices, but the
+        # multi-process dispatch mirror only wraps two-state steppers.
+        if backend not in ("auto", "dense"):
+            raise ValueError(
+                f"generations rules support backend auto/dense, not "
+                f"{backend!r}"
+            )
+        if multiprocess:
+            raise ValueError("generations rules are single-process only")
+        devs = devices if devices is not None else jax.devices()
+        k = shard_count(threads, height, len(devs))
+        return _gens_stepper(rule, devs[:k])
     if multiprocess:
         # Round-robin across processes so the k-shard prefix spans every
         # host; process-grouped order would leave whole hosts silently

@@ -310,3 +310,47 @@ def test_attach_during_long_dispatch_is_acked_immediately(golden_root, tmp_path)
         ctl.close()
     finally:
         server.shutdown()
+
+
+def test_cycle_detect_waits_for_detach(golden_root, tmp_path):
+    """--serve with Params.cycle_detect: while a per-turn consumer is
+    attached the turn counter must stay dense (no fast-forward leap);
+    after detach the detector engages and the astronomically long run
+    finishes (engine/cycles.py is live-gated on emit_turns)."""
+    import numpy as np
+
+    from gol_tpu.ops import life
+
+    world = np.zeros((64, 64), np.uint8)
+    world[10, 10:13] = life.ALIVE  # period-2 blinker
+    p = Params(
+        turns=50_000_001, threads=1, image_width=64, image_height=64,
+        image_dir=str(golden_root / "images"), out_dir=str(tmp_path / "out"),
+        tick_seconds=60.0, chunk=8, cycle_detect=True,
+    )
+    server = EngineServer(
+        p, port=0, initial_world=world, cycle_check_seconds=0.2
+    ).start()
+    ctl = Controller(*server.address, want_flips=True)
+    seen = []
+    start = time.monotonic()
+    for ev in ctl.events:
+        if isinstance(ev, TurnComplete):
+            seen.append(ev.completed_turns)
+            elapsed = time.monotonic() - start
+            if len(seen) >= 40 and elapsed > 0.8:
+                break  # held attached across several check intervals
+        assert time.monotonic() - start < 30
+    # Dense turn numbering while attached: no leap happened.
+    assert seen == list(range(seen[0], seen[0] + len(seen)))
+    assert server.engine.skipped_turns == 0
+    assert ctl.detach(30)
+
+    # Headless again: the detector engages and the run completes.
+    deadline = time.monotonic() + 60
+    while not server.engine.completed_turns >= p.turns:
+        assert time.monotonic() < deadline, "fast-forward never fired"
+        time.sleep(0.05)
+    assert server.engine.skipped_turns > 0
+    ctl.close()
+    assert server.wait(30)

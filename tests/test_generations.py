@@ -1,0 +1,230 @@
+"""Generations (B/S/C multi-state) model family.
+
+Ground truth is an independent pure-numpy oracle in this file; the
+family must also reduce EXACTLY to the two-state life-like engine at
+C=2 (the reference's rule is the C=2, B3/S23 member). Engine-level
+tests pin the event/PGM contract: alive payloads are state-1 cells
+only, and a gray-level snapshot is a complete resumable checkpoint."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.engine.distributor import Engine
+from gol_tpu.events import FinalTurnComplete
+from gol_tpu.models.rules import GenRule, RULES, Rule, get_rule
+from gol_tpu.ops import generations as gens, life
+from gol_tpu.parallel.stepper import make_stepper
+from gol_tpu.params import Params
+
+
+def oracle_step(state: np.ndarray, rule: GenRule) -> np.ndarray:
+    alive = (state == 1).astype(np.int32)
+    n = sum(
+        np.roll(np.roll(alive, dy, 0), dx, 1)
+        for dy in (-1, 0, 1) for dx in (-1, 0, 1) if (dy, dx) != (0, 0)
+    )
+    born = (state == 0) & np.isin(n, sorted(rule.birth))
+    stays = (state == 1) & np.isin(n, sorted(rule.survive))
+    aged = np.where(state > 0, state + 1, 0)
+    aged = np.where(aged >= rule.states, 0, aged)
+    return np.where(born | stays, 1, aged).astype(np.uint8)
+
+
+def random_states(rule, h=48, w=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, rule.states, (h, w)).astype(np.uint8)
+
+
+# --- notation / model ---
+
+
+def test_parse_and_named_rules():
+    bb = get_rule("B2/S/C3")
+    assert isinstance(bb, GenRule)
+    assert bb is RULES["B2/S/C3"]
+    assert (bb.birth, bb.survive, bb.states) == (frozenset({2}), frozenset(), 3)
+    assert isinstance(get_rule("B3/S23"), Rule)  # two-state stays life-like
+    with pytest.raises(ValueError):
+        GenRule.parse("B2/S/C1")
+    with pytest.raises(ValueError):
+        GenRule.parse("B2/S")
+
+
+# --- kernel vs oracle ---
+
+
+@pytest.mark.parametrize("notation", ["B2/S/C3", "B2/S345/C4"])
+def test_step_matches_oracle(notation):
+    rule = get_rule(notation)
+    state = random_states(rule, seed=3)
+    got = state
+    want = state.copy()
+    for _ in range(10):
+        want = oracle_step(want, rule)
+    got = np.asarray(gens.step_n_states(got, 10, rule))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_random_rules_match_oracle():
+    import random
+
+    rng = random.Random(5)
+    for i in range(10):
+        rule = GenRule(
+            name=f"r{i}",
+            birth=frozenset(k for k in range(9) if rng.random() < 0.3),
+            survive=frozenset(k for k in range(9) if rng.random() < 0.3),
+            states=rng.randint(2, 6),
+        )
+        state = random_states(rule, seed=i)
+        want = state.copy()
+        for _ in range(5):
+            want = oracle_step(want, rule)
+        got = np.asarray(gens.step_n_states(state, 5, rule))
+        np.testing.assert_array_equal(got, want, err_msg=rule.name)
+
+
+def test_c2_reduces_to_life():
+    rule = GenRule.parse("B3/S23/C2")
+    world = life.random_world(64, 64, density=0.3, seed=7)
+    state = (np.asarray(world) != 0).astype(np.uint8)
+    got = np.asarray(gens.step_n_states(state, 20, rule))
+    want = (np.asarray(life.step_n(world, 20)) != 0).astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_levels_roundtrip():
+    rule = get_rule("B2/S345/C4")
+    state = random_states(rule, seed=1)
+    lv = gens.levels_from_states(state, rule)
+    np.testing.assert_array_equal(gens.states_from_levels(lv, rule), state)
+    # A plain two-state board seeds as dead/alive.
+    two = np.array([[0, 255], [255, 0]], np.uint8)
+    np.testing.assert_array_equal(
+        gens.states_from_levels(two, rule), np.array([[0, 1], [1, 0]])
+    )
+
+
+# --- stepper ---
+
+
+def test_stepper_selection_and_shard_parity():
+    import jax
+
+    rule = "B2/S/C3"
+    s1 = make_stepper(threads=1, height=64, width=64, rule=rule)
+    s4 = make_stepper(threads=4, height=64, width=64, rule=rule)
+    assert s1.name == "generations-1" and s4.name == "generations-4"
+    world = life.random_world(64, 64, density=0.3, seed=2)
+    p1, p4 = s1.put(world), s4.put(world)
+    p1, c1 = s1.step_n(p1, 17)
+    p4, c4 = s4.step_n(p4, 17)
+    np.testing.assert_array_equal(s1.fetch(p1), s4.fetch(p4))
+    assert int(c1) == int(c4)
+    # Alive mask: only full-brightness (state-1) cells are alive.
+    lv = s1.fetch(p1)
+    assert s1.alive_mask(lv).sum() == int(c1)
+    assert (lv != 0).sum() >= int(c1)
+
+
+def test_stepper_rejects_bad_backends():
+    with pytest.raises(ValueError):
+        make_stepper(threads=1, height=64, width=64, rule="B2/S/C3",
+                     backend="packed")
+
+
+def test_stepper_diff_and_count():
+    s = make_stepper(threads=1, height=32, width=32, rule="B2/S/C3")
+    world = life.random_world(32, 32, density=0.4, seed=9)
+    p = s.put(world)
+    new, mask, count = s.step_with_diff(p)
+    a, b = s.fetch(p), s.fetch(new)
+    np.testing.assert_array_equal(np.asarray(mask), a != b)
+    assert int(s.alive_count_async(new)) == int(count)
+
+
+# --- engine integration ---
+
+
+def run_engine(p, world=None, start_turn=0):
+    engine = Engine(p, emit_flips=False, initial_world=world,
+                    start_turn=start_turn)
+    engine.start()
+    final = None
+    for ev in engine.events:
+        if isinstance(ev, FinalTurnComplete):
+            final = ev
+    engine.join(timeout=300)
+    if engine.error is not None:
+        raise engine.error
+    return final
+
+
+def test_engine_flips_path_survives_gens(golden_root, tmp_path):
+    """The per-turn diff path (emit_flips=True — what a want_flips
+    controller switches on) must not crash on the generations stepper:
+    its fetch used to try to gray-translate the boolean diff mask
+    (regression). Flip events carry state *changes*."""
+    from gol_tpu.events import CellFlipped
+
+    p = Params(turns=5, threads=1, image_width=64, image_height=64,
+               rule="B2/S/C3", chunk=1, tick_seconds=60.0,
+               image_dir=str(golden_root / "images"),
+               out_dir=str(tmp_path / "out"))
+    engine = Engine(p, emit_flips=True)
+    engine.start()
+    flips = 0
+    final = None
+    for ev in engine.events:
+        if isinstance(ev, CellFlipped):
+            flips += 1
+        elif isinstance(ev, FinalTurnComplete):
+            final = ev
+    engine.join(timeout=120)
+    if engine.error is not None:
+        raise engine.error
+    assert final is not None and flips > 0
+
+
+def test_engine_run_and_resume_exact(golden_root, tmp_path):
+    """A generations engine run writes a gray-level final PGM whose
+    alive payload counts only state-1 cells, and a mid-run snapshot
+    resumes to the identical final board."""
+    from gol_tpu.io.pgm import read_pgm
+
+    p = Params(turns=40, threads=2, image_width=64, image_height=64,
+               rule="B2/S/C3", chunk=4, tick_seconds=60.0,
+               image_dir=str(golden_root / "images"),
+               out_dir=str(tmp_path / "out"))
+    final = run_engine(p)
+    rule = get_rule("B2/S/C3")
+    world0 = read_pgm(golden_root / "images" / "64x64.pgm")
+    want = gens.states_from_levels(world0, rule)
+    for _ in range(40):
+        want = oracle_step(want, rule)
+    out = read_pgm(tmp_path / "out" / "64x64x40.pgm")
+    np.testing.assert_array_equal(
+        gens.states_from_levels(out, rule), want
+    )
+    assert len(final.alive) == int((want == 1).sum())
+
+    # Half-way run, then resume from its final snapshot.
+    p20 = Params(**{**p.__dict__, "turns": 20,
+                    "out_dir": str(tmp_path / "half")})
+    run_engine(p20)
+    snap = read_pgm(tmp_path / "half" / "64x64x20.pgm")
+    p_resume = Params(**{**p.__dict__, "out_dir": str(tmp_path / "res")})
+    run_engine(p_resume, world=np.asarray(snap), start_turn=20)
+    resumed = (tmp_path / "res" / "64x64x40.pgm").read_bytes()
+    direct = (tmp_path / "out" / "64x64x40.pgm").read_bytes()
+    assert resumed == direct
+
+
+def test_parse_rejects_unrepresentable_states():
+    with pytest.raises(ValueError):
+        GenRule.parse("B3/S23/C256")
+    # The full parseable range keeps the gray mapping injective.
+    for c in (2, 3, 17, 128, 255):
+        rule = GenRule.parse(f"B3/S23/C{c}")
+        lut = gens.levels(rule)
+        assert len(set(lut.tolist())) == rule.states
