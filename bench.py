@@ -140,14 +140,20 @@ def measure_device_rate(side: int, turns: int, latency: float,
 
     stepper = make_stepper(threads=1, height=side, width=side,
                            devices=[jax.devices()[0]], backend=backend)
-    p0 = stepper.put(_world(side))
+    return _sustained_rate(stepper, side, turns, latency)
+
+
+def _sustained_rate(stepper, side: int, turns: int, latency: float) -> dict:
+    """Sustained turns/s of any Stepper at side²: warm once, chain
+    dispatches, realize once, subtract the measured link latency."""
+    p = stepper.put(_world(side))
     n = min(25_000, turns)
     k = max(1, turns // n)
-    int(stepper.step_n(p0, n)[1])
+    int(stepper.step_n(p, n)[1])
     t0 = time.perf_counter()
-    p = p0
+    q = p
     for _ in range(k):
-        p, count = stepper.step_n(p, n)
+        q, count = stepper.step_n(q, n)
     int(count)
     dt = time.perf_counter() - t0 - latency
     tps = k * n / dt
@@ -172,22 +178,7 @@ def measure_ring_rate(side: int, turns: int, latency: float) -> dict:
     from gol_tpu.parallel.packed_halo import packed_sharded_stepper
 
     s = packed_sharded_stepper(LIFE, [jax.devices()[0]], side)
-    p = s.put(_world(side))
-    n = min(25_000, turns)
-    k = max(1, turns // n)
-    int(s.step_n(p, n)[1])
-    t0 = time.perf_counter()
-    q = p
-    for _ in range(k):
-        q, count = s.step_n(q, n)
-    int(count)
-    dt = time.perf_counter() - t0 - latency
-    tps = k * n / dt
-    return {
-        "backend": s.name,
-        "turns_per_sec": round(tps, 1),
-        "gcells_per_sec": round(tps * side * side / 1e9, 1),
-    }
+    return _sustained_rate(s, side, turns, latency)
 
 
 def measure_engine_rate(headline_tps: float) -> dict:

@@ -165,9 +165,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     # The live visualiser is two-state; a generations rule runs
     # headless, and the decision must land BEFORE the chunk default so
     # the run gets the fused/auto-calibrated fast path like any -noVis.
-    from gol_tpu.models.rules import GenRule, get_rule as _get_rule
+    from gol_tpu.models.rules import GenRule, get_rule
     try:
-        rule_obj = _get_rule(args.rule)
+        rule_obj = get_rule(args.rule)
     except ValueError as e:
         raise SystemExit(f"error: {e}") from None
     if isinstance(rule_obj, GenRule) and not args.novis:

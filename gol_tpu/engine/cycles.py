@@ -43,13 +43,6 @@ class CycleDetector:
 
     def __init__(self, interval_seconds: float = 2.0):
         self.interval = interval_seconds
-        # In a multi-process SPMD job every device program must be
-        # broadcast to all workers (parallel/multihost.py mirrors the
-        # stepper's dispatches); the compare below is not mirrored, and
-        # an unmirrored program over a globally-sharded array would
-        # strand the other processes at a collective rendezvous. The
-        # detector therefore disarms itself off the single-process path.
-        self._disabled = jax.process_count() > 1
         self._equal = jax.jit(lambda a, b: jnp.array_equal(a, b))
         self._anchor = None
         self._anchor_turn = -1
@@ -58,10 +51,15 @@ class CycleDetector:
         self._next_check = time.monotonic() + interval_seconds
 
     def observe(self, turn: int, world) -> int | None:
-        # Re-checked live: jax.distributed.initialize() may run after
-        # this detector was constructed, and the armed path must never
-        # execute in a multi-process job (see __init__).
-        if self._disabled or jax.process_count() > 1:
+        # In a multi-process SPMD job every device program must be
+        # broadcast to all workers (parallel/multihost.py mirrors the
+        # stepper's dispatches); the compare below is not mirrored, and
+        # an unmirrored program over a globally-sharded array would
+        # strand the other processes at a collective rendezvous. Checked
+        # live (not latched at construction) because
+        # jax.distributed.initialize() may run after this detector is
+        # built.
+        if jax.process_count() > 1:
             return None
         now = time.monotonic()
         if now < self._next_check:

@@ -31,22 +31,15 @@ import numpy as np
 from jax import lax
 
 from gol_tpu.models.rules import GenRule
-from gol_tpu.ops.life import ALIVE, neighbour_counts
-
-
-def _member_mask(counts: jax.Array, ns: frozenset) -> jax.Array:
-    out = jnp.zeros(counts.shape, jnp.bool_)
-    for k in sorted(ns):
-        out = out | (counts == k)
-    return out
+from gol_tpu.ops.life import ALIVE, count_in, neighbour_counts
 
 
 def step_states(state: jax.Array, rule: GenRule) -> jax.Array:
     """One Generations turn on a uint8 state grid (values 0..C-1)."""
     alive = state == 1
     n = neighbour_counts(alive.astype(jnp.uint8))
-    born = (state == 0) & _member_mask(n, rule.birth)
-    stays = alive & _member_mask(n, rule.survive)
+    born = (state == 0) & count_in(n, rule.birth)
+    stays = alive & count_in(n, rule.survive)
     # Non-surviving alive cells and dying cells both age; age wraps to
     # dead at C (for C=2 an alive cell that fails survive dies at once).
     aged = jnp.where(state > 0, state + 1, state)

@@ -58,6 +58,16 @@ def neighbour_counts(bits: jax.Array) -> jax.Array:
     return n - bits
 
 
+def count_in(counts: jax.Array, ns) -> jax.Array:
+    """Membership mask `counts ∈ ns` for a static neighbour-count set —
+    unrolls to compares + ors at trace time (shared by the dense B/S
+    combine below and the generations family, ops/generations.py)."""
+    terms = [counts == k for k in sorted(ns)]
+    if not terms:
+        return jnp.zeros(counts.shape, jnp.bool_)
+    return functools.reduce(operator.or_, terms)
+
+
 def apply_rule(bits: jax.Array, counts: jax.Array, rule: Rule) -> jax.Array:
     """B/S rule as a fused boolean combine over static neighbour sets.
 
@@ -65,15 +75,9 @@ def apply_rule(bits: jax.Array, counts: jax.Array, rule: Rule) -> jax.Array:
     unrolls to a handful of compares and ors that XLA fuses with the
     neighbour sum — no gather, no table lookup at runtime.
     """
-
-    def any_of(ns):
-        terms = [counts == k for k in sorted(ns)]
-        if not terms:
-            return jnp.zeros(counts.shape, jnp.bool_)
-        return functools.reduce(operator.or_, terms)
-
     alive = bits != 0
-    nxt = jnp.where(alive, any_of(rule.survive), any_of(rule.birth))
+    nxt = jnp.where(alive, count_in(counts, rule.survive),
+                    count_in(counts, rule.birth))
     return nxt.astype(jnp.uint8)
 
 

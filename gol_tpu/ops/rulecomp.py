@@ -93,7 +93,7 @@ def _select_cover(primes: set, minterms: frozenset) -> tuple:
                 key=lambda p: (
                     sum(1 for m in remaining if _covers(p, m)),
                     -bin(p[1]).count("1"),
-                    [-p[0], -p[1]],  # deterministic tie-break
+                    (-p[0], -p[1]),  # deterministic tie-break
                 ),
             )
         if essential not in chosen:
