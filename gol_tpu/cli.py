@@ -287,6 +287,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         if engine.error is not None:
             print(f"engine error: {engine.error!r}", file=sys.stderr)
             return 1
+        if engine.skipped_turns:
+            print(f"cycle fast-forward: skipped {engine.skipped_turns} "
+                  "turns (proven state revisit; result is bit-exact)")
         return 0
     finally:
         # On an exception path, skip releasing the workers: errors from

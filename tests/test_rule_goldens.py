@@ -1,0 +1,54 @@
+"""Self-generated golden boards for non-Life rules.
+
+The reference only ships goldens for B3/S23 (`check/images/`); every
+other rule is pinned by cross-backend property tests, where the dense
+path is both implementation and oracle — a dense-kernel regression
+would move the oracle with it. These fixtures
+(`fixtures/check/rules/`, produced by the dense path at a known-good
+commit and hand-spot-checked) freeze today's behavior so any future
+kernel change that alters a non-Life rule's output fails loudly."""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from gol_tpu.io.pgm import read_pgm
+from gol_tpu.models.rules import GenRule, get_rule
+from gol_tpu.ops import bitlife, generations as gens, life
+
+FIXTURES = pathlib.Path(__file__).resolve().parent.parent / "fixtures"
+RULES_DIR = FIXTURES / "check" / "rules"
+
+
+def _golden(notation: str, turns: int):
+    name = notation.replace("/", "_")
+    return read_pgm(RULES_DIR / f"64x64x{turns}_{name}.pgm")
+
+
+@pytest.mark.parametrize("turns", [1, 100])
+@pytest.mark.parametrize("notation", ["B36/S23", "B3678/S34678", "B2/S"])
+def test_lifelike_rule_goldens(turns, notation):
+    rule = get_rule(notation)
+    w0 = read_pgm(FIXTURES / "images" / "64x64.pgm")
+    want = np.asarray(_golden(notation, turns))
+    np.testing.assert_array_equal(
+        np.asarray(life.step_n(w0, turns, rule=rule)), want
+    )
+    # And the packed engine against the same frozen board.
+    np.testing.assert_array_equal(
+        np.asarray(bitlife.step_n_packed(w0, turns, rule=rule)), want
+    )
+
+
+@pytest.mark.parametrize("turns", [1, 100])
+@pytest.mark.parametrize("notation", ["B2/S/C3", "B2/S345/C4"])
+def test_generations_rule_goldens(turns, notation):
+    rule = get_rule(notation)
+    assert isinstance(rule, GenRule)
+    w0 = read_pgm(FIXTURES / "images" / "64x64.pgm")
+    s = gens.states_from_levels(w0, rule)
+    got = gens.levels_from_states(
+        np.asarray(gens.step_n_states(s, turns, rule)), rule
+    )
+    np.testing.assert_array_equal(got, np.asarray(_golden(notation, turns)))
