@@ -97,71 +97,25 @@ def _shift_down(p: jax.Array) -> jax.Array:
 
 #: Sentinel for an all-ones mask (a cover containing the care-nothing
 #: implicant); compared with `is` — jax arrays overload `==`.
-_ONE = object()
+ONE = object()
 
 
-def _apply_plan(p: jax.Array, plan: rulecomp.RulePlan, bits: dict) -> jax.Array:
-    """Final combine of the minimized survive/birth masks with the
-    current board, in the cheapest form the plan classified (see
-    rulecomp.compile_rule). None means an identically-zero mask."""
-    cache: dict = {}
-
-    def mask(cover):
-        if rulecomp.is_full(cover):
-            return _ONE
-        return rulecomp.emit_mask(cover, bits, cache)
-
-    def AND(x, m):
-        if m is None:
-            return None
-        if m is _ONE:
-            return x
-        return x & m
-
-    def OR(a, b):
-        if a is None:
-            return b
-        if b is None:
-            return a
-        if a is _ONE or b is _ONE:
-            return _ONE
-        return a | b
-
-    survive, birth = mask(plan.survive), mask(plan.birth)
-    if plan.combine == "b_subset":
-        out = OR(birth, AND(p, survive))
-    elif plan.combine == "s_subset":
-        out = OR(survive, AND(~p, birth))
-    else:
-        out = OR(AND(p, survive), AND(~p, birth))
-    if out is None:
-        return p ^ p
-    if out is _ONE:
-        return ~(p ^ p)
-    return out
-
-
-def combine_packed(p: jax.Array, up: jax.Array, down: jax.Array,
-                   rule: Rule, roll=None) -> jax.Array:
-    """Horizontal rolls + CSA count + rule combine, given the two
-    vertically-shifted bitboards. The single definition of the packed
-    rule engine — the single-chip path supplies toroidal shifts, the
-    sharded path supplies halo-carried ones (parallel/packed_halo.py),
-    and the pallas kernels supply `roll` (pltpu.roll) to stay on the VPU.
+def rule_masks(p: jax.Array, up: jax.Array, down: jax.Array,
+               plan: rulecomp.RulePlan, roll=None) -> tuple:
+    """(survive, birth) masks of the compiled plan over the CSA
+    neighbour count — each an array, None (identically zero), or the
+    `ONE` sentinel (identically ones). The single definition of the
+    packed count arithmetic, shared by the life-like combine below and
+    the generations planes (ops/bitgens.py).
 
     Column-sum form: the 8-neighbour count is (left column sum) +
-    (right column sum) + (up + down), where each column sum is the 2-bit
-    CSA of a vertical triple — 4 lane rolls (of the two column-sum bit
-    slices) instead of 6 (of p/up/down), and a 3x2-bit adder instead of
-    an 8x1-bit one.
-
-    The rule itself is compiled by `ops/rulecomp.py`: Quine-McCluskey
-    minimized masks (counts 9..15 are don't-cares), shared products, the
-    subset-factored final combine, and count bit-slices materialized
-    only if some implicant reads them (B3/S23 never touches bit 3)."""
+    (right column sum) + (up + down), where each column sum is the
+    2-bit CSA of a vertical triple — 4 lane rolls (of the two
+    column-sum bit slices) instead of 6 (of p/up/down), and a 3x2-bit
+    adder instead of an 8x1-bit one. Count bit-slices are materialized
+    only if some minimized implicant reads them."""
     if roll is None:
         roll = jnp.roll
-    plan = rulecomp.compile_rule(rule)
     need = plan.needed
     # Vertical triple (up + p + down) as 2 bit slices.
     upd = up ^ down
@@ -188,7 +142,73 @@ def combine_packed(p: jax.Array, up: jax.Array, down: jax.Array,
             bits[2] = k1 ^ k2
         if 3 in need:
             bits[3] = k1 & k2
-    return _apply_plan(p, plan, bits)
+    cache: dict = {}
+
+    def mask(cover):
+        if rulecomp.is_full(cover):
+            return ONE
+        return rulecomp.emit_mask(cover, bits, cache)
+
+    return mask(plan.survive), mask(plan.birth)
+
+
+def resolve_mask(m, like: jax.Array) -> jax.Array:
+    """Materialize a rule_masks result as an array (for callers that
+    cannot exploit the zero/ones sentinels structurally)."""
+    if m is None:
+        return like ^ like
+    if m is ONE:
+        return ~(like ^ like)
+    return m
+
+
+def _combine_masks(p: jax.Array, plan: rulecomp.RulePlan,
+                   survive, birth) -> jax.Array:
+    """Final combine of the minimized survive/birth masks with the
+    current board, in the cheapest form the plan classified (see
+    rulecomp.compile_rule)."""
+
+    def AND(x, m):
+        if m is None:
+            return None
+        if m is ONE:
+            return x
+        return x & m
+
+    def OR(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if a is ONE or b is ONE:
+            return ONE
+        return a | b
+
+    if plan.combine == "b_subset":
+        out = OR(birth, AND(p, survive))
+    elif plan.combine == "s_subset":
+        out = OR(survive, AND(~p, birth))
+    else:
+        out = OR(AND(p, survive), AND(~p, birth))
+    if out is None:
+        return p ^ p
+    if out is ONE:
+        return ~(p ^ p)
+    return out
+
+
+def combine_packed(p: jax.Array, up: jax.Array, down: jax.Array,
+                   rule: Rule, roll=None) -> jax.Array:
+    """Horizontal rolls + CSA count + rule combine, given the two
+    vertically-shifted bitboards. The single definition of the packed
+    rule engine — the single-chip path supplies toroidal shifts, the
+    sharded path supplies halo-carried ones (parallel/packed_halo.py),
+    and the pallas kernels supply `roll` (pltpu.roll) to stay on the
+    VPU. The count arithmetic + minimized mask emission live in
+    `rule_masks`; this adds the subset-factored final combine."""
+    plan = rulecomp.compile_rule(rule)
+    survive, birth = rule_masks(p, up, down, plan, roll)
+    return _combine_masks(p, plan, survive, birth)
 
 
 def step_packed(p: jax.Array, rule: Rule = LIFE) -> jax.Array:

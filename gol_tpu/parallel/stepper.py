@@ -197,6 +197,38 @@ def _single_device_pallas(rule: Rule, device=None) -> Stepper:
     )
 
 
+def _gens_alive_mask(levels) -> np.ndarray:
+    return np.asarray(levels) == life.ALIVE
+
+
+def _gens_scaffold(devices: list, row_axis_dim: int, to_levels):
+    """Shared wiring of the two generations builders: the GSPMD
+    row-strip NamedSharding (over dim `row_axis_dim` of the device
+    state), the bool-mask-passthrough fetch, and the CPU-mesh
+    serialization — one definition so the dense and packed variants
+    cannot drift apart here."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from gol_tpu.parallel.halo import cpu_serializing_sync
+
+    n = len(devices)
+    if n > 1:
+        spec = [None] * (row_axis_dim + 1) + [None]
+        spec[row_axis_dim] = "rows"
+        mesh = Mesh(np.asarray(devices), ("rows",))
+        sharding = NamedSharding(mesh, P(*spec))
+    else:
+        sharding = devices[0]
+
+    def fetch(arr):
+        host = np.asarray(arr)
+        if host.dtype == np.bool_:
+            return host  # diff masks pass through untranslated
+        return to_levels(host)
+
+    return sharding, fetch, cpu_serializing_sync(devices)
+
+
 def _gens_stepper(rule: GenRule, devices: list) -> Stepper:
     """Generations (B/S/C multi-state) backend — dense uint8 state grid
     (ops/generations.py). Device state holds states 0..C-1; `put` and
@@ -207,16 +239,12 @@ def _gens_stepper(rule: GenRule, devices: list) -> Stepper:
     collectives under plain jit — no shard_map needed for a dense
     elementwise kernel."""
     import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from gol_tpu.ops import generations as gens
 
-    n = len(devices)
-    if n > 1:
-        mesh = Mesh(np.asarray(devices), ("rows",))
-        sharding = NamedSharding(mesh, P("rows", None))
-    else:
-        sharding = devices[0]
+    sharding, fetch, _sync = _gens_scaffold(
+        devices, 0, lambda host: gens.levels_from_states(host, rule)
+    )
 
     @jax.jit
     def _count(s):
@@ -225,19 +253,9 @@ def _gens_stepper(rule: GenRule, devices: list) -> Stepper:
     def put(w):
         return jax.device_put(gens.states_from_levels(w, rule), sharding)
 
-    def fetch(s):
-        host = np.asarray(s)
-        if host.dtype == np.bool_:
-            return host  # diff masks pass through untranslated
-        return gens.levels_from_states(host, rule)
-
-    from gol_tpu.parallel.halo import cpu_serializing_sync
-
-    _sync = cpu_serializing_sync(devices)
-
     return Stepper(
-        name=f"generations-{n}",
-        shards=n,
+        name=f"generations-{len(devices)}",
+        shards=len(devices),
         put=put,
         fetch=fetch,
         step=lambda s: _sync(gens.step_n_states(s, 1, rule)),
@@ -246,7 +264,63 @@ def _gens_stepper(rule: GenRule, devices: list) -> Stepper:
         ),
         step_with_diff=lambda s: _sync(gens.step_with_diff_states(s, rule)),
         alive_count_async=lambda s: _sync(_count(s)),
-        alive_mask=lambda levels: np.asarray(levels) == life.ALIVE,
+        alive_mask=_gens_alive_mask,
+    )
+
+
+def _gens_stepper_packed(rule: GenRule, devices: list,
+                         height: int) -> Stepper:
+    """Packed generations backend (ops/bitgens.py): one-hot dying-state
+    bit-planes, the shared SWAR count machinery on the alive plane,
+    aging as a free plane rename — ~the packed Life rate for any C.
+    Sharding is GSPMD over the planes' row axis (dim 1), like the dense
+    variant."""
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import bitgens, bitlife, generations as gens
+
+    sharding, fetch, _sync = _gens_scaffold(
+        devices, 1,
+        lambda host: gens.levels_from_states(
+            bitgens.unpack_states(host, height, rule), rule
+        ),
+    )
+
+    def put(w):
+        return jax.device_put(
+            bitgens.pack_states(gens.states_from_levels(w, rule), rule),
+            sharding,
+        )
+
+    @jax.jit
+    def _count(planes):
+        return bitlife.count_packed(planes[0])
+
+    @jax.jit
+    def _step(planes):
+        return bitgens.step_packed_gens(planes, rule)
+
+    @jax.jit
+    def _step_with_diff(planes):
+        new = bitgens.step_packed_gens(planes, rule)
+        changed = jnp.zeros_like(planes[0])
+        for i in range(planes.shape[0]):
+            changed = changed | (planes[i] ^ new[i])
+        mask = bitlife.unpack(changed, height) != 0
+        return new, mask, bitlife.count_packed(new[0])
+
+    return Stepper(
+        name=f"generations-packed-{len(devices)}",
+        shards=len(devices),
+        put=put,
+        fetch=fetch,
+        step=lambda p: _sync(_step(p)),
+        step_n=lambda p, k: _sync(
+            bitgens.step_n_packed_gens(p, int(k), rule)
+        ),
+        step_with_diff=lambda p: _sync(_step_with_diff(p)),
+        alive_count_async=lambda p: _sync(_count(p)),
+        alive_mask=_gens_alive_mask,
     )
 
 
@@ -272,19 +346,42 @@ def make_stepper(
     rule = get_rule(rule) if isinstance(rule, str) else rule
     multiprocess = devices is None and jax.process_count() > 1
     if isinstance(rule, GenRule):
-        # Multi-state rules run the dense generations kernel (states
-        # don't bit-pack); GSPMD shards it across devices, but the
+        # Multi-state rules: one-hot bit-planes (packed SWAR, ~the Life
+        # rate) when the grid packs into whole words, else the dense
+        # state kernel; GSPMD shards either across devices. The
         # multi-process dispatch mirror only wraps two-state steppers.
-        if backend not in ("auto", "dense"):
+        from gol_tpu.ops.bitgens import packable_gens
+
+        if backend not in ("auto", "dense", "packed"):
             raise ValueError(
-                f"generations rules support backend auto/dense, not "
-                f"{backend!r}"
+                f"generations rules support backend auto/dense/packed, "
+                f"not {backend!r}"
             )
         if multiprocess:
             raise ValueError("generations rules are single-process only")
+        if backend == "packed" and not packable_gens(height, width):
+            raise ValueError(f"grid height {height} is not packable")
         devs = devices if devices is not None else jax.devices()
         k = shard_count(threads, height, len(devs))
-        return _gens_stepper(rule, devs[:k])
+
+        def largest_divisor(limit: int, n: int) -> int:
+            # GSPMD NamedShardings need the sharded axis to divide
+            # evenly (no uneven-shard path for the bonus family).
+            return max(d for d in range(1, limit + 1) if n % d == 0)
+
+        # One-hot planes cost (C-1)/8 bytes per cell vs the dense
+        # grid's 1 — memory crosses over at C=9, so "auto" keeps the
+        # packed path to rules where it is strictly smaller AND faster;
+        # higher C stays packed only on explicit request.
+        want_packed = backend == "packed" or (
+            backend == "auto" and rule.states <= 8
+        )
+        if want_packed and packable_gens(height, width):
+            from gol_tpu.ops.bitlife import WORD
+
+            k = largest_divisor(k, height // WORD)
+            return _gens_stepper_packed(rule, devs[:k], height)
+        return _gens_stepper(rule, devs[:largest_divisor(k, height)])
     if multiprocess:
         # Round-robin across processes so the k-shard prefix spans every
         # host; process-grouped order would leave whole hosts silently

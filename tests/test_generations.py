@@ -114,7 +114,11 @@ def test_stepper_selection_and_shard_parity():
     rule = "B2/S/C3"
     s1 = make_stepper(threads=1, height=64, width=64, rule=rule)
     s4 = make_stepper(threads=4, height=64, width=64, rule=rule)
-    assert s1.name == "generations-1" and s4.name == "generations-4"
+    # auto picks the packed one-hot-plane path on packable grids; a
+    # 64-row board is 2 word-rows, so 4 requested shards clamp to the
+    # largest dividing count.
+    assert s1.name == "generations-packed-1"
+    assert s4.name == "generations-packed-2"
     world = life.random_world(64, 64, density=0.3, seed=2)
     p1, p4 = s1.put(world), s4.put(world)
     p1, c1 = s1.step_n(p1, 17)
@@ -130,6 +134,9 @@ def test_stepper_selection_and_shard_parity():
 def test_stepper_rejects_bad_backends():
     with pytest.raises(ValueError):
         make_stepper(threads=1, height=64, width=64, rule="B2/S/C3",
+                     backend="pallas")
+    with pytest.raises(ValueError):  # explicit packed on unpackable grid
+        make_stepper(threads=1, height=48, width=64, rule="B2/S/C3",
                      backend="packed")
 
 
@@ -228,3 +235,90 @@ def test_parse_rejects_unrepresentable_states():
         rule = GenRule.parse(f"B3/S23/C{c}")
         lut = gens.levels(rule)
         assert len(set(lut.tolist())) == rule.states
+
+
+# --- packed (one-hot plane) fast path ---
+
+
+@pytest.mark.parametrize("notation", ["B2/S/C3", "B2/S345/C4", "B3/S23/C2"])
+@pytest.mark.parametrize("turns", [1, 5, 33])
+def test_packed_gens_matches_dense(notation, turns):
+    from gol_tpu.ops import bitgens
+
+    rule = get_rule(notation)
+    state = random_states(rule, h=64, w=64, seed=turns)
+    planes = bitgens.pack_states(state, rule)
+    out, count = bitgens.step_n_packed_gens(planes, turns, rule)
+    got = bitgens.unpack_states(np.asarray(out), 64, rule)
+    want = np.asarray(gens.step_n_states(state, turns, rule))
+    np.testing.assert_array_equal(got, want)
+    assert int(count) == int((want == 1).sum())
+
+
+def test_packed_gens_random_rules():
+    import random
+
+    from gol_tpu.ops import bitgens
+
+    rng = random.Random(11)
+    for i in range(8):
+        rule = GenRule(
+            name=f"p{i}",
+            birth=frozenset(k for k in range(9) if rng.random() < 0.3),
+            survive=frozenset(k for k in range(9) if rng.random() < 0.3),
+            states=rng.randint(2, 7),
+        )
+        state = random_states(rule, h=32, w=48, seed=i)
+        planes = bitgens.pack_states(state, rule)
+        out, _ = bitgens.step_n_packed_gens(planes, 6, rule)
+        got = bitgens.unpack_states(np.asarray(out), 32, rule)
+        want = np.asarray(gens.step_n_states(state, 6, rule))
+        np.testing.assert_array_equal(got, want, err_msg=rule.name)
+
+
+def test_packed_gens_stepper_selected_and_parity():
+    s = make_stepper(threads=1, height=64, width=64, rule="B2/S/C3")
+    assert s.name == "generations-packed-1"
+    dense = make_stepper(threads=1, height=64, width=64, rule="B2/S/C3",
+                         backend="dense")
+    assert dense.name == "generations-1"
+    world = life.random_world(64, 64, density=0.3, seed=4)
+    p, d = s.put(world), dense.put(world)
+    p, cp = s.step_n(p, 23)
+    d, cd = dense.step_n(d, 23)
+    np.testing.assert_array_equal(s.fetch(p), dense.fetch(d))
+    assert int(cp) == int(cd)
+    # Diff + alive-mask contract on the packed path.
+    new, mask, count = s.step_with_diff(p)
+    np.testing.assert_array_equal(
+        np.asarray(mask), s.fetch(p) != s.fetch(new)
+    )
+    assert s.alive_mask(s.fetch(new)).sum() == int(count)
+
+
+def test_packed_gens_sharded_parity():
+    s1 = make_stepper(threads=1, height=128, width=64, rule="B2/S345/C4")
+    s4 = make_stepper(threads=4, height=128, width=64, rule="B2/S345/C4")
+    assert s4.name == "generations-packed-4"
+    world = life.random_world(128, 64, density=0.3, seed=8)
+    p1, p4 = s1.put(world), s4.put(world)
+    p1, c1 = s1.step_n(p1, 19)
+    p4, c4 = s4.step_n(p4, 19)
+    np.testing.assert_array_equal(s1.fetch(p1), s4.fetch(p4))
+    assert int(c1) == int(c4)
+
+
+def test_unpackable_height_falls_back_to_dense():
+    s = make_stepper(threads=1, height=48, width=64, rule="B2/S/C3")
+    assert s.name == "generations-1"
+
+
+def test_auto_keeps_high_state_counts_dense():
+    """One-hot planes cost (C-1)/8 bytes per cell vs the dense grid's
+    1 — auto must not blow memory up for high-C rules (packed remains
+    an explicit opt-in there)."""
+    s = make_stepper(threads=1, height=64, width=64, rule="B3/S23/C12")
+    assert s.name == "generations-1"
+    forced = make_stepper(threads=1, height=64, width=64,
+                          rule="B3/S23/C12", backend="packed")
+    assert forced.name == "generations-packed-1"
