@@ -71,31 +71,40 @@ def _life_view(rule: GenRule) -> Rule:
     return Rule(name=rule.name, birth=rule.birth, survive=rule.survive)
 
 
-def step_packed_gens(planes: jax.Array, rule: GenRule) -> jax.Array:
-    """One turn on (C-1, rows, W) one-hot planes."""
+def step_planes(planes: tuple, rule: GenRule, up: jax.Array,
+                down: jax.Array, roll=None) -> tuple:
+    """One turn on a TUPLE of C-1 one-hot plane arrays, given the two
+    vertically-shifted alive bitboards — the core the XLA path (below)
+    and the pallas kernel (ops/pallas_bitgens.py) share; callers supply
+    their shift/roll primitives exactly like bitlife.combine_packed."""
     alive = planes[0]
     plan = rulecomp.compile_rule(_life_view(rule))
     # bitlife.combine_packed fuses the masks into the two-state next
     # board, but here birth and survive feed DIFFERENT planes — so the
     # shared CSA (`rule_masks`) emits them separately.
-    up = bitlife._shift_up(alive)
-    down = bitlife._shift_down(alive)
     survive_mask, birth_mask = (
         bitlife.resolve_mask(m, alive)
-        for m in bitlife.rule_masks(alive, up, down, plan)
+        for m in bitlife.rule_masks(alive, up, down, plan, roll)
     )
     dead = ~alive
-    for i in range(1, rule.states - 1):
-        dead = dead & ~planes[i]
+    for q in planes[1:]:
+        dead = dead & ~q
     new_alive = (alive & survive_mask) | (dead & birth_mask)
     if rule.states == 2:
-        return new_alive[None]
+        return (new_alive,)
     # Aging is a plane rename; the first dying plane is the alive cells
     # that failed survive.
-    new_planes = [new_alive, alive & ~survive_mask]
-    for i in range(1, rule.states - 2):
-        new_planes.append(planes[i])
-    return jnp.stack(new_planes)
+    return (new_alive, alive & ~survive_mask) + planes[1:-1]
+
+
+def step_packed_gens(planes: jax.Array, rule: GenRule) -> jax.Array:
+    """One turn on stacked (C-1, rows, W) one-hot planes (XLA path)."""
+    alive = planes[0]
+    new = step_planes(
+        tuple(planes[i] for i in range(rule.states - 1)), rule,
+        bitlife._shift_up(alive), bitlife._shift_down(alive),
+    )
+    return jnp.stack(new)
 
 
 def step_n_packed_gens_raw(planes: jax.Array, n: int,

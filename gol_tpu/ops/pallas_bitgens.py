@@ -1,0 +1,96 @@
+"""Pallas TPU kernel for packed Generations — one-hot planes in VMEM.
+
+The XLA packed-gens loop (`ops/bitgens.py`) bounces the plane stack
+through HBM every turn; this kernel keeps all C-1 one-hot planes
+VMEM-resident for the whole multi-turn chunk, exactly as
+`ops/pallas_bitlife.py` does for the two-state board. Planes are
+separate 2-D refs (Mosaic-friendly), the turn body is the shared
+`bitgens.step_planes` with `pltpu.roll` primitives, and the loop uses
+the same UNROLL discipline as the life kernels.
+
+Whole-board only: a generations run that outgrows VMEM falls back to
+the XLA path (the strip-tiled construction would apply identically if
+ever needed — the light-cone argument is rule-independent)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gol_tpu.models.rules import GenRule
+from gol_tpu.ops import bitgens
+from gol_tpu.ops.bitlife import WORD
+from gol_tpu.ops.pallas_bitlife import UNROLL, VMEM_BUDGET_BYTES
+
+
+def fits_pallas_gens(height: int, width: int, rule: GenRule) -> bool:
+    """Working set within the VMEM budget, with the same tile-alignment
+    gates as the two-state kernel. The kernel holds C-1 *input* refs
+    and C-1 *output* refs simultaneously (pallas_call does not alias
+    them) plus ~8 live CSA temporaries — the life model's 10x factor
+    (1 in + 1 out + 8 temps) generalizes to 2*(C-1) + 8 plane
+    equivalents, agreeing with it at C=2."""
+    if height % WORD != 0:
+        return False
+    rows = height // WORD
+    if rows % 8 != 0 or width % 128 != 0:
+        return False
+    working = rows * width * 4 * (2 * (rule.states - 1) + 8)
+    return working <= VMEM_BUDGET_BYTES
+
+
+def _gens_turn(planes: tuple, rule: GenRule) -> tuple:
+    alive = planes[0]
+    one, top = 1, WORD - 1
+    rows = alive.shape[0]
+    up = (alive << one) | (pltpu.roll(alive, 1, 0) >> top)
+    down = (alive >> one) | (pltpu.roll(alive, rows - 1, 0) << top)
+    return bitgens.step_planes(planes, rule, up, down, roll=pltpu.roll)
+
+
+def _make_kernel(n_turns: int, rule: GenRule):
+    nplanes = rule.states - 1
+
+    def body(_, planes):
+        for _ in range(UNROLL):
+            planes = _gens_turn(planes, rule)
+        return planes
+
+    def kernel(*refs):
+        planes = tuple(r[:] for r in refs[:nplanes])
+        whole, rem = divmod(n_turns, UNROLL)
+        if whole:
+            planes = lax.fori_loop(0, whole, body, planes)
+        for _ in range(rem):
+            planes = _gens_turn(planes, rule)
+        for out_ref, plane in zip(refs[nplanes:], planes):
+            out_ref[:] = plane
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("n", "rule", "interpret"))
+def step_n_packed_gens_pallas_raw(
+    planes: jax.Array,
+    n: int,
+    rule: GenRule,
+    interpret: bool = False,
+) -> jax.Array:
+    """`n` turns on stacked (C-1, rows, W) planes, one kernel call —
+    drop-in for `bitgens.step_n_packed_gens_raw` when
+    `fits_pallas_gens`."""
+    nplanes = rule.states - 1
+    shape = jax.ShapeDtypeStruct(planes.shape[1:], jnp.uint32)
+    outs = pl.pallas_call(
+        _make_kernel(n, rule),
+        out_shape=[shape] * nplanes,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * nplanes,
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * nplanes,
+        interpret=interpret,
+    )(*(planes[i] for i in range(nplanes)))
+    return jnp.stack(outs)

@@ -268,16 +268,22 @@ def _gens_stepper(rule: GenRule, devices: list) -> Stepper:
     )
 
 
-def _gens_stepper_packed(rule: GenRule, devices: list,
-                         height: int) -> Stepper:
+def _gens_stepper_packed(rule: GenRule, devices: list, height: int,
+                         width: int) -> Stepper:
     """Packed generations backend (ops/bitgens.py): one-hot dying-state
     bit-planes, the shared SWAR count machinery on the alive plane,
     aging as a free plane rename — ~the packed Life rate for any C.
-    Sharding is GSPMD over the planes' row axis (dim 1), like the dense
-    variant."""
+    Multi-turn chunks run the VMEM-resident pallas kernel
+    (ops/pallas_bitgens.py) when the plane set fits (single device, on
+    TPU), else the XLA fori_loop. Sharding is GSPMD over the planes'
+    row axis (dim 1), like the dense variant."""
     import jax.numpy as jnp
 
     from gol_tpu.ops import bitgens, bitlife, generations as gens
+    from gol_tpu.ops.pallas_bitgens import (
+        fits_pallas_gens,
+        step_n_packed_gens_pallas_raw,
+    )
 
     sharding, fetch, _sync = _gens_scaffold(
         devices, 1,
@@ -285,6 +291,19 @@ def _gens_stepper_packed(rule: GenRule, devices: list,
             bitgens.unpack_states(host, height, rule), rule
         ),
     )
+    # The pallas kernel is single-device (no shard_map wrapper for the
+    # bonus family) and compiled only on TPU, like the life kernels.
+    use_pallas = (
+        len(devices) == 1
+        and devices[0].platform == "tpu"
+        and fits_pallas_gens(height, width, rule)
+    )
+    if use_pallas:
+        raw_step_n = functools.partial(
+            step_n_packed_gens_pallas_raw, rule=rule
+        )
+    else:
+        raw_step_n = None
 
     def put(w):
         return jax.device_put(
@@ -309,15 +328,22 @@ def _gens_stepper_packed(rule: GenRule, devices: list,
         mask = bitlife.unpack(changed, height) != 0
         return new, mask, bitlife.count_packed(new[0])
 
+    if raw_step_n is not None:
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def _step_n(p, k):
+            p = raw_step_n(p, k)
+            return p, bitlife.count_packed(p[0])
+    else:
+        def _step_n(p, k):
+            return bitgens.step_n_packed_gens(p, k, rule)
+
     return Stepper(
         name=f"generations-packed-{len(devices)}",
         shards=len(devices),
         put=put,
         fetch=fetch,
         step=lambda p: _sync(_step(p)),
-        step_n=lambda p, k: _sync(
-            bitgens.step_n_packed_gens(p, int(k), rule)
-        ),
+        step_n=lambda p, k: _sync(_step_n(p, int(k))),
         step_with_diff=lambda p: _sync(_step_with_diff(p)),
         alive_count_async=lambda p: _sync(_count(p)),
         alive_mask=_gens_alive_mask,
@@ -380,7 +406,7 @@ def make_stepper(
             from gol_tpu.ops.bitlife import WORD
 
             k = largest_divisor(k, height // WORD)
-            return _gens_stepper_packed(rule, devs[:k], height)
+            return _gens_stepper_packed(rule, devs[:k], height, width)
         return _gens_stepper(rule, devs[:largest_divisor(k, height)])
     if multiprocess:
         # Round-robin across processes so the k-shard prefix spans every

@@ -322,3 +322,25 @@ def test_auto_keeps_high_state_counts_dense():
     forced = make_stepper(threads=1, height=64, width=64,
                           rule="B3/S23/C12", backend="packed")
     assert forced.name == "generations-packed-1"
+
+
+@pytest.mark.parametrize("notation", ["B2/S/C3", "B2/S345/C4", "B36/S23/C2"])
+def test_pallas_gens_kernel_interpret(notation):
+    """The VMEM-resident generations kernel (interpreter mode on CPU)
+    agrees with the XLA packed planes across the unroll boundary."""
+    from gol_tpu.ops import bitgens
+    from gol_tpu.ops.pallas_bitgens import (
+        fits_pallas_gens,
+        step_n_packed_gens_pallas_raw,
+    )
+
+    rule = get_rule(notation)
+    assert fits_pallas_gens(256, 128, rule)
+    state = random_states(rule, h=256, w=128, seed=1)
+    planes = bitgens.pack_states(state, rule)
+    for turns in (1, 11):
+        got = np.asarray(step_n_packed_gens_pallas_raw(
+            planes, turns, rule, interpret=True
+        ))
+        want = np.asarray(bitgens.step_n_packed_gens_raw(planes, turns, rule))
+        np.testing.assert_array_equal(got, want, err_msg=f"{notation}@{turns}")
