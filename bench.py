@@ -341,6 +341,19 @@ def main() -> None:
             )
         except Exception as e:
             detail["device_rates"][f"{side}x{side}"] = {"error": repr(e)}
+    # The Generations model family's fast path (one-hot planes,
+    # VMEM-resident pallas): Star Wars (C=4) at the headline size.
+    try:
+        from gol_tpu.parallel.stepper import make_stepper as _mk
+        import jax as _jax
+
+        s = _mk(threads=1, height=512, width=512, rule="B2/S345/C4",
+                devices=[_jax.devices()[0]])
+        detail["gens_512x512_B2_S345_C4"] = _sustained_rate(
+            s, 512, 2_000_000, latency
+        )
+    except Exception as e:
+        detail["gens_512x512_B2_S345_C4"] = {"error": repr(e)}
     # The sharded ring on hardware (1-device ring: same program as a
     # multi-chip mesh; delta vs device_rates = distributed overhead).
     for side, turns in ((1024, 400_000), (4096, 60_000)):
