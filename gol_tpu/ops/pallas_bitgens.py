@@ -1,16 +1,24 @@
-"""Pallas TPU kernel for packed Generations — one-hot planes in VMEM.
+"""Pallas TPU kernels for packed Generations — one-hot planes in VMEM.
 
 The XLA packed-gens loop (`ops/bitgens.py`) bounces the plane stack
-through HBM every turn; this kernel keeps all C-1 one-hot planes
-VMEM-resident for the whole multi-turn chunk, exactly as
+through HBM every turn; these kernels keep all C-1 one-hot planes
+VMEM-resident across multi-turn chunks, exactly as
 `ops/pallas_bitlife.py` does for the two-state board. Planes are
 separate 2-D refs (Mosaic-friendly), the turn body is the shared
 `bitgens.step_planes` with `pltpu.roll` primitives, and the loop uses
 the same UNROLL discipline as the life kernels.
 
-Whole-board only: a generations run that outgrows VMEM falls back to
-the XLA path (the strip-tiled construction would apply identically if
-ever needed — the light-cone argument is rule-independent)."""
+Two forms, mirroring the life kernels:
+
+- whole-board: every plane resident for the full chunk;
+- strip-tiled with deep halos: boards over the VMEM budget run as
+  row strips advancing 32·h turns per HBM pass. EVERY plane carries
+  the h-word ghost slab — the stencil itself only reads the alive
+  plane, but dead-ness (birth eligibility) reads all planes, so the
+  ghost rows of every plane feed the light cone. Validity shrinks one
+  bit-row per turn exactly as in the two-state argument.
+
+A per-plane working set that beats both falls back to the XLA path."""
 
 from __future__ import annotations
 
@@ -25,23 +33,28 @@ from jax.experimental.pallas import tpu as pltpu
 from gol_tpu.models.rules import GenRule
 from gol_tpu.ops import bitgens
 from gol_tpu.ops.bitlife import WORD
-from gol_tpu.ops.pallas_bitlife import UNROLL, VMEM_BUDGET_BYTES
+from gol_tpu.ops.pallas_bitlife import TILE_TURNS, UNROLL, VMEM_BUDGET_BYTES
 
 
 def fits_pallas_gens(height: int, width: int, rule: GenRule) -> bool:
     """Working set within the VMEM budget, with the same tile-alignment
-    gates as the two-state kernel. The kernel holds C-1 *input* refs
-    and C-1 *output* refs simultaneously (pallas_call does not alias
-    them) plus ~8 live CSA temporaries — the life model's 10x factor
-    (1 in + 1 out + 8 temps) generalizes to 2*(C-1) + 8 plane
-    equivalents, agreeing with it at C=2."""
+    gates as the two-state kernel (cost model: _plane_equivalents)."""
     if height % WORD != 0:
         return False
     rows = height // WORD
     if rows % 8 != 0 or width % 128 != 0:
         return False
-    working = rows * width * 4 * (2 * (rule.states - 1) + 8)
+    working = rows * width * 4 * _plane_equivalents(rule)
     return working <= VMEM_BUDGET_BYTES
+
+
+def _plane_equivalents(rule: GenRule) -> int:
+    """Whole-board VMEM cost in board-sized arrays: the kernel holds
+    C-1 *input* refs and C-1 *output* refs simultaneously (pallas_call
+    does not alias them) plus ~8 live CSA temporaries — the life
+    model's 10x factor (1 in + 1 out + 8 temps) generalized,
+    agreeing with it at C=2."""
+    return 2 * (rule.states - 1) + 8
 
 
 def _gens_turn(planes: tuple, rule: GenRule) -> tuple:
@@ -53,21 +66,29 @@ def _gens_turn(planes: tuple, rule: GenRule) -> tuple:
     return bitgens.step_planes(planes, rule, up, down, roll=pltpu.roll)
 
 
+def _run_gens_turns(planes: tuple, n_turns: int, rule: GenRule) -> tuple:
+    """`n_turns` in-kernel turns on a plane tuple: an UNROLL-deep loop
+    plus remainder — the gens mirror of pallas_bitlife._run_turns."""
+
+    def body(_, pl_):
+        for _ in range(UNROLL):
+            pl_ = _gens_turn(pl_, rule)
+        return pl_
+
+    whole, rem = divmod(n_turns, UNROLL)
+    if whole:
+        planes = lax.fori_loop(0, whole, body, planes)
+    for _ in range(rem):
+        planes = _gens_turn(planes, rule)
+    return planes
+
+
 def _make_kernel(n_turns: int, rule: GenRule):
     nplanes = rule.states - 1
 
-    def body(_, planes):
-        for _ in range(UNROLL):
-            planes = _gens_turn(planes, rule)
-        return planes
-
     def kernel(*refs):
         planes = tuple(r[:] for r in refs[:nplanes])
-        whole, rem = divmod(n_turns, UNROLL)
-        if whole:
-            planes = lax.fori_loop(0, whole, body, planes)
-        for _ in range(rem):
-            planes = _gens_turn(planes, rule)
+        planes = _run_gens_turns(planes, n_turns, rule)
         for out_ref, plane in zip(refs[nplanes:], planes):
             out_ref[:] = plane
 
@@ -94,3 +115,127 @@ def step_n_packed_gens_pallas_raw(
         interpret=interpret,
     )(*(planes[i] for i in range(nplanes)))
     return jnp.stack(outs)
+
+
+# --- strip-tiled form (boards over the whole-board VMEM budget) ---
+
+
+def _tiled_plane_equivalents(rule: GenRule) -> int:
+    """Tiled VMEM cost in ext-strip-sized arrays. The grid pipeline
+    DOUBLE-buffers every plane's in blocks and out strip on top of the
+    kernel's live temporaries (same effect the life kernel pins with
+    STRIP_ROWS_CAP): ~3 strip-sized buffers per plane + the CSA
+    temporaries. Empirically: the 2(C-1)+8 model admitted an 8192² C=3
+    config that compiled to 17.35 MB scoped vs the 16 MB limit; this
+    model rejects it and its accepted configs compile clean."""
+    return 3 * (rule.states - 1) + 9
+
+
+def _gens_tile_plan(rows: int, width: int, rule: GenRule,
+                    strip_rows: int | None,
+                    halo_words: int | None) -> tuple:
+    """(strip height, halo depth) for the tiled gens kernel — the
+    shared tiling policy (pallas_bitlife._tile_plan) with the
+    plane-count-scaled per-row cost."""
+    from gol_tpu.ops.pallas_bitlife import _tile_plan
+
+    return _tile_plan(
+        rows, width, strip_rows, halo_words,
+        row_cost=width * 4 * _tiled_plane_equivalents(rule),
+    )
+
+
+def fits_pallas_gens_tiled(height: int, width: int, rule: GenRule) -> bool:
+    """Tiled eligibility: tile-aligned packed shape and a minimum
+    8-row strip (plus halos) within the plane-scaled budget."""
+    if height % WORD != 0:
+        return False
+    rows = height // WORD
+    if rows % 8 != 0 or width % 128 != 0:
+        return False
+    return 10 * width * 4 * _tiled_plane_equivalents(rule) <= VMEM_BUDGET_BYTES
+
+
+def _make_tiled_kernel(k_turns: int, rule: GenRule, halo: int):
+    assert 1 <= k_turns <= TILE_TURNS * halo
+    nplanes = rule.states - 1
+
+    def kernel(*refs):
+        # Per plane: up halo block, centre strip, down halo block —
+        # grouped per plane in the in_specs order below.
+        ext = tuple(
+            jnp.concatenate(
+                [refs[3 * i][8 - halo:], refs[3 * i + 1][:],
+                 refs[3 * i + 2][:halo]],
+                axis=0,
+            )
+            for i in range(nplanes)
+        )
+        ext = _run_gens_turns(ext, k_turns, rule)
+        for i in range(nplanes):
+            refs[3 * nplanes + i][:] = ext[i][halo:-halo]
+
+    return kernel
+
+
+def _tiled_call(planes: jax.Array, k_turns: int, rule: GenRule,
+                interpret: bool, r: int, h: int):
+    nplanes, rows, width = planes.shape
+    nstrips = rows // r
+    blocks = r // 8
+    in_specs = []
+    args = []
+    for i in range(nplanes):
+        in_specs += [
+            pl.BlockSpec(
+                (8, width),
+                lambda j: (((j - 1) % nstrips) * blocks + blocks - 1, 0),
+            ),
+            pl.BlockSpec((r, width), lambda j: (j, 0)),
+            pl.BlockSpec((8, width), lambda j: (((j + 1) % nstrips) * blocks, 0)),
+        ]
+        args += [planes[i]] * 3
+    out_spec = pl.BlockSpec((r, width), lambda j: (j, 0))
+    shape = jax.ShapeDtypeStruct((rows, width), jnp.uint32)
+    outs = pl.pallas_call(
+        _make_tiled_kernel(k_turns, rule, h),
+        grid=(nstrips,),
+        in_specs=in_specs,
+        out_specs=[out_spec] * nplanes,
+        out_shape=[shape] * nplanes,
+        interpret=interpret,
+    )(*args)
+    return jnp.stack(outs)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n", "rule", "interpret", "strip_rows", "halo_words"),
+)
+def step_n_packed_gens_pallas_tiled_raw(
+    planes: jax.Array,
+    n: int,
+    rule: GenRule,
+    interpret: bool = False,
+    strip_rows: int | None = None,
+    halo_words: int | None = None,
+) -> jax.Array:
+    """`n` turns on stacked (C-1, rows, W) planes, strip-tiled with
+    h-word ghost slabs on EVERY plane — 32·h turns per HBM pass for
+    boards too big for the whole-board kernel. `strip_rows`/
+    `halo_words` override the auto sizing (tests force multi-strip
+    seams and light-cone boundaries on small boards)."""
+    _, rows, width = planes.shape
+    r, h = _gens_tile_plan(rows, width, rule, strip_rows, halo_words)
+    k = TILE_TURNS * h
+    whole, rem = divmod(n, k)
+    if whole:
+        planes = lax.fori_loop(
+            0, whole,
+            lambda _, q: _tiled_call(q, k, rule, interpret, r, h),
+            planes,
+        )
+    if rem:
+        h_rem = min(h, -(-rem // TILE_TURNS))
+        planes = _tiled_call(planes, rem, rule, interpret, r, h_rem)
+    return planes

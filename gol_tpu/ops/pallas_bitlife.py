@@ -133,13 +133,16 @@ def step_n_packed_pallas_raw(
 STRIP_ROWS_CAP = 64
 
 
-def _strip_rows(total_rows: int, width: int) -> int:
+def _strip_rows(total_rows: int, width: int,
+                row_cost: int | None = None) -> int:
     """Strip height (word rows) for the tiled kernel: largest divisor of
     `total_rows` that is a multiple of 8, within the working-set budget
-    ((R+2) x width x ~10 live arrays), and under STRIP_ROWS_CAP."""
-    budget_rows = min(
-        VMEM_BUDGET_BYTES // (width * 4 * 10) - 2, STRIP_ROWS_CAP
-    )
+    ((R+2) x `row_cost` bytes/row), and under STRIP_ROWS_CAP.
+    `row_cost` defaults to the two-state model (width x 4 x ~10 live
+    arrays); the generations kernel passes its plane-scaled cost so
+    ONE tiling policy serves both (ops/pallas_bitgens.py)."""
+    row_cost = row_cost or width * 4 * 10
+    budget_rows = min(VMEM_BUDGET_BYTES // row_cost - 2, STRIP_ROWS_CAP)
     r = 8
     for cand in range(8, total_rows + 1, 8):
         if total_rows % cand == 0 and cand <= budget_rows:
@@ -169,14 +172,16 @@ TILE_TURNS = WORD
 MAX_HALO_WORDS = 8
 
 
-def _halo_words(strip_rows: int, width: int) -> int:
+def _halo_words(strip_rows: int, width: int,
+                row_cost: int | None = None) -> int:
     """Halo depth (word-rows per side, 32*h turns per HBM pass): the
     deepest h whose extended-strip working set still fits scoped VMEM.
     Deeper halos amortize the per-pallas_call launch cost; past the
     VMEM knee the extra halo compute loses (measured: h=4 is ~7% over
     h=1 at 4096², h=8 regresses everywhere)."""
+    row_cost = row_cost or width * 4 * 10
     for h in (4, 2, 1):
-        if (strip_rows + 2 * h) * width * 4 * 10 <= VMEM_BUDGET_BYTES:
+        if (strip_rows + 2 * h) * row_cost <= VMEM_BUDGET_BYTES:
             return h
     return 1
 
@@ -201,17 +206,18 @@ def _make_tiled_kernel(k_turns: int, rule: Rule, halo: int):
 
 
 def _tile_plan(rows: int, width: int, strip_rows: int | None,
-               halo_words: int | None) -> tuple:
+               halo_words: int | None,
+               row_cost: int | None = None) -> tuple:
     """Resolve (strip height, halo depth) once — the chunk size and the
     kernel's halo are always derived from the same pair."""
-    r = strip_rows or _strip_rows(rows, width)
+    r = strip_rows or _strip_rows(rows, width, row_cost)
     if rows % r != 0 or r % 8 != 0:
         raise ValueError(
             f"strip_rows={r} must divide the packed row count {rows} and "
             "be a multiple of 8"
         )
     if halo_words is None:
-        h = _halo_words(r, width)
+        h = _halo_words(r, width, row_cost)
     elif not 1 <= halo_words <= MAX_HALO_WORDS:
         raise ValueError(
             f"halo_words={halo_words} must be in 1..{MAX_HALO_WORDS} "

@@ -273,16 +273,19 @@ def _gens_stepper_packed(rule: GenRule, devices: list, height: int,
     """Packed generations backend (ops/bitgens.py): one-hot dying-state
     bit-planes, the shared SWAR count machinery on the alive plane,
     aging as a free plane rename — ~the packed Life rate for any C.
-    Multi-turn chunks run the VMEM-resident pallas kernel
-    (ops/pallas_bitgens.py) when the plane set fits (single device, on
-    TPU), else the XLA fori_loop. Sharding is GSPMD over the planes'
-    row axis (dim 1), like the dense variant."""
+    Multi-turn chunks run the pallas kernels (ops/pallas_bitgens.py)
+    single-device on TPU — whole-board when every plane fits VMEM,
+    strip-tiled with per-plane ghost slabs otherwise — and the XLA
+    fori_loop elsewhere. Sharding is GSPMD over the planes' row axis
+    (dim 1), like the dense variant."""
     import jax.numpy as jnp
 
     from gol_tpu.ops import bitgens, bitlife, generations as gens
     from gol_tpu.ops.pallas_bitgens import (
         fits_pallas_gens,
+        fits_pallas_gens_tiled,
         step_n_packed_gens_pallas_raw,
+        step_n_packed_gens_pallas_tiled_raw,
     )
 
     sharding, fetch, _sync = _gens_scaffold(
@@ -291,19 +294,20 @@ def _gens_stepper_packed(rule: GenRule, devices: list, height: int,
             bitgens.unpack_states(host, height, rule), rule
         ),
     )
-    # The pallas kernel is single-device (no shard_map wrapper for the
-    # bonus family) and compiled only on TPU, like the life kernels.
-    use_pallas = (
-        len(devices) == 1
-        and devices[0].platform == "tpu"
-        and fits_pallas_gens(height, width, rule)
-    )
-    if use_pallas:
-        raw_step_n = functools.partial(
-            step_n_packed_gens_pallas_raw, rule=rule
-        )
-    else:
-        raw_step_n = None
+    # The pallas kernels are single-device (no shard_map wrapper for
+    # the bonus family) and compiled only on TPU, like the life
+    # kernels: whole-board when every plane fits VMEM, strip-tiled
+    # with per-plane ghost slabs otherwise.
+    raw_step_n = None
+    if len(devices) == 1 and devices[0].platform == "tpu":
+        if fits_pallas_gens(height, width, rule):
+            raw_step_n = functools.partial(
+                step_n_packed_gens_pallas_raw, rule=rule
+            )
+        elif fits_pallas_gens_tiled(height, width, rule):
+            raw_step_n = functools.partial(
+                step_n_packed_gens_pallas_tiled_raw, rule=rule
+            )
 
     def put(w):
         return jax.device_put(

@@ -344,3 +344,24 @@ def test_pallas_gens_kernel_interpret(notation):
         ))
         want = np.asarray(bitgens.step_n_packed_gens_raw(planes, turns, rule))
         np.testing.assert_array_equal(got, want, err_msg=f"{notation}@{turns}")
+
+
+@pytest.mark.parametrize("halo,turns", [
+    (1, 31), (1, 33), (2, 64), (4, 129), (None, 100),
+])
+def test_pallas_gens_tiled_interpret(halo, turns):
+    """The strip-tiled gens kernel (interpreter mode): 768 rows = 24
+    word rows at strip_rows=8 forces 3 strips, so every plane's
+    cross-strip ghost fetch and the per-depth light-cone boundaries are
+    genuinely exercised against the XLA planes."""
+    from gol_tpu.ops import bitgens
+    from gol_tpu.ops.pallas_bitgens import step_n_packed_gens_pallas_tiled_raw
+
+    rule = get_rule("B2/S345/C4")
+    state = random_states(rule, h=768, w=128, seed=2)
+    planes = bitgens.pack_states(state, rule)
+    got = np.asarray(step_n_packed_gens_pallas_tiled_raw(
+        planes, turns, rule, interpret=True, strip_rows=8, halo_words=halo
+    ))
+    want = np.asarray(bitgens.step_n_packed_gens_raw(planes, turns, rule))
+    np.testing.assert_array_equal(got, want)
