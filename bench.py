@@ -265,27 +265,74 @@ def measure_first_report() -> float:
 
 
 def measure_diff_rate(latency: float) -> dict:
-    """Live-view (per-turn diff) kernel rate: chained step_with_diff —
-    new world + flipped-cell mask + count every turn — realized once.
-    Quantifies the on-device cost of the SDL live view the reference
-    extension asks to measure (ref: README.md:257-259); shipping a mask
-    to the host adds one link round trip per rendered frame on top."""
-    import jax
+    """Live-view (per-turn diff) path, measured in its two tiers
+    (VERDICT r3 next-round #1: device-accumulated packed diffs):
 
+    - kernel: chained `step_n_with_diffs` dispatches — every turn's
+      packed XOR flip mask is computed and stacked ON DEVICE — realized
+      once. This is the rate ceiling the device imposes on a watched
+      run (the old per-turn `step_with_diff` chain paid a dispatch per
+      turn and measured 2,941 turns/s; the accumulated stack removes
+      that wall entirely).
+    - delivered: the full engine-shaped path — fetch each chunk's
+      (k, H/32, W) stack over the host link and expand every turn to
+      its flipped-Cell batch with NumPy. On a tunnel-attached TPU this
+      tier is LINK-BOUND: the packed masks are 8x smaller than dense
+      bools, but a ~10 MB/s control tunnel caps delivery at
+      (link bytes/s) / (H/32*W*4 bytes/turn) regardless of software.
+      `link_bytes_per_turn` is reported so the bound is checkable.
+
+    Quantifies the SDL live view the reference extension asks to
+    measure (ref: README.md:257-259)."""
+    import jax
+    import numpy as np
+
+    from gol_tpu.engine.distributor import DIFF_CHUNK
+    from gol_tpu.ops.bitlife import unpack_np
     from gol_tpu.parallel.stepper import make_stepper
+    from gol_tpu.utils.cell import cells_from_mask
 
     stepper = make_stepper(threads=1, height=H, width=W,
                            devices=[jax.devices()[0]])
     p = stepper.put(_world(W))
-    turns = 2_000
-    p, mask, count = stepper.step_with_diff(p)  # warm
+
+    # Tier 1: device kernel rate (diff stacks produced, realized once).
+    k, chains = 2_000, 10
+    q, diffs, count = stepper.step_n_with_diffs(p, k)  # warm + compile
     int(count)
     t0 = time.perf_counter()
-    for _ in range(turns):
-        p, mask, count = stepper.step_with_diff(p)
+    q = p
+    for _ in range(chains):
+        q, diffs, count = stepper.step_n_with_diffs(q, k)
     int(count)
     dt = time.perf_counter() - t0 - latency
-    return {"turns_per_sec": round(turns / dt, 1)}
+    kernel = {"turns_per_sec": round(chains * k / dt, 1), "chunk": k}
+
+    # Tier 2: delivered — one fetch per chunk + NumPy expansion to
+    # per-turn flip batches (the engine's exact consumption pattern).
+    kd, chunks = DIFF_CHUNK, 4
+    q, diffs, count = stepper.step_n_with_diffs(p, kd)  # warm this k
+    int(count)
+    q, total_flips, bytes_per_turn = p, 0, None
+    t0 = time.perf_counter()
+    for _ in range(chunks):
+        q, diffs, count = stepper.step_n_with_diffs(q, kd)
+        host = np.asarray(diffs)
+        host = host.copy()  # force materialization (lazy on axon)
+        bytes_per_turn = host.nbytes // kd
+        for i in range(kd):
+            row = host[i]
+            mask = unpack_np(row, H) if row.dtype == np.uint32 else row
+            total_flips += len(cells_from_mask(mask))
+    dt = time.perf_counter() - t0
+    delivered = {
+        "turns_per_sec": round(chunks * kd / dt, 1),
+        "chunk": kd,
+        "link_bytes_per_turn": bytes_per_turn,
+        "flips_per_turn": round(total_flips / (chunks * kd), 1),
+    }
+    return {"kernel": kernel, "delivered": delivered,
+            "turns_per_sec": kernel["turns_per_sec"]}
 
 
 def expected_alive() -> int | None:

@@ -136,12 +136,13 @@ def halo_step_bits_uneven(
     return jnp.where(row_ids < real, new, jnp.zeros_like(new))
 
 
-def _ring_stepper(name: str, devices: list, step_n, put, fetch):
+def _ring_stepper(name: str, devices: list, step_n, put, fetch,
+                  fetch_diffs=None):
     """Common wiring of both dense ring builders: single-turn wrappers
     derived from `step_n`, the async count, CPU-mesh serialization, and
     the Stepper assembly — one definition, so the even (deep-halo) and
     uneven (balanced-split) variants cannot drift apart here."""
-    from gol_tpu.parallel.stepper import Stepper
+    from gol_tpu.parallel.stepper import Stepper, scan_diffs
 
     @jax.jit
     def step(world):
@@ -156,6 +157,12 @@ def _ring_stepper(name: str, devices: list, step_n, put, fetch):
     def count(world):
         return jnp.sum(world != 0, dtype=jnp.int32)
 
+    # Per-turn halos inside one scanned program: the unused per-turn
+    # psum count inside step_n(·, 1) is dead code XLA prunes. Diffs
+    # stack sharded along their row axis; the engine gathers once.
+    _snd = scan_diffs(lambda w: step_n(w, 1)[0],
+                      lambda old, new: old != new, count)
+
     _sync = cpu_serializing_sync(devices)
 
     return Stepper(
@@ -167,6 +174,8 @@ def _ring_stepper(name: str, devices: list, step_n, put, fetch):
         step_n=lambda w, k: _sync(step_n(w, int(k))),
         step_with_diff=lambda w: _sync(step_with_diff(w)),
         alive_count_async=lambda w: _sync(count(w)),
+        step_n_with_diffs=lambda w, k: _sync(_snd(w, int(k))),
+        fetch_diffs=fetch_diffs,
     )
 
 
@@ -225,6 +234,7 @@ def sharded_stepper(rule: Rule, devices: list, height: int):
         f"halo-ring-{n}", devices, step_n,
         put=lambda w: spmd_put(sharding, np.asarray(w, np.uint8)),
         fetch=spmd_fetch,
+        fetch_diffs=spmd_fetch,
     )
 
 
@@ -279,4 +289,15 @@ def _sharded_stepper_uneven(rule: Rule, devices: list, height: int):
             [host[i * strip : i * strip + real[i]] for i in range(n)]
         )
 
-    return _ring_stepper(f"halo-ring-uneven-{n}", devices, step_n, put, fetch)
+    def fetch_diffs(d):
+        # (k, n*strip, W) padded diff stack -> (k, H, W): padding rows
+        # are dead on both sides of every turn, but their positions must
+        # still be cut out so row indices map to global y coordinates.
+        host = spmd_fetch(d)
+        return np.concatenate(
+            [host[:, i * strip : i * strip + real[i]] for i in range(n)],
+            axis=1,
+        )
+
+    return _ring_stepper(f"halo-ring-uneven-{n}", devices, step_n, put,
+                         fetch, fetch_diffs)

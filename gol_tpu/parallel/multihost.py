@@ -130,6 +130,7 @@ def device_count() -> int:
 
 _OP_PUT, _OP_STEP, _OP_STEP_N, _OP_DIFF, _OP_COUNT = 0, 1, 2, 3, 4
 _OP_FETCH_WORLD, _OP_FETCH_MASK, _OP_STOP = 5, 6, 7
+_OP_STEP_N_DIFFS, _OP_FETCH_DIFFS = 8, 9
 
 
 def _bcast(value: np.ndarray) -> np.ndarray:
@@ -234,6 +235,21 @@ def spmd_stepper(inner):
             _bcast_cmd(_OP_FETCH_WORLD)
         return inner.fetch(arr)
 
+    step_n_with_diffs = None
+    if inner.step_n_with_diffs is not None:
+        def step_n_with_diffs(world, k):
+            _bcast_cmd(_OP_STEP_N_DIFFS, int(k))
+            return inner.step_n_with_diffs(world, int(k))
+
+    fetch_diffs = None
+    if inner.step_n_with_diffs is not None:
+        def fetch_diffs(diffs):
+            # The diff stack is told apart from worlds/masks by its own
+            # opcode: workers keep the latest stack and gather theirs.
+            _bcast_cmd(_OP_FETCH_DIFFS)
+            inner_fd = inner.fetch_diffs or np.asarray
+            return inner_fd(diffs)
+
     return Stepper(
         name=f"spmd-{inner.name}",
         shards=inner.shards,
@@ -243,6 +259,11 @@ def spmd_stepper(inner):
         step_n=step_n,
         step_with_diff=step_with_diff,
         alive_count_async=alive_count_async,
+        # Host-side level translation, no dispatch — passes through
+        # unmirrored (the generations family's alive-vs-dying split).
+        alive_mask=inner.alive_mask,
+        step_n_with_diffs=step_n_with_diffs,
+        fetch_diffs=fetch_diffs,
     )
 
 
@@ -252,6 +273,7 @@ def spmd_worker_loop(inner, height: int, width: int) -> None:
     the coordinator exits, which tears down the distributed client)."""
     state = None
     mask = None
+    diffs = None
     while True:
         op, arg = _bcast_cmd(_OP_STOP)
         if op == _OP_PUT:
@@ -263,12 +285,16 @@ def spmd_worker_loop(inner, height: int, width: int) -> None:
             state, _ = inner.step_n(state, arg)
         elif op == _OP_DIFF:
             state, mask, _ = inner.step_with_diff(state)
+        elif op == _OP_STEP_N_DIFFS:
+            state, diffs, _ = inner.step_n_with_diffs(state, arg)
         elif op == _OP_COUNT:
             inner.alive_count_async(state)
         elif op == _OP_FETCH_WORLD:
             inner.fetch(state)
         elif op == _OP_FETCH_MASK:
             inner.fetch(mask)
+        elif op == _OP_FETCH_DIFFS:
+            (inner.fetch_diffs or np.asarray)(diffs)
         elif op == _OP_STOP:
             return
 

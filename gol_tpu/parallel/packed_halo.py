@@ -254,6 +254,20 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
             return bitlife.unpack_np(spmd_fetch(arr), height)
         return spmd_fetch(arr)
 
+    from gol_tpu.parallel.stepper import scan_diffs
+
+    # Per-turn ring halos inside one scanned program; the diff stack
+    # stays packed (k, H/32, W) and word-row-sharded until the engine's
+    # single gather. (Per-turn halo exchange, not deep blocks: the diff
+    # path needs every intermediate board anyway.)
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec
+    )
+    def _one_turn(block):
+        return halo_step_packed(block, rule)
+
+    _snd = scan_diffs(_one_turn, lambda old, new: old ^ new, count)
+
     _sync = cpu_serializing_sync(devices)
 
     return Stepper(
@@ -265,4 +279,6 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
         step_n=lambda p, k: _sync(step_n(p, int(k))),
         step_with_diff=lambda p: _sync(step_with_diff(p)),
         alive_count_async=lambda p: _sync(count(p)),
+        step_n_with_diffs=lambda p, k: _sync(_snd(p, int(k))),
+        fetch_diffs=spmd_fetch,
     )

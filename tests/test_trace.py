@@ -20,18 +20,47 @@ def make_params(golden_root, tmp_path, **kw):
     return Params(**defaults)
 
 
-def test_timeline_records_per_turn_diff_spans(golden_root, tmp_path):
+def test_timeline_records_diff_chunk_spans(golden_root, tmp_path):
     """The reference traces a 64x64, 10-turn, 4-worker run
-    (ref: trace_test.go:13-18); same shape here, diff path."""
-    p = make_params(golden_root, tmp_path)
+    (ref: trace_test.go:13-18); same shape here, watched (diff) path.
+    The device-accumulated diff path runs all 10 turns as ONE dispatch
+    whose span carries the whole chunk. (Params' default chunk=1 keeps
+    the reference's per-turn cadence; chunk=0 lifts the cap.)"""
+    p = make_params(golden_root, tmp_path, chunk=0)
     engine, tl = profile_run(p, emit_flips=True)
     assert engine.error is None
     spans = tl.spans
-    assert [s.turn for s in spans] == list(range(1, 11))
-    assert all(s.kind == "diff" and s.turns == 1 and s.seconds > 0 for s in spans)
+    assert [(s.turn, s.turns) for s in spans] == [(10, 10)]
+    assert all(s.kind == "diffs" and s.seconds > 0 for s in spans)
     s = tl.summary()
-    assert s["dispatches"] == 10 and s["turns"] == 10
+    assert s["dispatches"] == 1 and s["turns"] == 10
     assert 0 < s["busy_seconds"] <= s["wall_seconds"]
+
+
+def test_timeline_records_per_turn_diff_spans_legacy(golden_root, tmp_path):
+    """A stepper without step_n_with_diffs falls back to the per-turn
+    diff path, whose spans stay one-per-turn."""
+    import dataclasses
+
+    from gol_tpu.engine.distributor import Engine
+    from gol_tpu.parallel.stepper import make_stepper
+    from gol_tpu.utils.trace import Timeline
+
+    p = make_params(golden_root, tmp_path)
+    stepper = dataclasses.replace(
+        make_stepper(threads=p.threads, height=64, width=64),
+        step_n_with_diffs=None,
+    )
+    tl = Timeline()
+    engine = Engine(p, emit_flips=True, stepper=stepper, timeline=tl)
+    engine.start()
+    engine.join(timeout=300)
+    assert engine.error is None
+    assert [s.turn for s in tl.spans] == list(range(1, 11))
+    assert all(
+        s.kind == "diff" and s.turns == 1 and s.seconds > 0
+        for s in tl.spans
+    )
 
 
 def test_timeline_records_chunk_spans_and_dump(golden_root, tmp_path):
