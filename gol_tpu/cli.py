@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import queue
 import sys
 import threading
@@ -93,6 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run as a headless engine server on this address")
     ap.add_argument("--connect", default=None, metavar="HOST:PORT",
                     help="run as a controller attached to a remote engine")
+    ap.add_argument("--secret", default=os.environ.get("GOL_SECRET"),
+                    metavar="TOKEN",
+                    help="shared secret for --serve/--connect: a serving "
+                         "engine rejects attaches whose hello carries a "
+                         "different token (defaults to $GOL_SECRET; unset "
+                         "means unauthenticated)")
     ap.add_argument("--resume", default=None, metavar="SNAPSHOT.pgm",
                     help="resume from an out/ snapshot, continuing at "
                          "the turn encoded in its filename; 'latest' "
@@ -177,17 +184,17 @@ def main(argv: Optional[list[str]] = None) -> int:
                   file=sys.stderr)
             args.novis = True
 
-    # Headless engines (noVis drain or server) default to the fused-chunk
-    # fast path with auto-calibrated chunk size; a local visualiser needs
-    # per-turn diffs, so chunk 1.
-    headless = args.novis or args.serve is not None
-    chunk = args.chunk if args.chunk is not None else (0 if headless else 1)
+    # All engines default to chunk 0 (no cap): headless runs
+    # auto-calibrate their fused dispatches, and a local visualiser
+    # rides the device-accumulated diff path, which self-chunks
+    # (engine DIFF_CHUNK) — an explicit --chunk bounds both.
+    chunk = args.chunk if args.chunk is not None else 0
     params = Params(
         turns=args.turns,
         threads=args.t,
         image_width=args.w,
         image_height=args.h,
-        rule=args.rule,
+        rule=rule_obj,
         backend=args.backend,
         chunk=chunk,
         tick_seconds=args.tick,
@@ -320,14 +327,16 @@ def _serve(args, params: Params, resume_path: Optional[str] = None) -> int:
     """Headless engine server (the reference's AWS-side node,
     ref: README.md:157-175).
 
-    Binds loopback unless an explicit HOST is given: the control
-    protocol is unauthenticated (any peer that can connect may pull
-    board state or send the 'k' kill verb), so exposure must be a
-    deliberate choice, e.g. `--serve 0.0.0.0:8030`."""
+    Binds loopback unless an explicit HOST is given, and --secret (or
+    $GOL_SECRET) authenticates attaches — without it any peer that can
+    connect may pull board state or send the 'k' kill verb, so non-
+    loopback exposure should pair `--serve 0.0.0.0:8030` with a
+    secret."""
     from gol_tpu.distributed import EngineServer
 
     host, port = _addr(args.serve, default_host="127.0.0.1")
-    server = EngineServer(params, host, port, resume_from=resume_path)
+    server = EngineServer(params, host, port, resume_from=resume_path,
+                          secret=args.secret)
     print(f"engine serving on {server.address[0]}:{server.address[1]}")
     server.start()
     try:
@@ -350,7 +359,8 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
     from gol_tpu.distributed import Controller
 
     host, port = _addr(args.connect)
-    ctl = Controller(host, port, want_flips=not args.novis)
+    ctl = Controller(host, port, want_flips=not args.novis,
+                     secret=args.secret)
 
     class _WireKeys:
         """queue.Queue-shaped sink that forwards verbs over the wire —

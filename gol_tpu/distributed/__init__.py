@@ -1,7 +1,17 @@
 """Distributed split: engine server ⇄ controller client over TCP
 (the working version of the reference's RPC scaffolding, SURVEY.md §2 C11)."""
 
-from gol_tpu.distributed.client import Controller, ServerBusyError
+from gol_tpu.distributed.client import (
+    Controller,
+    ServerBusyError,
+    UnauthorizedError,
+)
 from gol_tpu.distributed.server import EngineServer, snapshot_turn
 
-__all__ = ["Controller", "EngineServer", "ServerBusyError", "snapshot_turn"]
+__all__ = [
+    "Controller",
+    "EngineServer",
+    "ServerBusyError",
+    "UnauthorizedError",
+    "snapshot_turn",
+]

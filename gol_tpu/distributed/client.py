@@ -32,6 +32,10 @@ class ServerBusyError(ConnectionError):
     """The engine already has a controller attached."""
 
 
+class UnauthorizedError(ConnectionError):
+    """The engine requires a shared secret this controller lacks."""
+
+
 class Controller:
     def __init__(
         self,
@@ -40,6 +44,7 @@ class Controller:
         *,
         want_flips: bool = True,
         timeout: float = 30.0,
+        secret: "str | None" = None,
     ):
         self.events = EventQueue()
         #: Board state from the attach sync (None until it arrives).
@@ -56,7 +61,13 @@ class Controller:
         # handshake failure closes the socket and the event stream.
         self._sock = socket.create_connection((host, port), timeout=timeout)
         try:
-            wire.send_msg(self._sock, {"t": "hello", "want_flips": want_flips})
+            # "compact" advertises the zlib'd-int32 flips encoding; a
+            # server that predates it just ignores the field and sends
+            # legacy JSON pairs (decodable either way).
+            hello = {"t": "hello", "want_flips": want_flips, "compact": True}
+            if secret is not None:
+                hello["secret"] = secret
+            wire.send_msg(self._sock, hello)
             first = wire.recv_msg(self._sock)
         except (TimeoutError, wire.WireError, OSError) as e:
             self.close()
@@ -66,7 +77,10 @@ class Controller:
         self._sock.settimeout(None)
         if first is not None and first.get("t") == "error":
             self.close()
-            raise ServerBusyError(first.get("reason", "rejected"))
+            reason = first.get("reason", "rejected")
+            if reason == "unauthorized":
+                raise UnauthorizedError(reason)
+            raise ServerBusyError(reason)
         self._reader = threading.Thread(
             target=self._reader_loop, args=(first,), name="gol-ctl-reader",
             daemon=True,

@@ -26,6 +26,7 @@ capability, working:
 from __future__ import annotations
 
 import contextlib
+import hmac
 import itertools
 import logging
 import queue
@@ -51,7 +52,8 @@ class _Conn:
 
     _next_token = itertools.count(1).__next__  # only the accept thread draws
 
-    def __init__(self, sock: socket.socket, want_flips: bool):
+    def __init__(self, sock: socket.socket, want_flips: bool,
+                 compact: bool = False):
         self.sock = sock
         # Send-side timeout only (SO_SNDTIMEO, not settimeout: the read
         # side must keep blocking forever — controllers send verbs
@@ -65,6 +67,10 @@ class _Conn:
             struct.pack("ll", 30, 0),
         )
         self.want_flips = want_flips
+        #: Peer advertised the zlib'd-int32 flips encoding in its hello;
+        #: older controllers get legacy JSON pair lists (the skew the
+        #: serve/connect split exists for runs both ways).
+        self.compact = compact
         #: Matches this connection to the BoardSync it requested.
         self.token = _Conn._next_token()
         # No events flow until this connection's BoardSync has been sent:
@@ -94,9 +100,16 @@ class EngineServer:
         port: int = 8030,
         *,
         resume_from: Optional[str] = None,
+        secret: Optional[str] = None,
         **engine_kwargs,
     ):
         self.params = params
+        #: Shared-secret attach token. When set, a hello whose "secret"
+        #: does not match is rejected and logged — the board state and
+        #: the 'k' kill verb are not for any peer that can reach the
+        #: port (the reference's open :8030 listener,
+        #: ref: gol/distributor.go:49-52, is a flaw to beat, not match).
+        self._secret = secret
         if resume_from is not None:
             engine_kwargs.setdefault("initial_world", read_pgm(resume_from))
             engine_kwargs.setdefault("start_turn", snapshot_turn(resume_from))
@@ -160,7 +173,26 @@ class EngineServer:
                 sock.close()
                 continue
 
-            conn = _Conn(sock, bool(hello.get("want_flips", False)))
+            # Compare as UTF-8 bytes: compare_digest on str raises
+            # TypeError for non-ASCII input, and the secret here is
+            # attacker-controlled — a unicode probe must be a clean
+            # rejection, not a dead accept thread.
+            if self._secret is not None and not hmac.compare_digest(
+                str(hello.get("secret", "")).encode("utf-8", "replace"),
+                self._secret.encode("utf-8", "replace"),
+            ):
+                log.warning(
+                    "rejecting unauthenticated attach from %s", addr
+                )
+                with contextlib.suppress(Exception):
+                    wire.send_msg(
+                        sock, {"t": "error", "reason": "unauthorized"}
+                    )
+                sock.close()
+                continue
+
+            conn = _Conn(sock, bool(hello.get("want_flips", False)),
+                         compact=bool(hello.get("compact", False)))
             with self._conn_lock:
                 if self._conn is not None:
                     busy = True
@@ -292,7 +324,12 @@ class EngineServer:
                 if not conn.synced:
                     continue  # pre-sync events are not this controller's
                 if flips and isinstance(ev, TurnComplete):
-                    conn.send({"t": "flips", "turn": flips_turn, "cells": flips})
+                    conn.send(
+                        wire.flips_to_msg(flips_turn, flips)
+                        if conn.compact
+                        else {"t": "flips", "turn": flips_turn,
+                              "cells": flips}
+                    )
                     flips.clear()
                 conn.send(wire.event_to_msg(ev))
             except (wire.WireError, OSError):
