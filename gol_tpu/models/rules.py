@@ -107,6 +107,8 @@ RULES = {
 
 def get_rule(notation: str):
     """Resolve B/S (life-like `Rule`) or B/S/C (`GenRule`) notation."""
+    notation = notation.strip()  # both parsers strip; the named lookup
+    # must too, or ' B3/S23 ' would return a fresh non-identical Rule
     named = RULES.get(notation.upper())
     if named is not None:
         return named

@@ -31,9 +31,11 @@ class Params:
     image_height: int = 512
 
     # --- TPU-native knobs (no reference analog) ---
-    # Cellular-automaton rule, B/S notation. "B3/S23" is Conway Life
-    # (ref: gol/distributor.go:325-342).
-    rule: str = "B3/S23"
+    # Cellular-automaton rule: B/S notation, or an already-resolved
+    # models.rules Rule/GenRule (the CLI resolves once and passes the
+    # object through, so validation happens at exactly one site).
+    # "B3/S23" is Conway Life (ref: gol/distributor.go:325-342).
+    rule: "str | object" = "B3/S23"
     # Max turns fused into one on-device lax.fori_loop dispatch when no
     # per-turn event consumer is attached. 1 reproduces the reference's
     # per-turn host cadence exactly. 0 = auto: the engine repeatedly
