@@ -145,9 +145,12 @@ def measure_device_rate(side: int, turns: int, latency: float,
 
 def _sustained_rate(stepper, side: int, turns: int, latency: float) -> dict:
     """Sustained turns/s of any Stepper at side²: warm once, chain
-    dispatches, realize once, subtract the measured link latency."""
+    dispatches, realize once, subtract the measured link latency.
+    Dispatches are large (100k turns where the budget allows): each
+    dispatch is an RPC through the tunnel, and 25k-turn chunks at the
+    512² kernel rate made dispatch overhead ~10% of the measurement."""
     p = stepper.put(_world(side))
-    n = min(25_000, turns)
+    n = min(100_000, turns)
     k = max(1, turns // n)
     int(stepper.step_n(p, n)[1])
     t0 = time.perf_counter()
@@ -220,12 +223,14 @@ def measure_engine_rate(headline_tps: float) -> dict:
     # The long run must dwarf the short one: the marginal rate divides
     # by (t_long - t_short), and a small delta drowns in run-to-run
     # noise (an early version with a 1M-turn spread measured a marginal
-    # above the kernel rate — impossible, pure noise).
+    # above the kernel rate — impossible, pure noise). Each timing is
+    # best-of-2: the tunnel adds ~±0.1 s of positive jitter per run,
+    # which on a ~0.6 s delta is a ±15% swing that min() mostly cancels.
     short_turns, long_turns = 200_000, 4_200_000
     with tempfile.TemporaryDirectory() as out:
         one_run(short_turns, out)          # warm every program the engine uses
-        t_short = one_run(short_turns, out)
-        t_long = one_run(long_turns, out)
+        t_short = min(one_run(short_turns, out) for _ in range(2))
+        t_long = min(one_run(long_turns, out) for _ in range(2))
     marginal = (long_turns - short_turns) / max(t_long - t_short, 1e-9)
     return {
         "end_to_end": {
@@ -388,19 +393,36 @@ def main() -> None:
             )
         except Exception as e:
             detail["device_rates"][f"{side}x{side}"] = {"error": repr(e)}
-    # The Generations model family's fast path (one-hot planes,
-    # VMEM-resident pallas): Star Wars (C=4) at the headline size.
-    try:
-        from gol_tpu.parallel.stepper import make_stepper as _mk
-        import jax as _jax
+    # The Generations model family's fast paths (one-hot planes,
+    # VMEM-resident pallas): Star Wars (C=4) at the headline size,
+    # Brian's Brain (C=3) at the strip-tiled 8192² scale, and the
+    # sharded packed-plane ring on hardware (1-device ring: the same
+    # program as a multi-chip gens mesh).
+    from gol_tpu.parallel.stepper import make_stepper as _mk
+    import jax as _jax
 
-        s = _mk(threads=1, height=512, width=512, rule="B2/S345/C4",
-                devices=[_jax.devices()[0]])
-        detail["gens_512x512_B2_S345_C4"] = _sustained_rate(
-            s, 512, 2_000_000, latency
+    for key, side, rule_s, turns in (
+        ("gens_512x512_B2_S345_C4", 512, "B2/S345/C4", 2_000_000),
+        ("gens_8192x8192_B2_S_C3", 8192, "B2/S/C3", 25_000),
+    ):
+        try:
+            s = _mk(threads=1, height=side, width=side, rule=rule_s,
+                    devices=[_jax.devices()[0]])
+            detail[key] = _sustained_rate(s, side, turns, latency)
+        except Exception as e:
+            detail[key] = {"error": repr(e)}
+    try:
+        from gol_tpu.models.rules import get_rule
+        from gol_tpu.parallel.gens_halo import packed_gens_sharded_stepper
+
+        s = packed_gens_sharded_stepper(
+            get_rule("B2/S345/C4"), [_jax.devices()[0]], 512
+        )
+        detail["gens_ring1_512x512_B2_S345_C4"] = _sustained_rate(
+            s, 512, 500_000, latency
         )
     except Exception as e:
-        detail["gens_512x512_B2_S345_C4"] = {"error": repr(e)}
+        detail["gens_ring1_512x512_B2_S345_C4"] = {"error": repr(e)}
     # The sharded ring on hardware (1-device ring: same program as a
     # multi-chip mesh; delta vs device_rates = distributed overhead).
     for side, turns in ((1024, 400_000), (4096, 60_000)):

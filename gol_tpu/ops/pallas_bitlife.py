@@ -287,6 +287,170 @@ def step_n_packed_pallas_tiled_raw(
     return p
 
 
+# --- 2-D tiled form (very wide boards) -------------------------------------
+#
+# The 1-D tiled kernel's strip height is bounded by VMEM *per row*
+# (width x 4 x ~10 live arrays), so very wide boards get thin strips —
+# and thin strips run far below the wide-op rate (measured at 2048²:
+# r=16 strips reach 0.58x the whole-board kernel, r=64 strips 0.83x;
+# the same short-dependency-chain wall as a small whole board). Tiling
+# the WIDTH as well restores 64-row ops regardless of board width: each
+# (r x TILE2D_WIDTH) tile is ghost-extended by h word-rows vertically
+# AND TILE2D_GHOST_LANES columns horizontally (the horizontal light
+# cone advances one column per turn, so 128 ghost columns match the
+# 32*h turns of an h=4 ghost slab), with the 8 neighbour tiles'
+# edges assembled in-kernel from nine block views of the same board.
+
+#: Lane width of a 2-D tile (multiple of 128). 4096 measured 2.41
+#: Tcells/s at 16384² vs 2.29 for 2048 (narrower tiles pay more column-
+#: ghost redundancy); its working set only compiles because the edge
+#: fetches are narrow TILE2D_FETCH_LANES blocks.
+TILE2D_WIDTH = 4096
+#: Ghost columns per side — one turn of horizontal light cone each.
+TILE2D_GHOST_LANES = 128
+#: Lane width of the neighbour-edge fetch blocks (the ghosts are
+#: sliced from these in-kernel; a wider-than-ghost fetch keeps the
+#: block shapes comfortably vreg-aligned).
+TILE2D_FETCH_LANES = 512
+
+
+def fits_pallas_packed_tiled2d(height: int, width: int) -> bool:
+    """2-D tiling eligibility: packed tile alignment in both dims and a
+    board wide enough that the 1-D strip budget is the binding
+    constraint (narrower boards do better on the 1-D kernel's full-
+    width strips)."""
+    if height % WORD != 0:
+        return False
+    rows = height // WORD
+    return (
+        rows % 8 == 0
+        and width % TILE2D_WIDTH == 0
+        and width > TILE2D_WIDTH
+        and rows >= 8
+    )
+
+
+def _tile2d_rows(total_rows: int) -> int:
+    """Tile height (word rows): the 1-D strip search at the 2-D tile's
+    fixed extended width — the per-row VMEM cost is width-independent
+    here, so this resolves to the largest divisor of `total_rows` that
+    is a multiple of 8 under STRIP_ROWS_CAP."""
+    return _strip_rows(total_rows, TILE2D_WIDTH + 2 * TILE2D_GHOST_LANES)
+
+
+def _make_tiled2d_kernel(k_turns: int, rule: Rule, halo: int, hw: int):
+    assert 1 <= k_turns <= min(TILE_TURNS * halo, hw)
+    assert 1 <= halo <= MAX_HALO_WORDS
+
+    def kernel(ul_ref, ub_ref, ur_ref, l_ref, c_ref, r_ref,
+               dl_ref, db_ref, dr_ref, out_ref):
+        # Assemble the ghost frame: 8-row bands from the tile row above
+        # and below (sliced to `halo` rows) and hw-lane edge blocks from
+        # the horizontal neighbours — corners come from the diagonal
+        # tiles, which the 8-neighbour stencil genuinely needs. All
+        # ghost views are fetched as narrow blocks (hw lanes / 8 rows),
+        # so the pipeline buffers stay small next to the extended tile.
+        top = jnp.concatenate(
+            [ul_ref[8 - halo:, -hw:], ub_ref[8 - halo:, :],
+             ur_ref[8 - halo:, :hw]], axis=1,
+        )
+        mid = jnp.concatenate(
+            [l_ref[:, -hw:], c_ref[:], r_ref[:, :hw]], axis=1
+        )
+        bot = jnp.concatenate(
+            [dl_ref[:halo, -hw:], db_ref[:halo, :], dr_ref[:halo, :hw]],
+            axis=1,
+        )
+        p_ext = jnp.concatenate([top, mid, bot], axis=0)
+        # Toroidal wrap on the extended tile feeds garbage into the
+        # outermost ghost ring only, advancing one row/column per turn
+        # — the interior stays exact for k_turns <= min(32*halo, hw).
+        out_ref[:] = _run_turns(p_ext, k_turns, rule)[halo:-halo, hw:-hw]
+
+    return kernel
+
+
+def _tiled2d_call(p: jax.Array, k_turns: int, rule: Rule, interpret: bool,
+                  r: int, h: int):
+    rows, width = p.shape
+    wt, hw = TILE2D_WIDTH, TILE2D_GHOST_LANES
+    fw = TILE2D_FETCH_LANES
+    n, m = rows // r, width // wt
+    blocks = r // 8   # vertical ghost fetches are single 8-sublane blocks
+    lanes = wt // fw  # fetch-width units per tile (edge fetches narrow)
+
+    def row_block(di, i):
+        return ((i + di) % n) * blocks + (blocks - 1 if di < 0 else 0)
+
+    def band(di, dj):
+        # (8, wt) full-width band for dj=0; (8, fw) corner block else.
+        if dj == 0:
+            return pl.BlockSpec(
+                (8, wt), lambda i, j, di=di: (row_block(di, i), j)
+            )
+        return pl.BlockSpec(
+            (8, fw),
+            lambda i, j, di=di, dj=dj: (
+                row_block(di, i),
+                ((j + dj) % m) * lanes + (lanes - 1 if dj < 0 else 0),
+            ),
+        )
+
+    def edge(dj):
+        return pl.BlockSpec(
+            (r, fw),
+            lambda i, j, dj=dj: (
+                i, ((j + dj) % m) * lanes + (lanes - 1 if dj < 0 else 0)
+            ),
+        )
+
+    return pl.pallas_call(
+        _make_tiled2d_kernel(k_turns, rule, h, hw),
+        grid=(n, m),
+        in_specs=[band(-1, -1), band(-1, 0), band(-1, 1),
+                  edge(-1), pl.BlockSpec((r, wt), lambda i, j: (i, j)),
+                  edge(1),
+                  band(1, -1), band(1, 0), band(1, 1)],
+        out_specs=pl.BlockSpec((r, wt), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((rows, width), jnp.uint32),
+        interpret=interpret,
+    )(*([p] * 9))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "rule", "interpret", "tile_rows")
+)
+def step_n_packed_pallas_tiled2d_raw(
+    p: jax.Array,
+    n: int,
+    rule: Rule = LIFE,
+    interpret: bool = False,
+    tile_rows: int | None = None,
+) -> jax.Array:
+    """`n` turns, packed in/out, tiled in BOTH dimensions — the wide-
+    board path (see the section comment above; measured 1.97 ->
+    ~2.5 Tcells/s at 16384²). `tile_rows` overrides the auto height
+    (tests force multi-tile seams on small boards)."""
+    rows, width = p.shape
+    r = tile_rows or _tile2d_rows(rows)
+    if rows % r != 0 or r % 8 != 0:
+        raise ValueError(f"tile_rows={r} must divide {rows} in 8-row units")
+    h = _halo_words(r, TILE2D_WIDTH + 2 * TILE2D_GHOST_LANES)
+    # Full-depth passes advance min(32h, ghost lanes) turns each.
+    k = min(TILE_TURNS * h, TILE2D_GHOST_LANES)
+    whole, rem = divmod(n, k)
+    if whole:
+        p = lax.fori_loop(
+            0, whole,
+            lambda _, q: _tiled2d_call(q, k, rule, interpret, r, h),
+            p,
+        )
+    if rem:
+        h_rem = min(h, -(-rem // TILE_TURNS))
+        p = _tiled2d_call(p, rem, rule, interpret, r, h_rem)
+    return p
+
+
 @functools.partial(jax.jit, static_argnames=("n", "rule", "interpret"))
 def step_n_pallas_packed(
     world: jax.Array,

@@ -180,16 +180,21 @@ def _single_device_pallas_packed(rule: Rule, height: int, width: int,
                                  device=None) -> Stepper:
     """Packed VMEM-resident pallas backend (ops/pallas_bitlife.py):
     multi-turn chunks run as one whole-board kernel when the packed
-    working set fits VMEM, else as the strip-tiled kernel (32*h turns
-    per HBM round trip, halo depth h auto-sized to VMEM — 128 on the
-    big-board configs). Measured 1.3x-3.6x the XLA packed path on TPU
-    at 512²..8192² (BENCH_DETAIL.json)."""
+    working set fits VMEM; boards over it run strip-tiled (32*h turns
+    per HBM round trip, halo depth h auto-sized to VMEM), and very wide
+    boards run the 2-D tiled kernel — width-tiling keeps the per-op
+    shape at the fast 64-row size where the 1-D budget would force thin
+    strips (measured 1.93 -> 2.41 Tcells/s at 16384²). Measured
+    1.3x-3.6x the XLA packed path on TPU at 512²..8192²
+    (BENCH_DETAIL.json)."""
     from gol_tpu.ops import pallas_bitlife
 
     dev = device or jax.devices()[0]
     interpret = dev.platform != "tpu"  # no mosaic off-TPU
     if pallas_bitlife.fits_pallas_packed(height, width):
         raw = pallas_bitlife.step_n_packed_pallas_raw
+    elif pallas_bitlife.fits_pallas_packed_tiled2d(height, width):
+        raw = pallas_bitlife.step_n_packed_pallas_tiled2d_raw
     else:
         raw = pallas_bitlife.step_n_packed_pallas_tiled_raw
     return _packed_state_stepper(

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""The 512² dependency-chain study — why the small-board kernel rate
+sits ~40% below the chip's wide-board peak, machine-captured.
+
+VERDICT r3 #5 asked for >=2.3 Tcells/s at 512² via "in-flight
+parallelism". This script runs the decisive experiments on hardware:
+
+  A. the production whole-board kernel (one 16-word-row board);
+  B. TWO INDEPENDENT boards stepped in one kernel, bodies interleaved
+     per loop iteration — the pure-ILP upper bound;
+  C. the same board split into two 8-row halves whose cross-word
+     carries are sourced from each other (bit-exact, ~4 extra select
+     ops/turn) — decoupled dependency chains EXCEPT one edge-row
+     coupling per turn;
+  D. C with the carries assembled by concatenation instead of
+     roll+select.
+
+Round-4 findings (this script reproduces them):
+  A ~1.7-1.95 Tcells/s; B ~3.1-3.5 AGGREGATE at ~91% per-board
+  efficiency; C and D collapse back to A's rate. Mosaic interleaves
+  fully independent chains almost perfectly, but any per-turn data
+  coupling between the halves — even one ghost row — serializes the
+  schedule. A torus has no coupling-free decomposition without
+  redundant ghost compute, and at 16 word-rows every ghost-decoupled
+  split costs >=2x compute (8-sublane alignment), more than the ~1.8x
+  ILP headroom. The 512² single-board rate is therefore a scheduler
+  property, not a kernel-design gap; the wide-board peak remains the
+  per-stream ceiling. (Boards at and above 1024² already run wide
+  enough ops to fill the pipeline: device_rates.)
+
+Usage: python scripts/ilp_study.py  (needs the TPU; ~2 min)
+"""
+
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gol_tpu.models.rules import LIFE
+from gol_tpu.ops.bitlife import WORD, combine_packed, pack, step_n_packed_raw
+from gol_tpu.ops.life import random_world, to_bits
+from gol_tpu.ops.pallas_bitlife import _pallas_turn
+
+H = W = 512
+N, CHAIN = 100_000, 20
+LINK_LATENCY = 0.104  # measured via bench.measure_link_latency
+
+ONE, TOP = 1, WORD - 1
+
+
+def _board(seed):
+    return jax.jit(lambda w: pack(to_bits(w)))(
+        jnp.asarray(random_world(H, W, seed=seed))
+    )
+
+
+def _vmem_call(kernel, n_out=1):
+    shape = jax.ShapeDtypeStruct((H // WORD, W), jnp.uint32)
+    return pl.pallas_call(
+        kernel,
+        out_shape=[shape] * n_out if n_out > 1 else shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_out,
+        out_specs=(
+            [pl.BlockSpec(memory_space=pltpu.VMEM)] * n_out
+            if n_out > 1
+            else pl.BlockSpec(memory_space=pltpu.VMEM)
+        ),
+    )
+
+
+def make_baseline(unroll=8):
+    def kernel(in_ref, out_ref):
+        def body(_, q):
+            for _ in range(unroll):
+                q = _pallas_turn(q, LIFE)
+            return q
+
+        out_ref[:] = lax.fori_loop(0, N // unroll, body, in_ref[:])
+
+    f = _vmem_call(kernel)
+    return jax.jit(lambda q: f(q))
+
+
+def make_two_boards(unroll=4):
+    def kernel(a_ref, b_ref, oa, ob):
+        def body(_, ab):
+            a, b = ab
+            for _ in range(unroll):
+                a = _pallas_turn(a, LIFE)
+                b = _pallas_turn(b, LIFE)
+            return a, b
+
+        a, b = lax.fori_loop(0, N // unroll, body, (a_ref[:], b_ref[:]))
+        oa[:] = a
+        ob[:] = b
+
+    f = _vmem_call(kernel, n_out=2)
+    return jax.jit(lambda a, b: f(a, b))
+
+
+def _pair_turn_select(a, b):
+    rows = a.shape[0]
+    ra1, ram = pltpu.roll(a, 1, 0), pltpu.roll(a, rows - 1, 0)
+    rb1, rbm = pltpu.roll(b, 1, 0), pltpu.roll(b, rows - 1, 0)
+    idx = lax.broadcasted_iota(jnp.int32, a.shape, 0)
+    first, last = idx == 0, idx == rows - 1
+    cu_a = jnp.where(first, rb1, ra1)
+    cd_a = jnp.where(last, rbm, ram)
+    cu_b = jnp.where(first, ra1, rb1)
+    cd_b = jnp.where(last, ram, rbm)
+    up_a = (a << ONE) | (cu_a >> TOP)
+    dn_a = (a >> ONE) | (cd_a << TOP)
+    up_b = (b << ONE) | (cu_b >> TOP)
+    dn_b = (b >> ONE) | (cd_b << TOP)
+    return (
+        combine_packed(a, up_a, dn_a, LIFE, roll=pltpu.roll),
+        combine_packed(b, up_b, dn_b, LIFE, roll=pltpu.roll),
+    )
+
+
+def _pair_turn_concat(a, b):
+    cu_a = jnp.concatenate([b[-1:], a[:-1]], axis=0)
+    cd_a = jnp.concatenate([a[1:], b[:1]], axis=0)
+    cu_b = jnp.concatenate([a[-1:], b[:-1]], axis=0)
+    cd_b = jnp.concatenate([b[1:], a[:1]], axis=0)
+    up_a = (a << ONE) | (cu_a >> TOP)
+    dn_a = (a >> ONE) | (cd_a << TOP)
+    up_b = (b << ONE) | (cu_b >> TOP)
+    dn_b = (b >> ONE) | (cd_b << TOP)
+    return (
+        combine_packed(a, up_a, dn_a, LIFE, roll=pltpu.roll),
+        combine_packed(b, up_b, dn_b, LIFE, roll=pltpu.roll),
+    )
+
+
+def make_coupled(pair_turn, unroll=8):
+    def kernel(in_ref, out_ref):
+        rows = in_ref.shape[0]
+
+        def body(_, ab):
+            a, b = ab
+            for _ in range(unroll):
+                a, b = pair_turn(a, b)
+            return a, b
+
+        a, b = lax.fori_loop(
+            0, N // unroll, body, (in_ref[: rows // 2], in_ref[rows // 2 :])
+        )
+        out_ref[: rows // 2] = a
+        out_ref[rows // 2 :] = b
+
+    f = _vmem_call(kernel)
+    return jax.jit(lambda q: f(q))
+
+
+def measure(name, f, boards):
+    q = f(*boards)
+    int(jnp.sum(q[0] if isinstance(q, (tuple, list)) else q))  # warm
+    t0 = time.perf_counter()
+    state = boards
+    for _ in range(CHAIN):
+        out = f(*state)
+        state = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+    int(jnp.sum(state[0]))
+    dt = time.perf_counter() - t0 - LINK_LATENCY
+    tps = CHAIN * N / dt
+    agg = len(boards) * tps * H * W / 1e12
+    print(f"{name:24s} {tps/1e6:6.2f}M turns/s/board   {agg:.2f} Tcells/s aggregate")
+    return agg
+
+
+def main():
+    p0, p1 = _board(1), _board(2)
+    # Bit-exactness of the coupled variants before timing them.
+    want = jax.jit(lambda q: step_n_packed_raw(q, 16, LIFE))(p0)
+    for pt in (_pair_turn_select, _pair_turn_concat):
+        def k16(in_ref, out_ref, pt=pt):
+            rows = in_ref.shape[0]
+            a, b = in_ref[: rows // 2], in_ref[rows // 2 :]
+            for _ in range(16):
+                a, b = pt(a, b)
+            out_ref[: rows // 2] = a
+            out_ref[rows // 2 :] = b
+
+        got = _vmem_call(k16)(p0)
+        assert (jnp.asarray(got) == jnp.asarray(want)).all(), pt.__name__
+    print("coupled variants bit-exact: OK\n")
+
+    measure("A baseline", make_baseline(), (p0,))
+    measure("B two independent", make_two_boards(), (p0, p1))
+    measure("C coupled roll+select", make_coupled(_pair_turn_select), (p0,))
+    measure("D coupled concat", make_coupled(_pair_turn_concat), (p0,))
+
+
+if __name__ == "__main__":
+    main()

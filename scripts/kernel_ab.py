@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""A/B the 1-D strip-tiled kernel against the 2-D tiled kernel on
+hardware — the capture behind docs/PERF.md's wide-board numbers
+(1-D thin strips vs width+height tiles with corner ghosts), plus the
+thin-strip diagnostic that motivated the 2-D design: strips of r=16
+word-rows forced onto a 2048² board (which the whole-board kernel runs
+at full rate) reproduce the wide-board fall-off exactly, pinning the
+cause on op shape rather than on HBM traffic or halo compute.
+
+Usage: python scripts/kernel_ab.py   (needs the TPU; ~3 min)
+"""
+
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+from gol_tpu.models.rules import LIFE
+from gol_tpu.ops.bitlife import pack
+from gol_tpu.ops.life import random_world, to_bits
+from gol_tpu.ops.pallas_bitlife import (
+    step_n_packed_pallas_raw,
+    step_n_packed_pallas_tiled2d_raw,
+    step_n_packed_pallas_tiled_raw,
+)
+
+LINK_LATENCY = 0.104  # measured via bench.measure_link_latency
+
+
+def rate(side, fn, n, chain, **kw):
+    p0 = jax.jit(lambda w: pack(to_bits(w)))(
+        jnp.asarray(random_world(side, side, seed=1))
+    )
+    f = jax.jit(lambda q: fn(q, n, LIFE, **kw))
+    q = f(p0)
+    int(jnp.sum(q))  # warm (realize; block_until_ready is lazy here)
+    t0 = time.perf_counter()
+    q = p0
+    for _ in range(chain):
+        q = f(q)
+    int(jnp.sum(q))
+    dt = time.perf_counter() - t0 - LINK_LATENCY
+    tps = chain * n / dt
+    return tps, tps * side * side / 1e12
+
+
+def main():
+    for side, n, chain in ((8192, 12_000, 8), (16384, 4_000, 6)):
+        for name, fn in (("1-D tiled", step_n_packed_pallas_tiled_raw),
+                         ("2-D tiled", step_n_packed_pallas_tiled2d_raw)):
+            tps, t = rate(side, fn, n, chain)
+            print(f"{side}² {name:10s}: {tps:8.0f} turns/s = {t:.2f} Tcells/s")
+    # Thin-strip diagnostic at a size the whole-board kernel handles.
+    tps, t = rate(2048, step_n_packed_pallas_raw, 30_000, 10)
+    print(f"2048² whole-board  : {tps:8.0f} turns/s = {t:.2f} Tcells/s")
+    tps, t = rate(2048, step_n_packed_pallas_tiled_raw, 30_000, 10,
+                  strip_rows=16, halo_words=2)
+    print(f"2048² forced r=16  : {tps:8.0f} turns/s = {t:.2f} Tcells/s "
+          "(the wide-board thin-strip wall, reproduced)")
+
+
+if __name__ == "__main__":
+    main()

@@ -166,6 +166,46 @@ def test_pallas_packed_tiled_matches_dense_interpret(halo, turns):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("turns", [
+    # k per full pass = min(32*h_auto, 128 ghost lanes); boundaries at
+    # 128 pin both the whole-pass and remainder (shallower-halo) paths.
+    1, 33, 127, 128, 130,
+])
+def test_pallas_packed_tiled2d_matches_dense_interpret(turns):
+    """The 2-D tiled kernel (wide boards: width AND height tiling,
+    corner ghosts from diagonal tiles): 512 rows x 8192 wide at
+    tile_rows=8 forces a 2x2 tile grid, so every ghost view — bands,
+    edges and all four corners, with toroidal wrap in both axes — is
+    genuinely exercised across the light-cone boundary."""
+    from gol_tpu.ops.pallas_bitlife import step_n_packed_pallas_tiled2d_raw
+
+    world = random_world(512, 8192, seed=turns)
+    p = bitlife.pack(life.to_bits(world))
+    got = np.asarray(
+        bitlife.unpack(
+            step_n_packed_pallas_tiled2d_raw(
+                p, turns, interpret=True, tile_rows=8
+            ),
+            512,
+        )
+    )
+    want = np.asarray(life.to_bits(life.step_n(world, turns)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fits_pallas_packed_tiled2d_gate():
+    from gol_tpu.ops.pallas_bitlife import (
+        TILE2D_WIDTH,
+        fits_pallas_packed_tiled2d,
+    )
+
+    assert fits_pallas_packed_tiled2d(16384, 16384)
+    assert fits_pallas_packed_tiled2d(8192, 8192)
+    assert not fits_pallas_packed_tiled2d(4096, TILE2D_WIDTH)  # not wider
+    assert not fits_pallas_packed_tiled2d(8192, 8000)  # lane misalignment
+    assert not fits_pallas_packed_tiled2d(48, 8192)  # no whole words
+
+
 @pytest.mark.parametrize("turns", [1, 50])
 def test_pallas_packed_whole_matches_dense_interpret(turns):
     from gol_tpu.ops.pallas_bitlife import step_n_pallas_packed
