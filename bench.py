@@ -336,7 +336,47 @@ def measure_diff_rate(latency: float) -> dict:
         "link_bytes_per_turn": bytes_per_turn,
         "flips_per_turn": round(total_flips / (chunks * kd), 1),
     }
+
+    # Tier 3: delivered on a SETTLED board with the sparse encoding —
+    # the engine's steady-state watched path. The 512² fixture goes
+    # periodic by turn ~6k; a settled board changes few words/turn, so
+    # sparse rows (8*cap+4 B) beat the 32 KB mask on the link.
+    from gol_tpu.parallel.stepper import (
+        sparse_bitmap_words,
+        sparse_decode_rows,
+    )
+
+    q, _ = stepper.step_n(p, 10_000)
+    q, diffs, count = stepper.step_n_with_diffs(q, kd)
+    int(count)
+    host = np.asarray(diffs).copy()
+    max_words = max(int(np.count_nonzero(host[i])) for i in range(kd))
+    hw = H // 32
+    nb = sparse_bitmap_words(hw * W)
+    capd = min(max(64, 1 << (2 * max_words - 1).bit_length()), hw * W // 2)
+    q2, buf, count = stepper.step_n_with_diffs_sparse(q, kd, capd)  # warm
+    int(count)
+    q2, total_flips = q, 0
+    t0 = time.perf_counter()
+    for _ in range(chunks):
+        q2, buf, count = stepper.step_n_with_diffs_sparse(q2, kd, capd)
+        host = np.ascontiguousarray(np.asarray(buf)).view(np.uint32)
+        host = host.copy()  # force materialization (lazy on axon)
+        for words in sparse_decode_rows(host, hw * W):
+            total_flips += len(
+                cells_from_mask(unpack_np(words.reshape(hw, W), H))
+            )
+    dt = time.perf_counter() - t0
+    sparse = {
+        "turns_per_sec": round(chunks * kd / dt, 1),
+        "chunk": kd,
+        "cap_words": capd,
+        "link_bytes_per_turn": (1 + nb + capd) * 4,
+        "flips_per_turn": round(total_flips / (chunks * kd), 1),
+        "board": "settled (turn 10k+)",
+    }
     return {"kernel": kernel, "delivered": delivered,
+            "delivered_sparse_settled": sparse,
             "turns_per_sec": kernel["turns_per_sec"]}
 
 

@@ -70,6 +70,12 @@ DIFF_CHUNK = 256
 #: Device-memory ceiling for one diff stack (bytes); caps the chunk on
 #: big boards (a dense 16384² bool stack is 256 MB at k=1).
 DIFF_STACK_BUDGET = 128 * 1024 * 1024
+#: Sparse diff encoding (packed backends): a row is a changed-word
+#: bitmap (total_words/8 bytes) plus `cap` values (4 bytes each), vs
+#: total_words*4 for the full mask — capping values at total_words//2
+#: guarantees >=~1.9x less on the link even when the cap is saturated,
+#: and a quiet board approaches the bitmap floor (32x).
+DIFF_SPARSE_MIN_CAP = 64
 
 # Engines whose thread may still be running. The engine thread is
 # non-daemon (see Engine.start), so an abandoned infinite run would pin
@@ -240,6 +246,11 @@ class Engine:
             else None
         )
         self.skipped_turns = 0
+        # Sparse diff encoding state: None = ship full masks; an int =
+        # the changed-word cap for the next sparse chunk (see
+        # _run_diff_chunk). Starts off; the first plain chunk's observed
+        # activity enables it.
+        self._sparse_cap: Optional[int] = None
 
     # --- public api ---
 
@@ -535,7 +546,16 @@ class Engine:
         masks in one transfer, expand them host-side with NumPy and emit
         the *identical* per-turn CellFlipped/TurnComplete stream the
         one-turn path produced (ref contract: gol/distributor.go:212-220
-        via sdl_test.go:57-74). Returns the new completed-turn count."""
+        via sdl_test.go:57-74). Returns the new completed-turn count.
+
+        Steady-state watched runs on a slow host link ride the SPARSE
+        encoding when the stepper offers it: once a plain chunk shows
+        the board changes few enough words per turn, subsequent chunks
+        ship [count, word indices, word values] rows instead of full
+        masks (device-side static-size nonzero), adapting the cap to
+        the observed activity; a truncated row (activity burst past the
+        cap) is detected by its count and the chunk is redone densely —
+        the stream is bit-identical on every path."""
         p = self.p
         cap = max(1, DIFF_STACK_BUDGET // max(p.image_height * p.image_width, 1))
         k = min(DIFF_CHUNK, cap, p.turns - turn)
@@ -547,22 +567,93 @@ class Engine:
             k = min(k, max(1, self._autosave_turn + p.autosave_turns - turn))
         world = self._committed[1]
         tick = time.perf_counter() if self.timeline else 0.0
-        new_world, diffs, count = self.stepper.step_n_with_diffs(world, k)
-        host_diffs = (self.stepper.fetch_diffs or np.asarray)(diffs)
+        rows, new_world, count = None, None, None
+        if self._sparse_cap is not None:
+            got = self._dispatch_sparse(world, k)
+            if got is not None:
+                new_world, rows, count = got
+        if rows is None:  # plain masks (also the burst fallback)
+            new_world, diffs, count = self.stepper.step_n_with_diffs(world, k)
+            host_diffs = (self.stepper.fetch_diffs or np.asarray)(diffs)
+            rows = [host_diffs[i] for i in range(k)]
+            self._observe_diff_activity(rows)
         if self.timeline:
             self.timeline.record(
                 turn + k, k, time.perf_counter() - tick, "diffs"
             )
         self._commit(turn + k, new_world, count)
-        for i in range(k):
+        for i, row in enumerate(rows):
             t = turn + 1 + i
-            for cell in self._diff_cells(host_diffs[i]):
+            for cell in self._diff_cells(row):
                 self.events.put(CellFlipped(t, cell))
             self.events.put(TurnComplete(t))
         turn += k
         self._throttle_events()
         self._maybe_autosave(turn, new_world)
         return turn
+
+    def _sparse_cap_ceiling(self) -> int:
+        total_words = (self.p.image_height // 32) * self.p.image_width
+        return total_words // 2
+
+    def _dispatch_sparse(self, world, k: int):
+        """Sparse-encoded diff dispatch ([count, bitmap, values] rows —
+        see Stepper.step_n_with_diffs_sparse). Returns (new_world,
+        dense word rows, count) or None when a turn overflowed the cap
+        (the caller redoes the chunk densely; the board burst, so
+        sparse turns off until a plain chunk shows it settled again)."""
+        from gol_tpu.parallel.stepper import sparse_decode_rows
+
+        cap = self._sparse_cap
+        new_world, buf, count = self.stepper.step_n_with_diffs_sparse(
+            world, k, cap
+        )
+        host = np.ascontiguousarray(np.asarray(buf)).view(np.uint32)
+        counts = host[:, 0]
+        max_m = int(counts.max()) if counts.size else 0
+        if max_m > cap:
+            self._sparse_cap = None
+            return None
+        hw, w = self.p.image_height // 32, self.p.image_width
+        rows = [
+            words.reshape(hw, w)
+            for words in sparse_decode_rows(host, hw * w)
+        ]
+        self._adapt_sparse_cap(max_m)
+        return new_world, rows, count
+
+    def _observe_diff_activity(self, rows) -> None:
+        """After a plain packed chunk: enable sparse encoding when the
+        observed peak changed-word count fits a worthwhile cap."""
+        if self.stepper.step_n_with_diffs_sparse is None:
+            return
+        if not rows or rows[0].dtype != np.uint32:
+            return  # dense-mask backends stay on the plain path
+        max_words = max(int(np.count_nonzero(r)) for r in rows)
+        self._adapt_sparse_cap(max_words)
+
+    def _adapt_sparse_cap(self, max_words: int) -> None:
+        """Set the next chunk's cap to a power of two with 2x headroom
+        over the observed peak, clamped to the ceiling (where the row is
+        still ~2x under the mask). Enabling requires the peak to clear
+        the ceiling with 2x margin — activity near the ceiling would
+        overflow-and-redo every other chunk — and a cap only SHRINKS
+        when the peak falls to a quarter of it: each distinct cap is a
+        recompile of the k-turn scan, so a peak hovering at a power-of-
+        two boundary must not flip-flop the size."""
+        ceiling = self._sparse_cap_ceiling()
+        if ceiling < DIFF_SPARSE_MIN_CAP or 2 * max_words > ceiling:
+            self._sparse_cap = None
+            return
+        want = (
+            max(DIFF_SPARSE_MIN_CAP, 1 << (2 * max_words - 1).bit_length())
+            if max_words
+            else DIFF_SPARSE_MIN_CAP
+        )
+        cur = self._sparse_cap
+        if cur is not None and want < cur and 4 * max_words > cur:
+            want = cur  # within hysteresis band: keep the compiled size
+        self._sparse_cap = min(want, ceiling)
 
     def _diff_cells(self, diff) -> list:
         """Flipped Cells of one turn's diff row — packed uint32 word-rows

@@ -67,6 +67,20 @@ class Stepper:
     #: axis = turn). None = plain np.asarray; sharded backends override
     #: to gather (and the uneven split to strip its padding rows).
     fetch_diffs: Optional[Callable] = None
+    #: (world, k, cap) -> (world, sparse_stack, count): the diff scan
+    #: with each turn's flip mask SPARSE-encoded on device. One int32
+    #: row per turn, laid out [changed_word_count (1), changed-word
+    #: BITMAP (total_words/32 words), changed-word values (cap)] —
+    #: exactly what sparse_scan_diffs emits and sparse_decode_rows
+    #: reads; implement new backends through those helpers so the
+    #: layout cannot drift. On a slow host link this is the engine's
+    #: steady-state watched path: a changed word costs 4 bytes plus its
+    #: bitmap bit instead of the mask's 4 bytes per word, changed or
+    #: not. A count above `cap` means that turn's value list is
+    #: truncated — the engine detects it and redoes the chunk with the
+    #: dense stack (never trusts truncated data). Packed single-device
+    #: backends only.
+    step_n_with_diffs_sparse: Optional[Callable] = None
 
     def alive_count(self, world) -> int:
         return int(self.alive_count_async(world))
@@ -91,6 +105,77 @@ def scan_diffs(step_fn, diff_fn, count_fn, post=None):
         return post(*out) if post is not None else out
 
     return step_n_with_diffs
+
+
+def sparse_bitmap_words(total_words: int) -> int:
+    """int32 words in the changed-word bitmap for a diff space of
+    `total_words` packed words — the one layout constant the encoder,
+    the engine decoder, and the bench share."""
+    return -(-total_words // 32)
+
+
+def sparse_decode_rows(host_rows, total_words: int):
+    """Decode sparse diff rows (see Stepper.step_n_with_diffs_sparse)
+    into flat (total_words,) uint32 word arrays — the single host-side
+    decoder the engine and the bench share. `host_rows` is the fetched
+    (k, 1 + bitmap + cap) stack viewed as uint32. Yields one array per
+    turn; raises ValueError on a truncated row (count above the cap the
+    row width implies) so callers can fall back to dense masks."""
+    import numpy as _np
+
+    nb = sparse_bitmap_words(total_words)
+    cap = host_rows.shape[1] - 1 - nb
+    shifts = _np.arange(32, dtype=_np.uint32)
+    for t in range(host_rows.shape[0]):
+        m = int(host_rows[t, 0])
+        if m > cap:
+            raise ValueError(f"sparse row truncated: {m} > cap {cap}")
+        words = _np.zeros(nb * 32, _np.uint32)
+        if m:
+            bits = (host_rows[t, 1 : 1 + nb, None] >> shifts) & 1
+            words[_np.flatnonzero(bits)] = host_rows[t, 1 + nb : 1 + nb + m]
+        yield words[:total_words]
+
+
+def sparse_scan_diffs(step_fn, diff_fn, count_fn):
+    """Build a `step_n_with_diffs_sparse` (see the Stepper field): the
+    scanned per-turn output row is
+
+        [changed_count (1), changed-word BITMAP (total/32), values (cap)]
+
+    as one int32 vector. The bitmap (1 bit per packed word) carries the
+    positions, so values need no indices — a changed word costs 4 bytes
+    plus its bitmap bit, vs 4 bytes/word for the full mask: the row
+    beats the mask whenever under ~31/32 of the words changed, and on a
+    quiet board it approaches total/8 bytes. Value order is ascending
+    word index (jnp.nonzero), matching the host's bitmap scan. A
+    changed_count above `cap` marks the value list truncated — the
+    consumer must fall back to the dense stack for that chunk."""
+    import jax.numpy as jnp
+    from jax import lax as _lax
+
+    @functools.partial(jax.jit, static_argnames=("k", "cap"))
+    def step_n_with_diffs_sparse(state, k, cap):
+        def body(q, _):
+            new = step_fn(q)
+            d = diff_fn(q, new).reshape(-1)
+            nb = sparse_bitmap_words(d.shape[0])
+            changed = jnp.pad(d != 0, (0, nb * 32 - d.shape[0]))
+            m = jnp.sum(changed, dtype=jnp.int32)
+            bits = changed.astype(jnp.uint32).reshape(nb, 32)
+            weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+            bitmap = jnp.sum(bits * weights, axis=1, dtype=jnp.uint32)
+            idx = jnp.nonzero(d, size=cap, fill_value=0)[0]
+            vals = d[idx]
+            row = jnp.concatenate(
+                [m[None].astype(jnp.uint32), bitmap, vals]
+            )
+            return new, _lax.bitcast_convert_type(row, jnp.int32)
+
+        new, rows = _lax.scan(body, state, None, length=max(int(k), 0))
+        return new, rows, count_fn(new)
+
+    return step_n_with_diffs_sparse
 
 
 def _single_device(rule: Rule, device=None) -> Stepper:
@@ -156,6 +241,11 @@ def _packed_state_stepper(name: str, rule: Rule, height: int,
         # the cross-backend tests, and the diff path is link-bound, not
         # kernel-bound.)
         step_n_with_diffs=scan_diffs(
+            lambda q: bitlife.step_packed(q, rule),
+            lambda old, new: old ^ new,
+            bitlife.count_packed,
+        ),
+        step_n_with_diffs_sparse=sparse_scan_diffs(
             lambda q: bitlife.step_packed(q, rule),
             lambda old, new: old ^ new,
             bitlife.count_packed,
@@ -404,6 +494,9 @@ def _gens_stepper_packed(rule: GenRule, device, height: int,
     _snd = scan_diffs(
         lambda p: bitgens.step_packed_gens(p, rule), _planes_xor, _count
     )
+    _snd_sparse = sparse_scan_diffs(
+        lambda p: bitgens.step_packed_gens(p, rule), _planes_xor, _count
+    )
 
     return Stepper(
         name="generations-packed-1",
@@ -416,6 +509,9 @@ def _gens_stepper_packed(rule: GenRule, device, height: int,
         alive_count_async=lambda p: _sync(_count(p)),
         alive_mask=_gens_alive_mask,
         step_n_with_diffs=lambda p, k: _sync(_snd(p, int(k))),
+        step_n_with_diffs_sparse=lambda p, k, cap: _sync(
+            _snd_sparse(p, int(k), int(cap))
+        ),
     )
 
 
