@@ -239,3 +239,157 @@ def step_n_packed_gens_pallas_tiled_raw(
         h_rem = min(h, -(-rem // TILE_TURNS))
         planes = _tiled_call(planes, rem, rule, interpret, r, h_rem)
     return planes
+
+# --- 2-D tiled form (very wide boards) -------------------------------------
+#
+# The 1-D gens strips are even thinner than Life's — the per-row VMEM
+# cost scales with the plane count — so wide gens boards hit the same
+# thin-strip dependency-chain wall (docs/PERF.md, the 512² study).
+# This is pallas_bitlife's 2-D tiled kernel applied per plane: every
+# plane contributes a full 9-view ghost frame (vertical bands, narrow
+# horizontal edge blocks, corner blocks from the diagonal tiles), and
+# the tile width adapts to the plane count so the tile height stays at
+# the fast >=32-word-row shape where the budget allows.
+
+
+def _gens_tile2d_plan(rows: int, width: int, rule: GenRule,
+                      tile_rows: int | None = None):
+    """(tile height r, halo h, tile width wt) for a 2-D gens tiling, or
+    None when no width tile fits. Prefers the TALLEST tile (op shape
+    dominates: r=64 at half width measured over r=32 at full width,
+    2.27 vs 2.17 Tcells/s at 8192² C=3), width as the tie-break."""
+    from gol_tpu.ops.pallas_bitlife import (
+        TILE2D_GHOST_LANES,
+        TILE2D_WIDTH,
+        _halo_words,
+        _strip_rows,
+    )
+
+    mult = _tiled_plane_equivalents(rule)
+    plans = []
+    for wt in (TILE2D_WIDTH, TILE2D_WIDTH // 2):
+        if width % wt != 0 or width <= wt:
+            continue
+        extw = wt + 2 * TILE2D_GHOST_LANES
+        cost = extw * 4 * mult
+        if 10 * cost > VMEM_BUDGET_BYTES:  # minimum 8+2 rows must fit
+            continue
+        r = tile_rows or _strip_rows(rows, extw, cost)
+        h = _halo_words(r, extw, cost)
+        plans.append((r, h, wt))
+    if not plans:
+        return None
+    return max(plans, key=lambda p: (p[0], p[2]))
+
+
+def fits_pallas_gens_tiled2d(height: int, width: int,
+                             rule: GenRule) -> bool:
+    if height % WORD != 0:
+        return False
+    rows = height // WORD
+    if rows % 8 != 0 or width % 128 != 0 or rows < 8:
+        return False
+    return _gens_tile2d_plan(rows, width, rule) is not None
+
+
+def prefer_gens_tiled2d(height: int, width: int, rule: GenRule) -> bool:
+    """True when the 2-D tiling's tile height genuinely beats the 1-D
+    strip plan's. The 2-D frame pays ghost-column compute and corner
+    fetches for its taller tiles, so equal heights favour 1-D — e.g. a
+    C=2 rule at 4096² reaches r=64 full-width strips and must keep
+    them."""
+    if not fits_pallas_gens_tiled2d(height, width, rule):
+        return False
+    rows = height // WORD
+    r2d = _gens_tile2d_plan(rows, width, rule)[0]
+    if not fits_pallas_gens_tiled(height, width, rule):
+        return True
+    r1d = _gens_tile_plan(rows, width, rule, None, None)[0]
+    return r2d > r1d
+
+
+def _make_tiled2d_kernel(k_turns: int, rule: GenRule, halo: int, hw: int):
+    from gol_tpu.ops.pallas_bitlife import MAX_HALO_WORDS
+
+    assert 1 <= k_turns <= min(TILE_TURNS * halo, hw)
+    assert 1 <= halo <= MAX_HALO_WORDS
+    nplanes = rule.states - 1
+
+    def kernel(*refs):
+        ext = []
+        for i in range(nplanes):
+            ul, ub, ur, le, c, ri, dl, db, dr = refs[9 * i : 9 * i + 9]
+            top = jnp.concatenate(
+                [ul[8 - halo:, -hw:], ub[8 - halo:, :], ur[8 - halo:, :hw]],
+                axis=1,
+            )
+            mid = jnp.concatenate([le[:, -hw:], c[:], ri[:, :hw]], axis=1)
+            bot = jnp.concatenate(
+                [dl[:halo, -hw:], db[:halo, :], dr[:halo, :hw]], axis=1
+            )
+            ext.append(jnp.concatenate([top, mid, bot], axis=0))
+        ext = _run_gens_turns(tuple(ext), k_turns, rule)
+        for i in range(nplanes):
+            refs[9 * nplanes + i][:] = ext[i][halo:-halo, hw:-hw]
+
+    return kernel
+
+
+def _gens_tiled2d_call(planes: jax.Array, k_turns: int, rule: GenRule,
+                       interpret: bool, r: int, h: int, wt: int):
+    from gol_tpu.ops.pallas_bitlife import TILE2D_GHOST_LANES, tiled2d_specs
+
+    nplanes, rows, width = planes.shape
+    frame = tiled2d_specs(rows, width, r, wt)
+    centre = frame[4]
+    in_specs, args = [], []
+    for i in range(nplanes):
+        in_specs += list(frame)
+        args += [planes[i]] * 9
+    shape = jax.ShapeDtypeStruct((rows, width), jnp.uint32)
+    outs = pl.pallas_call(
+        _make_tiled2d_kernel(k_turns, rule, h, TILE2D_GHOST_LANES),
+        grid=(rows // r, width // wt),
+        in_specs=in_specs,
+        out_specs=[centre] * nplanes,
+        out_shape=[shape] * nplanes,
+        interpret=interpret,
+    )(*args)
+    return jnp.stack(outs)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "rule", "interpret", "tile_rows")
+)
+def step_n_packed_gens_pallas_tiled2d_raw(
+    planes: jax.Array,
+    n: int,
+    rule: GenRule,
+    interpret: bool = False,
+    tile_rows: int | None = None,
+) -> jax.Array:
+    """`n` turns on stacked (C-1, rows, W) planes, tiled in BOTH
+    dimensions — the wide-board gens path (see the section comment).
+    `tile_rows` overrides the auto height (tests force multi-tile
+    seams on small boards)."""
+    from gol_tpu.ops.pallas_bitlife import TILE2D_GHOST_LANES
+
+    _, rows, width = planes.shape
+    plan = _gens_tile2d_plan(rows, width, rule, tile_rows)
+    if plan is None:
+        raise ValueError(f"no 2-D gens tiling fits {rows}x{width} C={rule.states}")
+    r, h, wt = plan
+    if rows % r != 0 or r % 8 != 0:
+        raise ValueError(f"tile_rows={r} must divide {rows} in 8-row units")
+    k = min(TILE_TURNS * h, TILE2D_GHOST_LANES)
+    whole, rem = divmod(n, k)
+    if whole:
+        planes = lax.fori_loop(
+            0, whole,
+            lambda _, q: _gens_tiled2d_call(q, k, rule, interpret, r, h, wt),
+            planes,
+        )
+    if rem:
+        h_rem = min(h, -(-rem // TILE_TURNS))
+        planes = _gens_tiled2d_call(planes, rem, rule, interpret, r, h_rem, wt)
+    return planes

@@ -373,7 +373,26 @@ def _make_tiled2d_kernel(k_turns: int, rule: Rule, halo: int, hw: int):
 def _tiled2d_call(p: jax.Array, k_turns: int, rule: Rule, interpret: bool,
                   r: int, h: int):
     rows, width = p.shape
-    wt, hw = TILE2D_WIDTH, TILE2D_GHOST_LANES
+    wt = TILE2D_WIDTH
+    specs = tiled2d_specs(rows, width, r, wt)
+    return pl.pallas_call(
+        _make_tiled2d_kernel(k_turns, rule, h, TILE2D_GHOST_LANES),
+        grid=(rows // r, width // wt),
+        in_specs=list(specs),
+        out_specs=specs[4],  # the centre spec doubles as the out spec
+        out_shape=jax.ShapeDtypeStruct((rows, width), jnp.uint32),
+        interpret=interpret,
+    )(*([p] * 9))
+
+
+def tiled2d_specs(rows: int, width: int, r: int, wt: int) -> tuple:
+    """The nine BlockSpecs of one 2-D ghost frame, in kernel order
+    [up-left, up, up-right, left, centre, right, down-left, down,
+    down-right] — vertical ghosts are single 8-sublane bands, the
+    horizontal/corner ghosts narrow TILE2D_FETCH_LANES blocks sliced to
+    the ghost width in-kernel. Shared with the per-plane generations
+    kernel (ops/pallas_bitgens.py) so the grid index arithmetic has one
+    definition."""
     fw = TILE2D_FETCH_LANES
     n, m = rows // r, width // wt
     blocks = r // 8   # vertical ghost fetches are single 8-sublane blocks
@@ -404,17 +423,9 @@ def _tiled2d_call(p: jax.Array, k_turns: int, rule: Rule, interpret: bool,
             ),
         )
 
-    return pl.pallas_call(
-        _make_tiled2d_kernel(k_turns, rule, h, hw),
-        grid=(n, m),
-        in_specs=[band(-1, -1), band(-1, 0), band(-1, 1),
-                  edge(-1), pl.BlockSpec((r, wt), lambda i, j: (i, j)),
-                  edge(1),
-                  band(1, -1), band(1, 0), band(1, 1)],
-        out_specs=pl.BlockSpec((r, wt), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((rows, width), jnp.uint32),
-        interpret=interpret,
-    )(*([p] * 9))
+    return (band(-1, -1), band(-1, 0), band(-1, 1),
+            edge(-1), pl.BlockSpec((r, wt), lambda i, j: (i, j)), edge(1),
+            band(1, -1), band(1, 0), band(1, 1))
 
 
 @functools.partial(

@@ -428,7 +428,9 @@ def _gens_stepper_packed(rule: GenRule, device, height: int,
     from gol_tpu.ops.pallas_bitgens import (
         fits_pallas_gens,
         fits_pallas_gens_tiled,
+        prefer_gens_tiled2d,
         step_n_packed_gens_pallas_raw,
+        step_n_packed_gens_pallas_tiled2d_raw,
         step_n_packed_gens_pallas_tiled_raw,
     )
 
@@ -447,6 +449,13 @@ def _gens_stepper_packed(rule: GenRule, device, height: int,
         if fits_pallas_gens(height, width, rule):
             raw_step_n = functools.partial(
                 step_n_packed_gens_pallas_raw, rule=rule
+            )
+        elif prefer_gens_tiled2d(height, width, rule):
+            # Wide boards: width tiling keeps the tile height at the
+            # fast op shape the plane-scaled 1-D budget would forbid
+            # (only when it actually beats the 1-D plan's height).
+            raw_step_n = functools.partial(
+                step_n_packed_gens_pallas_tiled2d_raw, rule=rule
             )
         elif fits_pallas_gens_tiled(height, width, rule):
             raw_step_n = functools.partial(

@@ -414,3 +414,27 @@ def test_pallas_gens_tiled_interpret(halo, turns):
     ))
     want = np.asarray(bitgens.step_n_packed_gens_raw(planes, turns, rule))
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("turns", [1, 33, 128, 130])
+def test_pallas_gens_tiled2d_interpret(turns):
+    """The 2-D tiled gens kernel (width AND height tiles, per-plane
+    corner ghosts): 512 rows x 8192 wide at tile_rows=8 forces a
+    multi-tile grid in both axes, exercised across the light-cone
+    boundary against the XLA planes."""
+    from gol_tpu.ops import bitgens
+    from gol_tpu.ops.pallas_bitgens import (
+        fits_pallas_gens_tiled2d,
+        step_n_packed_gens_pallas_tiled2d_raw,
+    )
+
+    rule = get_rule("B2/S/C3")
+    assert fits_pallas_gens_tiled2d(512, 8192, rule)
+    assert not fits_pallas_gens_tiled2d(512, 2048, rule)  # not wider
+    state = random_states(rule, h=512, w=8192, seed=3)
+    planes = bitgens.pack_states(state, rule)
+    got = np.asarray(step_n_packed_gens_pallas_tiled2d_raw(
+        planes, turns, rule, interpret=True, tile_rows=8
+    ))
+    want = np.asarray(bitgens.step_n_packed_gens_raw(planes, turns, rule))
+    np.testing.assert_array_equal(got, want)
