@@ -238,12 +238,16 @@ class EngineServer:
             enable_flips=conn.want_flips, token=conn.token
         )
 
-    def _detach(self, conn: _Conn) -> None:
+    def _release(self, conn: _Conn) -> None:
+        """Free the controller slot (without closing the socket)."""
         with self._conn_lock:
             if self._conn is conn:
                 self._conn = None
                 self.engine.emit_flips = False
                 self.engine.emit_turns = False
+
+    def _detach(self, conn: _Conn) -> None:
+        self._release(conn)
         conn.close()
 
     def _refresh_flips(self) -> None:
@@ -273,10 +277,15 @@ class EngineServer:
             if key in ("p", "s"):
                 self._keys.put(key)
             elif key == "q":
-                # Detach only — the engine keeps evolving (ref: README.md:182).
+                # Detach only — the engine keeps evolving
+                # (ref: README.md:182). The slot is freed BEFORE the
+                # ack: a controller that reattaches the moment
+                # `detach()` returns must never bounce off its own
+                # stale registration ("busy" race, seen under load).
+                self._release(conn)
                 with contextlib.suppress(Exception):
                     conn.send({"t": "detached"})
-                self._detach(conn)
+                conn.close()
                 return
             elif key == "k":
                 # Global shutdown with a final snapshot (ref: README.md:183).

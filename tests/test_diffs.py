@@ -167,6 +167,43 @@ def test_sparse_wrapper_matches_plain_diffs():
     np.testing.assert_array_equal(s.fetch(new_s), s.fetch(new_p))
 
 
+@pytest.mark.parametrize("seed,rule,cap", [
+    # Caps count packed WORDS (a 64² board has at most 128), so small
+    # caps with dense/explosive rules genuinely hit the truncation
+    # branch while larger ones decode cleanly.
+    (0, "B3/S23", 16), (1, "B36/S23", 128), (2, "B2/S345/C4", 48),
+    (3, "B2/S/C3", 96),
+])
+def test_sparse_decode_fuzz(seed, rule, cap):
+    """Randomized boards x rules x caps through the shared decoder
+    (`sparse_decode_rows`): every turn that fits the cap decodes to the
+    exact plain mask; a board too active for the cap raises."""
+    from gol_tpu.parallel.stepper import sparse_decode_rows
+
+    rng = np.random.default_rng(seed)
+    s = make_stepper(threads=1, height=H, width=W, rule=rule,
+                     backend="packed")
+    world = np.asarray(
+        life.random_world(H, W, density=float(rng.uniform(0.05, 0.5)),
+                          seed=seed + 10)
+    )
+    k = 6
+    _, plain, _ = s.step_n_with_diffs(s.put(world), k)
+    plain = np.asarray(plain)
+    _, buf, _ = s.step_n_with_diffs_sparse(s.put(world), k, cap)
+    host = np.ascontiguousarray(np.asarray(buf)).view(np.uint32)
+    hw = H // 32
+    max_words = max(int(np.count_nonzero(p)) for p in plain)
+    if max_words > cap:
+        with pytest.raises(ValueError):
+            list(sparse_decode_rows(host, hw * W))
+        return
+    for t, words in enumerate(sparse_decode_rows(host, hw * W)):
+        np.testing.assert_array_equal(
+            words.reshape(hw, W), plain[t], err_msg=f"turn {t}"
+        )
+
+
 def test_sparse_wrapper_flags_overflow():
     """A cap below the true changed-word count must be detectable from
     the row's count field (the engine's fallback trigger)."""
