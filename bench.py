@@ -465,7 +465,9 @@ def main() -> None:
         detail["gens_ring1_512x512_B2_S345_C4"] = {"error": repr(e)}
     # The sharded ring on hardware (1-device ring: same program as a
     # multi-chip mesh; delta vs device_rates = distributed overhead).
-    for side, turns in ((1024, 400_000), (4096, 60_000)):
+    # 16384² pins the wide-shard case where the local blocks run the
+    # 2-D tiled kernel (1-D thin strips measured 1.85 Tcells/s there).
+    for side, turns in ((1024, 400_000), (4096, 60_000), (16384, 12_000)):
         try:
             detail[f"ring1_{side}x{side}"] = measure_ring_rate(
                 side, turns, latency

@@ -320,8 +320,10 @@ def gens_local_block_mode(strip_words: int, width: int, rule: GenRule,
     """(ghost word-rows h, local stepping mode) for packed gens deep
     blocks — the packed_halo.local_block_mode analog with the gens
     kernels' own VMEM cost models (plane count scales the working
-    set)."""
+    set), including the 2-D tiled kernel for wide shards (scored with
+    the shared thin-strip shape factor)."""
     from gol_tpu.ops import pallas_bitgens
+    from gol_tpu.parallel.packed_halo import search_local_block_mode
 
     if force is False:
         return 1, "xla"
@@ -330,14 +332,21 @@ def gens_local_block_mode(strip_words: int, width: int, rule: GenRule,
         if (ext % 8 == 0
                 and pallas_bitgens.fits_pallas_gens(ext * WORD, width, rule)):
             return _GENS_DEEP_WORDS, "whole"
-        for h in (4, 8, 16, 32, 64):
-            if h >= strip_words:
-                break
-            e = strip_words + 2 * h
-            if (e % 8 == 0
-                    and pallas_bitgens.fits_pallas_gens_tiled(
-                        e * WORD, width, rule)):
-                return h, "tiled"
+
+        def plan_1d(e):
+            if not pallas_bitgens.fits_pallas_gens_tiled(
+                    e * WORD, width, rule):
+                return None
+            return pallas_bitgens._gens_tile_plan(e, width, rule, None, None)
+
+        def plan_2d(e):
+            # Returns None when no width tile fits; its (r, h, wt) is
+            # exactly what step_n_packed_gens_pallas_tiled2d_raw runs.
+            return pallas_bitgens._gens_tile2d_plan(e, width, rule)
+
+        found = search_local_block_mode(strip_words, plan_1d, plan_2d)
+        if found is not None:
+            return found
     return 1, "xla"
 
 
@@ -384,6 +393,10 @@ def packed_gens_sharded_stepper(rule: GenRule, devices: list, height: int,
             )
         elif mode == "tiled":
             ext = pallas_bitgens.step_n_packed_gens_pallas_tiled_raw(
+                ext, turns, rule, interpret=not on_tpu
+            )
+        elif mode == "tiled2d":
+            ext = pallas_bitgens.step_n_packed_gens_pallas_tiled2d_raw(
                 ext, turns, rule, interpret=not on_tpu
             )
         else:

@@ -88,19 +88,64 @@ def halo_step_packed(p: jax.Array, rule: Rule, axis: str = AXIS) -> jax.Array:
 DEEP_WORDS = 4
 
 
+def _strip_shape_factor(r: int) -> float:
+    """Throughput discount of thin tile heights — the dependency-chain
+    wall (docs/PERF.md, the 512² study). r/(r+6) approximately fits
+    the measured forced-r rates at 2048² (r=16: ~0.73, r=32: ~0.85,
+    r=64: ~0.92 of the halo-adjusted whole-board rate, each ±0.04 of
+    the formula)."""
+    return r / (r + 6)
+
+
+def search_local_block_mode(strip_words: int, plan_1d, plan_2d):
+    """Best (ghost depth, 'tiled'|'tiled2d') over ppermute slab depths,
+    scoring each candidate by ghost overhead x inner tiling efficiency
+    x the thin-strip shape factor — the ONE search both the Life and
+    the Generations rings use (the plan callables inject the family's
+    kernels). `plan_1d(ext_rows) -> (r, inner_halo) | None`;
+    `plan_2d(ext_rows) -> (r, inner_halo, tile_width) | None` — both
+    must describe the plan the kernel will actually execute. Returns
+    None when nothing fits."""
+    from gol_tpu.ops.pallas_bitlife import TILE2D_GHOST_LANES
+
+    best = None
+    for h in (4, 8, 16, 32, 64):
+        if h >= strip_words:
+            break
+        e = strip_words + 2 * h
+        if e % 8 != 0:
+            continue
+        outer = strip_words / e
+        p1 = plan_1d(e)
+        if p1 is not None:
+            r, hi = p1
+            eff = outer * (r / (r + 2 * hi)) * _strip_shape_factor(r)
+            if best is None or eff > best[0]:
+                best = (eff, h, "tiled")
+        p2 = plan_2d(e)
+        if p2 is not None:
+            r2, h2, wt = p2
+            eff = (outer * (r2 / (r2 + 2 * h2))
+                   * (wt / (wt + 2 * TILE2D_GHOST_LANES))
+                   * _strip_shape_factor(r2))
+            if best is None or eff > best[0]:
+                best = (eff, h, "tiled2d")
+    return (best[1], best[2]) if best is not None else None
+
+
 def local_block_mode(strip_words: int, width: int, on_tpu: bool,
                      force: bool | None = None) -> tuple:
     """(ghost depth h, local stepping mode) for a shard's deep blocks.
 
     'whole': the ghost-extended block fits VMEM — the single-chip
-    VMEM-resident pallas kernel steps it. 'tiled': too big for VMEM but
-    tile-aligned — the strip-tiled pallas kernel steps it (it is an
-    exact toroidal stepper, and the ext block's wrap garbage is the
-    same garbage the ghost analysis already wrote off); the ghost depth
-    is a ppermute slab, not an 8-row block fetch, so it searches deeper
-    ghosts for the ext row count whose inner strips tile efficiently.
-    'xla': the fori_loop fallback with one-word ghosts (off-TPU unless
-    `force`, or misaligned shapes)."""
+    VMEM-resident pallas kernel steps it. 'tiled'/'tiled2d': too big
+    for VMEM but tile-aligned — the strip-tiled (or, for wide shards,
+    the 2-D tiled) pallas kernel steps it (both are exact toroidal
+    steppers, and the ext block's wrap garbage is the same garbage the
+    ghost analysis already wrote off); the ghost depth is a ppermute
+    slab, not an 8-row block fetch, so `search_local_block_mode` picks
+    the best (h, kernel) pair. 'xla': the fori_loop fallback with
+    one-word ghosts (off-TPU unless `force`, or misaligned shapes)."""
     from gol_tpu.ops import pallas_bitlife
 
     if force is False:
@@ -110,29 +155,29 @@ def local_block_mode(strip_words: int, width: int, on_tpu: bool,
         if (ext % 8 == 0
                 and ext * width * 4 * 10 <= pallas_bitlife.VMEM_BUDGET_BYTES):
             return DEEP_WORDS, "whole"
-        # Tiled local stepping: pick the ghost depth whose extended
-        # block wastes the least compute — outer waste strip/ext times
-        # inner waste r/(r + 2*h_inner) from the tiled kernel's own
-        # halos (e.g. a 128-word strip tiles at 47% efficiency with
-        # h=4 ghosts but 67% with h=16).
-        best = None
-        for h in (4, 8, 16, 32, 64):
-            if h >= strip_words:
-                break
-            e = strip_words + 2 * h
-            if (e % 8 != 0
-                    or not pallas_bitlife.fits_pallas_packed_tiled(
-                        e * WORD, width)):
-                continue
+
+        def plan_1d(e):
+            if not pallas_bitlife.fits_pallas_packed_tiled(e * WORD, width):
+                return None
             # The tiled kernel's own planner supplies (inner strip,
-            # inner halo) — the efficiency model scores the exact plan
+            # inner halo) — the score models the exact plan
             # step_n_packed_pallas_tiled_raw will execute.
-            r, h_inner = pallas_bitlife._tile_plan(e, width, None, None)
-            eff = (strip_words / e) * (r / (r + 2 * h_inner))
-            if best is None or eff > best[0]:
-                best = (eff, h)
-        if best is not None:
-            return best[1], "tiled"
+            return pallas_bitlife._tile_plan(e, width, None, None)
+
+        def plan_2d(e):
+            if not pallas_bitlife.fits_pallas_packed_tiled2d(e * WORD, width):
+                return None
+            r2 = pallas_bitlife._tile2d_rows(e)
+            h2 = pallas_bitlife._halo_words(
+                r2,
+                pallas_bitlife.TILE2D_WIDTH
+                + 2 * pallas_bitlife.TILE2D_GHOST_LANES,
+            )
+            return r2, h2, pallas_bitlife.TILE2D_WIDTH
+
+        found = search_local_block_mode(strip_words, plan_1d, plan_2d)
+        if found is not None:
+            return found
     return 1, "xla"
 
 
@@ -178,6 +223,10 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
             )
         elif mode == "tiled":
             ext = pallas_bitlife.step_n_packed_pallas_tiled_raw(
+                ext, turns, rule, interpret=not on_tpu
+            )
+        elif mode == "tiled2d":
+            ext = pallas_bitlife.step_n_packed_pallas_tiled2d_raw(
                 ext, turns, rule, interpret=not on_tpu
             )
         else:

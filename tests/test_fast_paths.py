@@ -408,10 +408,13 @@ def test_local_block_mode_selection():
 
     assert local_block_mode(8, 128, on_tpu=True) == (4, "whole")
     # 256-word strip at 16384 wide: the ext block exceeds VMEM at any
-    # ghost depth, and the ghost-depth search lands on h=8 (ext 272 =
-    # 16x17 tiles into 16-row inner strips at 63% efficiency, beating
-    # h=4's degenerate 8-row strips at 48%).
-    assert local_block_mode(256, 16384, on_tpu=True) == (8, "tiled")
+    # ghost depth; the 1-D budget forces thin (8-16 row) inner strips,
+    # so the search lands on the 2-D tiled kernel at h=16 (ext 288
+    # tiles into 48-row x 4096-lane blocks).
+    assert local_block_mode(256, 16384, on_tpu=True) == (16, "tiled2d")
+    # At 4096 wide the 2-D kernel is ineligible (needs width > its
+    # tile); the 1-D form with full-width strips remains the pick.
+    assert local_block_mode(256, 4096, on_tpu=True)[1] == "tiled"
     # Misaligned: ext = 12+8 = 20 word rows is not a multiple of 8.
     assert local_block_mode(12, 128, on_tpu=True) == (1, "xla")
     # Lane misalignment.
@@ -442,6 +445,34 @@ def test_packed_sharded_pallas_local_blocks_match_dense():
     want = np.asarray(life.step_n(world, 165))
     np.testing.assert_array_equal(s.fetch(p), want)
     assert int(count) == int(np.count_nonzero(want))
+
+
+def test_packed_sharded_tiled2d_local_blocks_match_dense():
+    """Wide shards route their local blocks through the 2-D tiled
+    kernel inside shard_map (interpreter mode on the CPU mesh): 3072
+    rows / 2 shards = 48-word strips at 8192 wide — the ghost-extended
+    block just exceeds the whole-block VMEM budget, thin strips on the
+    1-D form, so the search picks tiled2d. 34 turns = one partial 2-D
+    block per shard."""
+    import jax
+
+    from gol_tpu.parallel.packed_halo import (
+        local_block_mode,
+        packed_sharded_stepper,
+    )
+
+    assert local_block_mode(48, 8192, on_tpu=False, force=True) == (
+        4, "tiled2d",
+    )
+    world = random_world(3072, 8192, seed=11)
+    s = packed_sharded_stepper(
+        LIFE, jax.devices()[:2], 3072, force_local_pallas=True
+    )
+    p = s.put(world)
+    p, count = s.step_n(p, 34)
+    want = np.asarray(life.to_bits(life.step_n(world, 34)))
+    np.testing.assert_array_equal(np.asarray(life.to_bits(s.fetch(p))), want)
+    assert int(count) == int(want.sum())
 
 
 @pytest.mark.parametrize("shards", [2, 8])

@@ -334,6 +334,31 @@ def test_gens_uneven_shard_parity(threads):
     assert int(c1) == int(cn)
 
 
+def test_gens_tiled2d_local_blocks_inside_shard_map():
+    """Wide gens shards route local blocks through the 2-D tiled gens
+    kernel inside shard_map (interpreter mode on the CPU mesh), staying
+    bit-exact vs the XLA ring."""
+    from gol_tpu.parallel.gens_halo import (
+        gens_local_block_mode,
+        packed_gens_sharded_stepper,
+    )
+
+    rule = get_rule("B2/S/C3")
+    h, mode = gens_local_block_mode(48, 8192, rule, on_tpu=False, force=True)
+    assert mode == "tiled2d"
+    world = np.asarray(life.random_world(3072, 8192, density=0.3, seed=23))
+    fast = packed_gens_sharded_stepper(
+        rule, jax.devices()[:2], 3072, force_local_pallas=True
+    )
+    slow = packed_gens_sharded_stepper(
+        rule, jax.devices()[:2], 3072, force_local_pallas=False
+    )
+    pf, cf = fast.step_n(fast.put(world), 34)
+    ps, cs = slow.step_n(slow.put(world), 34)
+    np.testing.assert_array_equal(fast.fetch(pf), slow.fetch(ps))
+    assert int(cf) == int(cs)
+
+
 def test_gens_local_pallas_blocks_inside_shard_map():
     """The packed gens ring's deep blocks run the pallas gens kernels
     inside shard_map (forced to interpreter mode on the CPU mesh) and
