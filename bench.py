@@ -29,6 +29,7 @@ to BENCH_DETAIL.json so README perf claims are machine-captured
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import pathlib
@@ -380,6 +381,59 @@ def measure_diff_rate(latency: float) -> dict:
             "turns_per_sec": kernel["turns_per_sec"]}
 
 
+def measure_wire_watched() -> dict:
+    """The fully assembled watched product path: a real EngineServer on
+    this TPU, a controller attached over loopback TCP with
+    want_flips=True, delivered TurnComplete rate at the controller —
+    device diff stacks (sparse when the board settles) + wire flip
+    frames end to end. On a tunnel-attached chip this sits at the
+    device-link bound (see diff_kernel_512x512.delivered); on local
+    hardware the wire becomes the ceiling."""
+    import queue as _q
+    import threading
+
+    from gol_tpu.distributed import Controller, EngineServer
+    from gol_tpu.events import TurnComplete
+    from gol_tpu.params import Params
+
+    img_dir = _golden(f"images/{W}x{H}.pgm").parent
+    p = Params(turns=10**9, threads=1, image_width=W, image_height=H,
+               chunk=0, tick_seconds=60.0,
+               image_dir=str(img_dir), out_dir="out")
+    server = EngineServer(p, port=0).start()
+    # batch=True is the product visualiser configuration (per-turn
+    # FlipBatch arrays end to end — see events.FlipBatch).
+    ctl = Controller(*server.address, want_flips=True, batch=True)
+    counts: _q.Queue = _q.Queue()
+
+    def drain():
+        seen = 0
+        t0 = None
+        for ev in ctl.events:
+            if isinstance(ev, TurnComplete):
+                if t0 is None:
+                    t0 = time.perf_counter()  # start after the sync
+                seen += 1
+                if seen >= 2_000:
+                    counts.put((seen - 1, time.perf_counter() - t0))
+                    return
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    try:
+        got = counts.get(timeout=300)
+    except _q.Empty:
+        got = None
+    with contextlib.suppress(Exception):
+        ctl.send_key("k")
+    server.wait(60)
+    ctl.close()
+    if got is None:
+        return {"error": "no turns delivered within 300s"}
+    turns, secs = got
+    return {"turns_per_sec": round(turns / secs, 1), "turns": turns}
+
+
 def expected_alive() -> int | None:
     csv = _golden(f"check/alive/{W}x{H}.csv")
     if csv is None:
@@ -484,6 +538,10 @@ def main() -> None:
         detail["diff_kernel_512x512"] = measure_diff_rate(latency)
     except Exception as e:
         detail["diff_kernel_512x512"] = {"error": repr(e)}
+    try:
+        detail["wire_watched_512x512"] = measure_wire_watched()
+    except Exception as e:
+        detail["wire_watched_512x512"] = {"error": repr(e)}
     detail["first_alive_report_s"] = first_report
     # The pallas-packed vs XLA-packed-fori_loop ratio the README quotes.
     try:

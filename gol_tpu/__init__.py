@@ -25,6 +25,7 @@ from gol_tpu.events import (
     CellFlipped,
     Event,
     FinalTurnComplete,
+    FlipBatch,
     ImageOutputComplete,
     State,
     StateChange,
@@ -38,13 +39,14 @@ __all__ = [
     "ImageOutputComplete",
     "StateChange",
     "CellFlipped",
+    "FlipBatch",
     "TurnComplete",
     "FinalTurnComplete",
     "State",
     "run",
 ]
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 
 def run(params, keypresses=None, events=None, **kwargs):

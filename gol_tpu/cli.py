@@ -273,8 +273,12 @@ def main(argv: Optional[list[str]] = None) -> int:
             print("warning: --cycle-detect only engages on headless "
                   "fused runs; pass -noVis for it to fire",
                   file=sys.stderr)
+        # The built-in visualiser applies flips vectorized, so the local
+        # watched run uses per-turn FlipBatch arrays (library consumers
+        # of gol_tpu.run() keep the per-cell reference contract).
         engine = Engine(params, keypresses=keypresses,
-                        emit_flips=not args.novis, **engine_kwargs)
+                        emit_flips=not args.novis,
+                        emit_flip_batches=not args.novis, **engine_kwargs)
         engine.start()
         try:
             if args.novis:
@@ -359,8 +363,10 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
     from gol_tpu.distributed import Controller
 
     host, port = _addr(args.connect)
+    # batch=True: the visualiser applies each turn's flips as one
+    # vectorized XOR (events.FlipBatch) instead of per-cell objects.
     ctl = Controller(host, port, want_flips=not args.novis,
-                     secret=args.secret)
+                     secret=args.secret, batch=not args.novis)
 
     class _WireKeys:
         """queue.Queue-shaped sink that forwards verbs over the wire —

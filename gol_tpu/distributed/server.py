@@ -35,10 +35,12 @@ import struct
 import threading
 from typing import Optional
 
+import numpy as np
+
 from gol_tpu.checkpoint import snapshot_turn
 from gol_tpu.distributed import wire
 from gol_tpu.engine.distributor import Engine
-from gol_tpu.events import BoardSync, CellFlipped, TurnComplete
+from gol_tpu.events import BoardSync, CellFlipped, FlipBatch, TurnComplete
 from gol_tpu.io.pgm import read_pgm
 from gol_tpu.params import Params
 
@@ -114,8 +116,12 @@ class EngineServer:
             engine_kwargs.setdefault("initial_world", read_pgm(resume_from))
             engine_kwargs.setdefault("start_turn", snapshot_turn(resume_from))
         self._keys: queue.Queue = queue.Queue()
+        # Flips ride as per-turn FlipBatch arrays: the broadcaster and
+        # the wire consume them vectorized — per-cell Python event
+        # objects capped the whole watched pipeline at ~30 turns/s.
         self.engine = Engine(
-            params, keypresses=self._keys, emit_flips=False, **engine_kwargs
+            params, keypresses=self._keys, emit_flips=False,
+            emit_flip_batches=True, **engine_kwargs
         )
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
@@ -295,19 +301,28 @@ class EngineServer:
     # --- engine → controller ---
 
     def _broadcast_loop(self) -> None:
-        """Single consumer of the engine's event stream; batches each
-        turn's CellFlipped burst into one wire message."""
-        flips: list = []
+        """Single consumer of the engine's event stream; each turn's
+        flips become one wire message — from a FlipBatch array directly
+        (the engine's vectorized form) or by batching a CellFlipped
+        burst (engines injected with the per-cell contract)."""
+        flips: "list | object" = []
         flips_turn = 0
         for ev in self.engine.events:
             conn = self._conn
+            if isinstance(ev, FlipBatch):
+                if conn is not None and conn.want_flips and len(ev.cells):
+                    flips_turn = ev.completed_turns
+                    flips = ev.cells
+                continue
             if isinstance(ev, CellFlipped):
                 if conn is not None and conn.want_flips:
                     flips_turn = ev.completed_turns
+                    if not isinstance(flips, list):
+                        flips = []
                     flips.append([ev.cell.x, ev.cell.y])
                 continue
             if conn is None:
-                flips.clear()
+                flips = []
                 if isinstance(ev, BoardSync):
                     # Sync requested by a controller that vanished: drop
                     # the stale enable_flips so a detached engine pays
@@ -325,25 +340,25 @@ class EngineServer:
                         # one, so keying off synced would freeze it).
                         self._refresh_flips()
                         continue
-                    flips.clear()  # the sync supersedes any batched diff
+                    flips = []  # the sync supersedes any batched diff
                     conn.send(wire.board_to_msg(ev.completed_turns, ev.world,
                                                 ev.token))
                     conn.synced = True
                     continue
                 if not conn.synced:
                     continue  # pre-sync events are not this controller's
-                if flips and isinstance(ev, TurnComplete):
+                if len(flips) and isinstance(ev, TurnComplete):
                     conn.send(
                         wire.flips_to_msg(flips_turn, flips)
                         if conn.compact
                         else {"t": "flips", "turn": flips_turn,
-                              "cells": flips}
+                              "cells": np.asarray(flips).tolist()}
                     )
-                    flips.clear()
+                    flips = []
                 conn.send(wire.event_to_msg(ev))
             except (wire.WireError, OSError):
                 self._detach(conn)
-                flips.clear()
+                flips = []
                 continue
         # Engine stream closed: the run is over (final turn, 'k', or stop).
         with self._conn_lock:

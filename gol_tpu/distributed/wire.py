@@ -129,6 +129,20 @@ def event_to_msg(ev: Event) -> dict:
     raise TypeError(f"unserializable event {ev!r}")
 
 
+def msg_flips_array(msg: dict) -> tuple:
+    """(turn, (N, 2) int32 x,y array) from a flips message — the
+    vectorized decode (Controller batch mode); `msg_to_events` expands
+    the same array into per-cell CellFlipped events."""
+    turn = msg["turn"]
+    if "cells_z" in msg:
+        coords = np.frombuffer(
+            zlib.decompress(base64.b64decode(msg["cells_z"])), np.int32
+        ).reshape(-1, 2)
+    else:
+        coords = np.asarray(msg["cells"], np.int32).reshape(-1, 2)
+    return turn, coords
+
+
 def flips_to_msg(turn: int, cells) -> dict:
     """One turn's flip batch as zlib'd int32 (x, y) pairs — the board-
     raster/FinalTurnComplete treatment applied to the per-turn stream
@@ -144,15 +158,8 @@ def msg_to_events(msg: dict) -> list[Event]:
     batch becomes one CellFlipped per cell)."""
     t = msg["t"]
     if t == "flips":
-        turn = msg["turn"]
-        if "cells_z" in msg:
-            coords = np.frombuffer(
-                zlib.decompress(base64.b64decode(msg["cells_z"])), np.int32
-            ).reshape(-1, 2)
-            return [
-                CellFlipped(turn, Cell(int(x), int(y))) for x, y in coords
-            ]
-        return [CellFlipped(turn, Cell(x, y)) for x, y in msg["cells"]]
+        turn, coords = msg_flips_array(msg)
+        return [CellFlipped(turn, Cell(int(x), int(y))) for x, y in coords]
     if t != "ev":
         raise TypeError(f"not an event message: {msg!r}")
     k, turn = msg["k"], msg["turn"]

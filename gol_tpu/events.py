@@ -21,6 +21,8 @@ import dataclasses
 import enum
 from typing import List
 
+import numpy as np
+
 from gol_tpu.utils.cell import Cell
 
 
@@ -85,6 +87,24 @@ class CellFlipped(Event):
     (ref: gol/distributor.go:212-220). Never logged (empty string)."""
 
     cell: Cell = Cell(0, 0)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class FlipBatch(Event):
+    """Framework extension (no reference analog): one turn's flipped
+    cells as a single (N, 2) int32 array of (x, y) pairs in row-major
+    board order — semantically identical to N CellFlipped events.
+    Opt-in (`Engine(emit_flip_batches=True)`): the per-cell stream is
+    the reference contract, but a watched 512² board flips thousands
+    of cells per turn and per-cell Python event objects cap the whole
+    watched pipeline at ~30 turns/s; the server, wire and visualiser
+    consume batches vectorized instead. Never logged."""
+
+    # np.ndarray (N, 2) int32 of (x, y); the default is a valid empty
+    # batch so a payload-less construction cannot poison consumers.
+    cells: "object" = dataclasses.field(
+        default_factory=lambda: np.zeros((0, 2), np.int32)
+    )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -23,3 +23,13 @@ def cells_from_mask(arr) -> "list[Cell]":
 
     ys, xs = np.nonzero(np.asarray(arr))
     return [Cell(int(x), int(y)) for x, y in zip(xs, ys)]
+
+
+def xy_from_mask(arr) -> "object":
+    """Nonzero coordinates of a (H, W) array as an (N, 2) int32 ndarray
+    of (x, y) pairs — the vectorized form of `cells_from_mask`, in the
+    SAME row-major order (events.FlipBatch payloads)."""
+    import numpy as np
+
+    ys, xs = np.nonzero(np.asarray(arr))
+    return np.column_stack([xs, ys]).astype(np.int32)

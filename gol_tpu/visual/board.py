@@ -18,6 +18,23 @@ import threading
 
 import numpy as np
 
+def _batch_mask(cells, width: int, height: int) -> "np.ndarray | None":
+    """(N, 2) x,y pairs -> a {0,1} (H, W) flip mask, or None for an
+    empty batch; bounds-checked with the same strictness as per-pixel
+    flips. (A FlipBatch never contains duplicates — it comes from a
+    mask — so one mask XOR equals N pixel flips.)"""
+    cells = np.asarray(cells, dtype=np.int64).reshape(-1, 2)
+    if len(cells) == 0:
+        return None
+    xs, ys = cells[:, 0], cells[:, 1]
+    if (xs.min() < 0 or ys.min() < 0
+            or int(xs.max()) >= width or int(ys.max()) >= height):
+        raise IndexError("pixel out of range")
+    mask = np.zeros((height, width), np.uint8)
+    mask[ys, xs] = 1
+    return mask
+
+
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
 _LIB_PATH = _NATIVE_DIR / "libgolvis.so"
 _build_lock = threading.Lock()
@@ -117,6 +134,13 @@ class NativeBoard:
     def flip_mask(self, mask: np.ndarray) -> None:
         self._lib.golvis_flip_mask(self._h, self._as_bytes(mask))
 
+    def flip_batch(self, cells) -> None:
+        """XOR a whole turn's (x, y) flips in one native call
+        (events.FlipBatch payloads)."""
+        mask = _batch_mask(cells, self.width, self.height)
+        if mask is not None:
+            self.flip_mask(mask)
+
     def _as_bytes(self, mask: np.ndarray) -> bytes:
         m = np.ascontiguousarray(mask, dtype=np.uint8)
         if m.shape != (self.height, self.width):
@@ -178,6 +202,13 @@ class NumpyBoard:
 
     def flip_mask(self, mask: np.ndarray) -> None:
         self._px ^= self._checked(mask)
+
+    def flip_batch(self, cells) -> None:
+        """XOR a whole turn's (x, y) flips vectorized
+        (events.FlipBatch payloads)."""
+        mask = _batch_mask(cells, self.width, self.height)
+        if mask is not None:
+            self.flip_mask(mask)
 
     def _checked(self, mask: np.ndarray) -> np.ndarray:
         # Same strictness as NativeBoard._as_bytes — no silent broadcast.

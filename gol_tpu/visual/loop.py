@@ -21,7 +21,12 @@ from __future__ import annotations
 import queue
 from typing import Callable, Optional
 
-from gol_tpu.events import CellFlipped, FinalTurnComplete, TurnComplete
+from gol_tpu.events import (
+    CellFlipped,
+    FinalTurnComplete,
+    FlipBatch,
+    TurnComplete,
+)
 from gol_tpu.params import Params
 from gol_tpu.visual.board import make_board
 
@@ -72,6 +77,10 @@ def run_loop(
 
             if isinstance(ev, CellFlipped):
                 board.flip(ev.cell.x, ev.cell.y)
+            elif isinstance(ev, FlipBatch):
+                # One vectorized XOR per turn (the opt-in batch form —
+                # semantically N CellFlipped events).
+                board.flip_batch(ev.cells)
             elif isinstance(ev, TurnComplete):
                 board.render()
                 if on_turn is not None:

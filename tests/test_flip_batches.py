@@ -1,0 +1,161 @@
+"""FlipBatch — the opt-in vectorized form of the per-cell flip stream.
+
+Per-cell CellFlipped events are the reference contract
+(ref: gol/event.go:50-53); at thousands of flips per turn the Python
+event objects alone cap a watched pipeline at ~30 turns/s, so the
+engine server, wire and visualiser can opt into one (N, 2) ndarray per
+turn instead. Pinned here: batch payloads carry EXACTLY the per-cell
+stream's cells in the same order, every consumer (board, loop, wire,
+controller) reconstructs bit-identical state, and the default stays
+per-cell.
+"""
+
+import dataclasses
+import queue
+import threading
+
+import numpy as np
+import pytest
+
+from gol_tpu.engine.distributor import Engine, EventQueue
+from gol_tpu.events import CellFlipped, FlipBatch, TurnComplete
+from gol_tpu.params import Params
+from gol_tpu.utils.cell import xy_from_mask
+from gol_tpu.visual.board import NumpyBoard
+from gol_tpu.visual.loop import run_loop
+
+H = W = 64
+
+
+def _params(images_dir, tmp_path, **kw):
+    defaults = dict(turns=23, threads=1, image_width=W, image_height=H,
+                    chunk=0, image_dir=str(images_dir),
+                    out_dir=str(tmp_path / "out"), tick_seconds=60.0)
+    defaults.update(kw)
+    return Params(**defaults)
+
+
+def _run(engine):
+    engine.start()
+    evs = list(engine.events)
+    engine.join(timeout=300)
+    if engine.error is not None:
+        raise engine.error
+    return evs
+
+
+def test_batch_stream_equals_per_cell_stream(images_dir, tmp_path):
+    """Per turn, the FlipBatch payload is exactly the per-cell stream's
+    cells, in the same order; all other events are identical."""
+    p = _params(images_dir, tmp_path)
+    cells_evs = _run(Engine(p, events=EventQueue(), emit_flips=True))
+    batch_evs = _run(Engine(p, events=EventQueue(), emit_flips=True,
+                            emit_flip_batches=True))
+
+    def split(evs, flip_type):
+        flips, others = {}, []
+        turn_key = 0
+        for ev in evs:
+            if isinstance(ev, flip_type):
+                turn_key = ev.completed_turns
+                flips.setdefault(turn_key, []).append(ev)
+            elif type(ev).__name__ != "AliveCellsCount":
+                others.append(str((type(ev).__name__, ev.completed_turns)))
+        return flips, others
+
+    per_cell, others_a = split(cells_evs, CellFlipped)
+    batches, others_b = split(batch_evs, FlipBatch)
+    assert others_a == others_b
+    assert set(per_cell) == set(batches)
+    for turn, evs in per_cell.items():
+        want = [[e.cell.x, e.cell.y] for e in evs]
+        (batch,) = batches[turn]
+        np.testing.assert_array_equal(batch.cells, np.asarray(want))
+
+
+def test_run_loop_applies_batches_bit_exact(images_dir, tmp_path, golden_root):
+    """The visualiser loop drives a shadow board from a batch stream to
+    the same pixels the golden board has (the TestSdl-analog protocol
+    with the vectorized path)."""
+    from gol_tpu.io.pgm import read_pgm
+
+    p = _params(images_dir, tmp_path, turns=100)
+    engine = Engine(p, events=EventQueue(), emit_flips=True,
+                    emit_flip_batches=True)
+    engine.start()
+    board = NumpyBoard(W, H)
+    run_loop(p, engine.events, board=board, want_window=False)
+    engine.join(timeout=300)
+    want = np.asarray(
+        read_pgm(golden_root / "check" / "images" / "64x64x100.pgm")
+    ) != 0
+    np.testing.assert_array_equal(board._px, want)
+
+
+def test_board_flip_batch_matches_per_pixel():
+    rng = np.random.default_rng(3)
+    cells = xy_from_mask(rng.random((H, W)) < 0.2)
+    a, b = NumpyBoard(W, H), NumpyBoard(W, H)
+    a.flip_batch(cells)
+    for x, y in cells:
+        b.flip(int(x), int(y))
+    np.testing.assert_array_equal(a._px, b._px)
+    with pytest.raises(IndexError):
+        a.flip_batch(np.asarray([[W, 0]], np.int32))
+    a.flip_batch(np.zeros((0, 2), np.int32))  # empty batch is a no-op
+
+
+def test_controller_batch_mode_reconstructs_board(golden_root, tmp_path):
+    """Server (FlipBatch engine) -> wire -> batch-mode controller ->
+    board: bit-exact against the golden board, with zero per-cell
+    events on the client."""
+    from gol_tpu.distributed import Controller, EngineServer
+
+    p = _params(golden_root / "images", tmp_path, turns=100)
+    server = EngineServer(p, port=0).start()
+    ctl = Controller(*server.address, want_flips=True, batch=True)
+    board = NumpyBoard(W, H)
+    saw_per_cell = False
+    turns = 0
+    for ev in ctl.events:
+        if isinstance(ev, FlipBatch):
+            board.flip_batch(ev.cells)
+        elif isinstance(ev, CellFlipped):
+            saw_per_cell = True
+        elif isinstance(ev, TurnComplete):
+            turns = ev.completed_turns
+    assert server.wait(60)
+    ctl.close()
+    assert not saw_per_cell
+    assert turns == 100
+    from gol_tpu.io.pgm import read_pgm
+
+    want = np.asarray(
+        read_pgm(golden_root / "check" / "images" / "64x64x100.pgm")
+    ) != 0
+    np.testing.assert_array_equal(board._px, want)
+
+
+def test_per_cell_client_still_served_by_batch_server(golden_root, tmp_path):
+    """A default (per-cell) controller against the batch-emitting server
+    sees the reference-contract stream — the wire expansion hides the
+    server's internal form."""
+    from gol_tpu.distributed import Controller, EngineServer
+
+    p = _params(golden_root / "images", tmp_path, turns=50)
+    server = EngineServer(p, port=0).start()
+    ctl = Controller(*server.address, want_flips=True)
+    board = NumpyBoard(W, H)
+    for ev in ctl.events:
+        if isinstance(ev, CellFlipped):
+            board.flip(ev.cell.x, ev.cell.y)
+        assert not isinstance(ev, FlipBatch)
+    assert server.wait(60)
+    ctl.close()
+    from gol_tpu.io.pgm import read_pgm
+    from gol_tpu.ops import life
+
+    want = np.asarray(life.step_n(
+        read_pgm(golden_root / "images" / f"{W}x{H}.pgm"), 50
+    )) != 0
+    np.testing.assert_array_equal(board._px, want)
