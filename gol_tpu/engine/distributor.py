@@ -258,6 +258,10 @@ class Engine:
         # _run_diff_chunk). Starts off; the first plain chunk's observed
         # activity enables it.
         self._sparse_cap: Optional[int] = None
+        # In-flight chunk of the pipelined diff path (see
+        # _diff_pipeline_step); engine thread only.
+        self._pending_diffs: Optional[dict] = None
+        self._last_diff_span_end = 0.0
 
     # --- public api ---
 
@@ -400,7 +404,14 @@ class Engine:
                 break
             if self.emit_flips:
                 if self.stepper.step_n_with_diffs is not None:
-                    turn = self._run_diff_chunk(turn)
+                    if self.stepper.fetch_diffs is None:
+                        # Single-device: overlap each chunk's transfer
+                        # with the previous chunk's fan-out.
+                        turn = self._diff_pipeline_step(turn)
+                    else:
+                        # Sharded/mirrored: the gather is a collective
+                        # that must stay in dispatch order.
+                        turn = self._run_diff_chunk(turn)
                     world = self._committed[1]
                     continue
                 tick = time.perf_counter() if self.timeline else 0.0
@@ -424,6 +435,10 @@ class Engine:
                 self._throttle_events()
                 self._maybe_autosave(turn, world)
             else:
+                # A controller detach mid-pipeline switches paths: the
+                # in-flight diff chunk's turns must land first.
+                turn = self._flush_pending_diffs(turn)
+                world = self._committed[1]
                 if cal is not None and not self.emit_turns:
                     # Calibration only advances on an undisturbed engine:
                     # an attached controller caps dispatches (and taxes
@@ -518,6 +533,12 @@ class Engine:
                         # revisit (anchor distances shrink as the walk
                         # re-anchors) could still collapse the tail.
 
+        # An in-flight diff chunk's turns are computed and its events
+        # owed — quit verbs land at chunk boundaries, exactly as on the
+        # unpipelined path.
+        turn = self._flush_pending_diffs(turn)
+        world = self._committed[1] if self._committed[1] is not None else world
+
         self._ticker_stop.set()
         self._last_pair = (turn, int(self._committed[2]))
         # Serve any sync request that arrived during the last dispatch
@@ -565,36 +586,118 @@ class Engine:
         Steady-state watched runs on a slow host link ride the SPARSE
         encoding when the stepper offers it: once a plain chunk shows
         the board changes few enough words per turn, subsequent chunks
-        ship [count, word indices, word values] rows instead of full
-        masks (device-side static-size nonzero), adapting the cap to
-        the observed activity; a truncated row (activity burst past the
-        cap) is detected by its count and the chunk is redone densely —
-        the stream is bit-identical on every path."""
+        ship [count, bitmap, word values] rows instead of full masks,
+        adapting the cap to the observed activity; a truncated row
+        (activity burst past the cap) is detected by its count and the
+        chunk is redone densely — the stream is bit-identical on every
+        path."""
+        return self._diff_consume(turn, self._diff_dispatch(turn))
+
+    def _diff_pipeline_step(self, turn: int) -> int:
+        """One iteration of the PIPELINED diff path (single-device
+        steppers): dispatch the next chunk — its device compute and its
+        host transfer (started eagerly with copy_to_host_async) overlap
+        the expansion and event fan-out of the chunk dispatched on the
+        previous iteration — then consume that previous chunk. The
+        event stream and its ordering are untouched: chunk N's events
+        are always emitted, and N committed, before any of chunk N+1's.
+        `_run`'s epilogue consumes a still-pending chunk when the loop
+        exits (quit verbs land at chunk boundaries, as before)."""
+        ahead = self._pending_diffs["k"] if self._pending_diffs else 0
+        nxt = turn + ahead
+        new_pending = (
+            self._diff_dispatch(nxt) if nxt < self.p.turns else None
+        )
+        if self._pending_diffs is not None:
+            turn = self._diff_consume(turn, self._pending_diffs)
+        self._pending_diffs = new_pending
+        return turn
+
+    def _flush_pending_diffs(self, turn: int) -> int:
+        """Consume the in-flight diff chunk, if any (loop exit)."""
+        if self._pending_diffs is not None:
+            turn = self._diff_consume(turn, self._pending_diffs)
+            self._pending_diffs = None
+        return turn
+
+    def _diff_dispatch(self, turn: int) -> dict:
+        """Dispatch one diff chunk starting after `turn` completed
+        turns and start its host transfer; no host-blocking work.
+
+        Dispatch runs one chunk AHEAD of consume on the pipelined path,
+        so the mutable knobs it reads are a chunk stale: the sparse cap
+        may already be doomed (an activity burst costs up to two dense
+        redos instead of one — the price of the one-chunk lag), and the
+        autosave anchor is projected forward to the boundary the
+        in-flight chunk will land on (consume caps chunks exactly at
+        cadence boundaries, so anchors only ever sit on them; the
+        projection can never overshoot, only avoid spurious 1-turn
+        chunks)."""
         p = self.p
-        cap = max(1, DIFF_STACK_BUDGET // max(p.image_height * p.image_width, 1))
+        pipelined = self._pending_diffs is not None or (
+            self.stepper.fetch_diffs is None
+        )
+        budget = DIFF_STACK_BUDGET // (2 if pipelined else 1)
+        cap = max(1, budget // max(p.image_height * p.image_width, 1))
         k = min(DIFF_CHUNK, cap, p.turns - turn)
         if p.chunk > 0:
             k = min(k, p.chunk)
         if p.autosave_turns > 0:
             # Never overshoot the autosave boundary (same contract as
-            # the fused path).
-            k = min(k, max(1, self._autosave_turn + p.autosave_turns - turn))
-        world = self._committed[1]
-        tick = time.perf_counter() if self.timeline else 0.0
-        rows, new_world, count = None, None, None
+            # the fused path), against the projected anchor (see above).
+            anchor = self._autosave_turn
+            if turn > anchor:
+                anchor += (turn - anchor) // p.autosave_turns * p.autosave_turns
+            k = min(k, max(1, anchor + p.autosave_turns - turn))
+        world = self._committed[1] if turn == self._committed[0] else None
+        if world is None:
+            # Pipelined dispatch continues from the not-yet-committed
+            # world of the in-flight chunk.
+            world = self._pending_diffs["new_world"]
+        pending = {"k": k, "world_before": world, "sparse_cap": None,
+                   "tick": time.perf_counter() if self.timeline else 0.0}
         if self._sparse_cap is not None:
-            got = self._dispatch_sparse(world, k)
-            if got is not None:
-                new_world, rows, count = got
-        if rows is None:  # plain masks (also the burst fallback)
-            new_world, diffs, count = self.stepper.step_n_with_diffs(world, k)
+            pending["sparse_cap"] = self._sparse_cap
+            new_world, buf, count = self.stepper.step_n_with_diffs_sparse(
+                world, k, self._sparse_cap
+            )
+        else:
+            new_world, buf, count = self.stepper.step_n_with_diffs(world, k)
+        start_copy = getattr(buf, "copy_to_host_async", None)
+        if start_copy is not None:  # overlap the transfer (jax Arrays)
+            start_copy()
+        pending.update(new_world=new_world, buf=buf, count=count)
+        return pending
+
+    def _diff_consume(self, turn: int, pending: dict) -> int:
+        """Materialize one dispatched diff chunk: decode (with the
+        sparse-overflow dense fallback), commit, emit, autosave."""
+        k = pending["k"]
+        new_world, count = pending["new_world"], pending["count"]
+        rows = None
+        if pending["sparse_cap"] is not None:
+            rows = self._decode_sparse(pending)
+            if rows is None:  # truncated: the board burst past the cap
+                self._sparse_cap = None
+                new_world, diffs, count = self.stepper.step_n_with_diffs(
+                    pending["world_before"], k
+                )
+                # (bit-identical to the discarded sparse result)
+        if rows is None:
+            if pending["sparse_cap"] is None:
+                diffs = pending["buf"]
             host_diffs = (self.stepper.fetch_diffs or np.asarray)(diffs)
             rows = [host_diffs[i] for i in range(k)]
             self._observe_diff_activity(rows)
         if self.timeline:
-            self.timeline.record(
-                turn + k, k, time.perf_counter() - tick, "diffs"
-            )
+            # Pipelined spans overlap at dispatch time; clamping each
+            # span's start to the previous span's end keeps them
+            # disjoint so Timeline's busy_seconds <= wall invariant
+            # (and the spans-sum semantics) survive the overlap.
+            now = time.perf_counter()
+            start = max(pending["tick"], self._last_diff_span_end)
+            self._last_diff_span_end = now
+            self.timeline.record(turn + k, k, now - start, "diffs")
         self._commit(turn + k, new_world, count)
         for i, row in enumerate(rows):
             t = turn + 1 + i
@@ -609,27 +712,16 @@ class Engine:
         self._maybe_autosave(turn, new_world)
         return turn
 
-    def _sparse_cap_ceiling(self) -> int:
-        total_words = (self.p.image_height // 32) * self.p.image_width
-        return total_words // 2
-
-    def _dispatch_sparse(self, world, k: int):
-        """Sparse-encoded diff dispatch ([count, bitmap, values] rows —
-        see Stepper.step_n_with_diffs_sparse). Returns (new_world,
-        dense word rows, count) or None when a turn overflowed the cap
-        (the caller redoes the chunk densely; the board burst, so
-        sparse turns off until a plain chunk shows it settled again)."""
+    def _decode_sparse(self, pending: dict):
+        """Sparse rows of a dispatched chunk -> dense word rows, or
+        None when any row was truncated (cap overflow)."""
         from gol_tpu.parallel.stepper import sparse_decode_rows
 
-        cap = self._sparse_cap
-        new_world, buf, count = self.stepper.step_n_with_diffs_sparse(
-            world, k, cap
-        )
-        host = np.ascontiguousarray(np.asarray(buf)).view(np.uint32)
+        cap = pending["sparse_cap"]
+        host = np.ascontiguousarray(np.asarray(pending["buf"])).view(np.uint32)
         counts = host[:, 0]
         max_m = int(counts.max()) if counts.size else 0
         if max_m > cap:
-            self._sparse_cap = None
             return None
         hw, w = self.p.image_height // 32, self.p.image_width
         rows = [
@@ -637,7 +729,11 @@ class Engine:
             for words in sparse_decode_rows(host, hw * w)
         ]
         self._adapt_sparse_cap(max_m)
-        return new_world, rows, count
+        return rows
+
+    def _sparse_cap_ceiling(self) -> int:
+        total_words = (self.p.image_height // 32) * self.p.image_width
+        return total_words // 2
 
     def _observe_diff_activity(self, rows) -> None:
         """After a plain packed chunk: enable sparse encoding when the

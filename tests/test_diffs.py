@@ -263,6 +263,28 @@ def test_engine_stream_identical_with_sparse_encoding(images_dir, tmp_path):
     assert got2 == want
 
 
+def test_pipelined_autosave_keeps_full_chunks(images_dir, tmp_path):
+    """Pipelined dispatch projects the autosave anchor forward: a
+    cadence equal to DIFF_CHUNK must yield full-size chunks landing
+    exactly on the boundaries — not the 256,1,255,... degradation a
+    stale anchor produces — while the snapshots still land exactly."""
+    from gol_tpu.utils.trace import Timeline
+
+    p = Params(turns=2 * DIFF_CHUNK, threads=1, image_width=W,
+               image_height=H, autosave_turns=DIFF_CHUNK, chunk=0,
+               image_dir=str(images_dir), out_dir=str(tmp_path))
+    tl = Timeline()
+    engine = Engine(p, events=EventQueue(), emit_flips=True, timeline=tl)
+    engine.start()
+    engine.join(timeout=300)
+    assert engine.error is None
+    assert [(s.turn, s.turns) for s in tl.spans] == [
+        (DIFF_CHUNK, DIFF_CHUNK), (2 * DIFF_CHUNK, DIFF_CHUNK),
+    ]
+    saved = sorted(int(f.stem.split("x")[-1]) for f in tmp_path.glob("*.pgm"))
+    assert saved == [DIFF_CHUNK, 2 * DIFF_CHUNK]
+
+
 def test_keys_still_serviced_between_diff_chunks(images_dir, tmp_path):
     """'q' lands at a chunk boundary: the run stops early with the
     snapshot + clean close, proving verbs stay live on the new path."""
