@@ -29,11 +29,12 @@ def glider_world(h=64, w=64):
     return world
 
 
-def run_engine(world, turns, cycle_detect, tmp_path, chunk=32):
+def run_engine(world, turns, cycle_detect, tmp_path, chunk=32,
+               rule="B3/S23"):
     p = Params(
         turns=turns, threads=1,
         image_width=world.shape[1], image_height=world.shape[0],
-        chunk=chunk, tick_seconds=60.0,
+        rule=rule, chunk=chunk, tick_seconds=60.0,
         image_dir=str(tmp_path), out_dir=str(tmp_path / "out"),
         cycle_detect=cycle_detect,
     )
@@ -101,6 +102,21 @@ def test_engine_no_jump_without_revisit(tmp_path):
     assert engine.skipped_turns == 0
     want = life.alive_cells(np.asarray(life.step_n(world, 200)))
     assert sorted(final.alive) == sorted(want)
+
+
+def test_engine_fast_forwards_periodic_generations_board(tmp_path):
+    """The detector is representation-agnostic (a full device compare of
+    whatever state the backend commits — one-hot planes included): a
+    Star Wars board whose lone cell dies out goes permanently empty, so
+    a 10M-turn run collapses and lands on the empty board."""
+    world = np.zeros((64, 64), np.uint8)
+    world[10, 10] = 255  # no B1: dies through the C=4 aging chain
+    turns = 10_000_001
+    engine, final = run_engine(world, turns, True, tmp_path,
+                               rule="B2/S345/C4")
+    assert engine.skipped_turns > 0
+    assert final is not None and final.completed_turns == turns
+    assert final.alive == []
 
 
 def test_cycle_detect_off_by_default():
