@@ -750,10 +750,13 @@ class Engine:
         over the observed peak, clamped to the ceiling (where the row is
         still ~2x under the mask). Enabling requires the peak to clear
         the ceiling with 2x margin — activity near the ceiling would
-        overflow-and-redo every other chunk — and a cap only SHRINKS
-        when the peak falls to a quarter of it: each distinct cap is a
-        recompile of the k-turn scan, so a peak hovering at a power-of-
-        two boundary must not flip-flop the size."""
+        overflow-and-redo every other chunk. Every cap is a power of
+        two (the ceiling clamp rounds DOWN to one), which makes shrink
+        hysteresis inherent: pow2(2*peak) < cap requires peak <= cap/4,
+        so an oscillating peak can never flip-flop the compiled size
+        (each distinct cap is a recompile of the k-turn scan). The
+        pow2-floored clamp still covers any peak the enable check
+        admits: 2*peak <= ceiling implies peak <= pow2floor(ceiling)."""
         ceiling = self._sparse_cap_ceiling()
         if ceiling < DIFF_SPARSE_MIN_CAP or 2 * max_words > ceiling:
             self._sparse_cap = None
@@ -763,10 +766,7 @@ class Engine:
             if max_words
             else DIFF_SPARSE_MIN_CAP
         )
-        cur = self._sparse_cap
-        if cur is not None and want < cur and 4 * max_words > cur:
-            want = cur  # within hysteresis band: keep the compiled size
-        self._sparse_cap = min(want, ceiling)
+        self._sparse_cap = min(want, 1 << (ceiling.bit_length() - 1))
 
     def _diff_mask(self, diff) -> np.ndarray:
         """One turn's diff row as a dense mask — packed uint32 word-rows

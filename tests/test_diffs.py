@@ -263,6 +263,55 @@ def test_engine_stream_identical_with_sparse_encoding(images_dir, tmp_path):
     assert got2 == want
 
 
+def test_sparse_cap_policy(images_dir, tmp_path):
+    """The adaptive cap's edges: enable needs 2x margin under the
+    ceiling, growth is immediate, shrink is hysteretic (a peak at a
+    power-of-two boundary must not flip-flop recompiles), and a burst
+    past half the words disables sparse."""
+    p = Params(turns=1, threads=1, image_width=512, image_height=512,
+               image_dir=str(images_dir), out_dir=str(tmp_path))
+    e = Engine(p, emit_flips=False)
+    ceiling = e._sparse_cap_ceiling()
+    assert ceiling == (512 // 32) * 512 // 2  # total_words // 2
+    # Enable at a modest peak.
+    e._adapt_sparse_cap(100)
+    assert e._sparse_cap == 256  # pow2(200)
+    # Growth is immediate.
+    e._adapt_sparse_cap(300)
+    assert e._sparse_cap == 1024
+    # Shrink hysteresis is inherent to the pow2 + 2x-headroom sizing:
+    # a peak just under the boundary keeps the compiled size...
+    e._adapt_sparse_cap(257)
+    assert e._sparse_cap == 1024
+    # ...and only a fall to a quarter of the cap shrinks it.
+    e._adapt_sparse_cap(60)
+    assert e._sparse_cap == 128
+    # A peak without the 2x ceiling margin disables sparse outright.
+    e._adapt_sparse_cap(ceiling // 2 + 1)
+    assert e._sparse_cap is None
+    # Quiet board re-enables at the floor.
+    e._adapt_sparse_cap(0)
+    assert e._sparse_cap == 64
+    e.stop()
+    e.events.close()
+
+    # Non-power-of-two ceiling (480x640: total_words//2 = 4800): the
+    # clamp rounds down to a power of two, so an oscillating peak still
+    # cannot flip-flop between a pow2 cap and the raw ceiling.
+    p2 = Params(turns=1, threads=1, image_width=640, image_height=480,
+                image_dir=str(images_dir), out_dir=str(tmp_path))
+    e2 = Engine(p2, emit_flips=False)
+    assert e2._sparse_cap_ceiling() == 4800
+    e2._adapt_sparse_cap(2000)
+    assert e2._sparse_cap == 4096  # pow2 floor of 4800, covers the peak
+    e2._adapt_sparse_cap(1300)
+    assert e2._sparse_cap == 4096  # inherent hysteresis holds
+    e2._adapt_sparse_cap(1000)
+    assert e2._sparse_cap == 2048
+    e2.stop()
+    e2.events.close()
+
+
 def test_pipelined_autosave_keeps_full_chunks(images_dir, tmp_path):
     """Pipelined dispatch projects the autosave anchor forward: a
     cadence equal to DIFF_CHUNK must yield full-size chunks landing

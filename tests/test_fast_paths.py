@@ -447,6 +447,33 @@ def test_packed_sharded_pallas_local_blocks_match_dense():
     assert int(count) == int(np.count_nonzero(want))
 
 
+def test_search_local_block_mode_scoring():
+    """The shared ghost-depth x kernel search: picks the higher-scoring
+    kernel per depth (shape factor included), skips misaligned depths,
+    and returns None when nothing fits."""
+    from gol_tpu.parallel.packed_halo import search_local_block_mode
+
+    # Only a 1-D plan exists: picked.
+    got = search_local_block_mode(
+        64, lambda e: (32, 4), lambda e: None
+    )
+    assert got == (4, "tiled")
+    # A 2-D plan with a much taller tile beats the thin 1-D strips.
+    got = search_local_block_mode(
+        64, lambda e: (8, 4), lambda e: (64, 4, 4096)
+    )
+    assert got == (4, "tiled2d")
+    # Equal tile heights: the 2-D frame's ghost columns lose.
+    got = search_local_block_mode(
+        64, lambda e: (64, 4), lambda e: (64, 4, 4096)
+    )
+    assert got == (4, "tiled")
+    # Nothing fits anywhere.
+    assert search_local_block_mode(64, lambda e: None, lambda e: None) is None
+    # Strips too thin for any ghost depth.
+    assert search_local_block_mode(3, lambda e: (8, 4), lambda e: None) is None
+
+
 def test_packed_sharded_tiled2d_local_blocks_match_dense():
     """Wide shards route their local blocks through the 2-D tiled
     kernel inside shard_map (interpreter mode on the CPU mesh): 3072
