@@ -56,11 +56,33 @@ from gol_tpu.events import (
 from gol_tpu.utils.cell import Cell
 
 MAX_FRAME = 64 << 20
+#: Decompressed-payload ceiling. The frame cap bounds *compressed*
+#: size only; a hostile or buggy peer could otherwise make a receiver
+#: allocate multi-GB buffers from a 64 MiB zlib bomb (ADVICE r4). 512
+#: MiB covers every legitimate payload (an 8192² raster is 64 MiB raw;
+#: a full-board flip of int32 pairs on the same board is 512 MiB) —
+#: callers that know the exact expected size pass a tighter limit.
+MAX_RAW = 512 << 20
 _LEN = struct.Struct(">I")
 
 
 class WireError(ConnectionError):
     pass
+
+
+def _decompress(data: bytes, limit: int = MAX_RAW) -> bytes:
+    """zlib-decompress with a hard output bound (never trusts the
+    peer's sizes — see MAX_RAW)."""
+    d = zlib.decompressobj()
+    out = d.decompress(data, limit)
+    if d.unconsumed_tail:
+        raise WireError(f"decompressed payload exceeds {limit} bytes")
+    if not d.eof:
+        # zlib.decompress would raise on an incomplete stream; the
+        # incremental object just stops — surface truncation/corruption
+        # instead of returning a silently partial payload.
+        raise WireError("truncated zlib stream")
+    return out
 
 
 def send_msg(sock: socket.socket, msg: dict) -> None:
@@ -136,7 +158,7 @@ def msg_flips_array(msg: dict) -> tuple:
     turn = msg["turn"]
     if "cells_z" in msg:
         coords = np.frombuffer(
-            zlib.decompress(base64.b64decode(msg["cells_z"])), np.int32
+            _decompress(base64.b64decode(msg["cells_z"])), np.int32
         ).reshape(-1, 2)
     else:
         coords = np.asarray(msg["cells"], np.int32).reshape(-1, 2)
@@ -173,7 +195,7 @@ def msg_to_events(msg: dict) -> list[Event]:
         return [TurnComplete(turn)]
     if k == "final":
         coords = np.frombuffer(
-            zlib.decompress(base64.b64decode(msg["alive_z"])), np.int32
+            _decompress(base64.b64decode(msg["alive_z"])), np.int32
         ).reshape(-1, 2)
         return [FinalTurnComplete(turn, [Cell(int(x), int(y)) for x, y in coords])]
     raise TypeError(f"unknown event kind {k!r}")
@@ -187,6 +209,11 @@ def board_to_msg(turn: int, world: np.ndarray, token: int = 0) -> dict:
 
 
 def msg_to_board(msg: dict) -> tuple[int, np.ndarray]:
-    raw = zlib.decompress(base64.b64decode(msg["data"]))
-    world = np.frombuffer(raw, np.uint8).reshape(msg["height"], msg["width"])
+    h, w = int(msg["height"]), int(msg["width"])
+    if h <= 0 or w <= 0 or h * w > MAX_RAW:
+        raise WireError(f"implausible board dimensions {w}x{h}")
+    # The header states the exact raster size — bound the inflation to
+    # it (reshape would reject a short payload either way).
+    raw = _decompress(base64.b64decode(msg["data"]), limit=h * w)
+    world = np.frombuffer(raw, np.uint8).reshape(h, w)
     return msg["turn"], world

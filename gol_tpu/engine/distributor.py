@@ -637,9 +637,7 @@ class Engine:
         pipelined = self._pending_diffs is not None or (
             self.stepper.fetch_diffs is None
         )
-        budget = DIFF_STACK_BUDGET // (2 if pipelined else 1)
-        cap = max(1, budget // max(p.image_height * p.image_width, 1))
-        k = min(DIFF_CHUNK, cap, p.turns - turn)
+        k = min(DIFF_CHUNK, self._diff_chunk_cap(pipelined), p.turns - turn)
         if p.chunk > 0:
             k = min(k, p.chunk)
         if p.autosave_turns > 0:
@@ -669,9 +667,30 @@ class Engine:
         pending.update(new_world=new_world, buf=buf, count=count)
         return pending
 
+    def _diff_chunk_cap(self, pipelined: bool) -> int:
+        """Max diff-chunk turns the device stack budget allows, from the
+        actual per-turn diff representation: packed word-row diffs are
+        H*W/8 bytes (uint32 words of 32 cells), dense bool masks H*W —
+        sizing packed backends as dense would clamp big boards to
+        chunks 8x under budget (ADVICE r4). Pipelined dispatch keeps
+        two stacks alive, so it halves the budget."""
+        p = self.p
+        budget = DIFF_STACK_BUDGET // (2 if pipelined else 1)
+        per_turn = p.image_height * p.image_width
+        if self.stepper.packed_diffs:
+            per_turn //= 8
+        return max(1, budget // max(per_turn, 1))
+
     def _diff_consume(self, turn: int, pending: dict) -> int:
         """Materialize one dispatched diff chunk: decode (with the
-        sparse-overflow dense fallback), commit, emit, autosave."""
+        sparse-overflow dense fallback), commit, emit, autosave.
+
+        The chunk's final turn/world are committed BEFORE its per-turn
+        events are emitted, so `completed_turns` (and the ticker's
+        alive sample) can run up to the chunk size ahead of what event
+        consumers have drained — the same observability skew as the
+        fused path; the event stream content itself is identical to
+        the per-turn path (pinned by tests/test_diffs.py)."""
         k = pending["k"]
         new_world, count = pending["new_world"], pending["count"]
         rows = None
@@ -707,6 +726,15 @@ class Engine:
                 for cell in self._diff_cells(row):
                     self.events.put(CellFlipped(t, cell))
             self.events.put(TurnComplete(t))
+            if (i & 31) == 31:
+                # Backpressure per ~32 turns, not per chunk: a slow
+                # consumer otherwise sees DIFF_CHUNK-sized queue bursts
+                # between throttle checks (ADVICE r4). Cheap when the
+                # queue is short (one qsize read). Verbs serviced here
+                # stamp `t` — the last turn whose events are out — not
+                # the already-committed turn+k, which would put a
+                # future turn number mid-stream.
+                self._throttle_events(t)
         turn += k
         self._throttle_events()
         self._maybe_autosave(turn, new_world)
@@ -881,7 +909,7 @@ class Engine:
                 StateChange(turn, State.PAUSED if self._paused else State.EXECUTING)
             )
 
-    def _throttle_events(self) -> None:
+    def _throttle_events(self, turn: Optional[int] = None) -> None:
         """Producer-side backpressure: when an event consumer lags far
         behind (an engine can emit millions of TurnCompletes/s; a wire
         broadcaster drains tens of thousands), wait for the backlog to
@@ -897,9 +925,15 @@ class Engine:
         waiting on it would hang a run that used to complete, so after
         5s without a single get() the throttle disarms for the rest of
         the run and the queue just grows, the pre-backpressure
-        behavior."""
+        behavior.
+
+        `turn` stamps any StateChange a serviced verb emits; callers
+        throttling mid-emit pass the last turn whose events are out
+        (the committed turn may be a whole chunk ahead of the
+        stream)."""
         if self._throttle_disabled:
             return
+        at = self._committed[0] if turn is None else turn
         stalled_since = None
         last_consumed = self.events.consumed
         while (
@@ -908,7 +942,7 @@ class Engine:
             and not self.events.closed
         ):
             self._service_requests()
-            self._poll_keys(self._committed[0])
+            self._poll_keys(at)
             time.sleep(0.005)
             consumed = self.events.consumed
             if consumed != last_consumed:

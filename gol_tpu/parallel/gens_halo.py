@@ -109,7 +109,8 @@ def halo_step_states_uneven(
 
 
 def _gens_ring_stepper(name, devices, step_n, put, fetch,
-                       fetch_diffs=None, one_turn=None):
+                       fetch_diffs=None, one_turn=None,
+                       packed_diffs=False):
     """Shared Stepper assembly for the sharded gens variants (the
     _ring_stepper analog, plus the family's alive-only count and
     alive_mask). `one_turn` overrides the single-turn step the diff
@@ -170,6 +171,7 @@ def _gens_ring_stepper(name, devices, step_n, put, fetch,
         alive_mask=alive_mask,
         step_n_with_diffs=lambda w, k: _sync(_snd(w, int(k))),
         fetch_diffs=fetch_diffs,
+        packed_diffs=packed_diffs,
     )
 
 
@@ -469,5 +471,5 @@ def packed_gens_sharded_stepper(rule: GenRule, devices: list, height: int,
 
     return _gens_ring_stepper(
         f"gens-packed-halo-ring-{n}", devices, step_n, put, fetch,
-        fetch_diffs=spmd_fetch, one_turn=_one_turn,
+        fetch_diffs=spmd_fetch, one_turn=_one_turn, packed_diffs=True,
     )

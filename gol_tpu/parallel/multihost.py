@@ -264,6 +264,7 @@ def spmd_stepper(inner):
         alive_mask=inner.alive_mask,
         step_n_with_diffs=step_n_with_diffs,
         fetch_diffs=fetch_diffs,
+        packed_diffs=inner.packed_diffs,
     )
 
 

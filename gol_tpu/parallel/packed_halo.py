@@ -330,4 +330,5 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
         alive_count_async=lambda p: _sync(count(p)),
         step_n_with_diffs=lambda p, k: _sync(_snd(p, int(k))),
         fetch_diffs=spmd_fetch,
+        packed_diffs=True,
     )

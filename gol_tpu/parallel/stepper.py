@@ -67,6 +67,12 @@ class Stepper:
     #: axis = turn). None = plain np.asarray; sharded backends override
     #: to gather (and the uneven split to strip its padding rows).
     fetch_diffs: Optional[Callable] = None
+    #: True when `step_n_with_diffs` rows are packed uint32 word-rows
+    #: (H*W/8 bytes per turn) rather than dense bool masks (H*W) — the
+    #: engine sizes its diff-chunk budget from this, so packed big
+    #: boards get the full DIFF_STACK_BUDGET instead of chunks 8x
+    #: smaller than the stack actually is (ADVICE r4).
+    packed_diffs: bool = False
     #: (world, k, cap) -> (world, sparse_stack, count): the diff scan
     #: with each turn's flip mask SPARSE-encoded on device. One int32
     #: row per turn, laid out [changed_word_count (1), changed-word
@@ -245,6 +251,7 @@ def _packed_state_stepper(name: str, rule: Rule, height: int,
             lambda old, new: old ^ new,
             bitlife.count_packed,
         ),
+        packed_diffs=True,
         step_n_with_diffs_sparse=sparse_scan_diffs(
             lambda q: bitlife.step_packed(q, rule),
             lambda old, new: old ^ new,
@@ -518,6 +525,7 @@ def _gens_stepper_packed(rule: GenRule, device, height: int,
         alive_count_async=lambda p: _sync(_count(p)),
         alive_mask=_gens_alive_mask,
         step_n_with_diffs=lambda p, k: _sync(_snd(p, int(k))),
+        packed_diffs=True,
         step_n_with_diffs_sparse=lambda p, k, cap: _sync(
             _snd_sparse(p, int(k), int(cap))
         ),

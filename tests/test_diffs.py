@@ -354,3 +354,32 @@ def test_keys_still_serviced_between_diff_chunks(images_dir, tmp_path):
     assert engine.error is None
     assert 0 < engine.completed_turns < 10_000_000
     assert list(tmp_path.glob("*.pgm"))
+
+
+def test_diff_chunk_cap_sized_from_actual_row_bytes(images_dir, tmp_path):
+    """The stack budget divides by what a diff turn actually costs:
+    packed word-row diffs are H*W/8 bytes, dense masks H*W — a packed
+    16384² backend gets 8x the dense chunk instead of being clamped as
+    if its rows were dense (ADVICE r4)."""
+    import types
+
+    from gol_tpu.engine.distributor import DIFF_STACK_BUDGET
+
+    def cap(side, packed, pipelined=False):
+        p = Params(turns=10**6, threads=1, image_width=side,
+                   image_height=side, image_dir=str(images_dir),
+                   out_dir=str(tmp_path))
+        eng = Engine(
+            p,
+            stepper=types.SimpleNamespace(packed_diffs=packed),
+            io_service=types.SimpleNamespace(stop=lambda: None),
+        )
+        return eng._diff_chunk_cap(pipelined)
+
+    side = 16384  # dense stack: 256 MB/turn; packed: 32 MB/turn
+    assert cap(side, packed=False) == 1
+    assert cap(side, packed=True) == DIFF_STACK_BUDGET // (side * side // 8)
+    # Pipelined dispatch keeps two stacks alive: half the budget.
+    assert cap(side, packed=True, pipelined=True) == cap(side, True) // 2
+    # Small boards are bounded by DIFF_CHUNK elsewhere, not the budget.
+    assert cap(512, packed=True) > DIFF_CHUNK

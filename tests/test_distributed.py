@@ -417,3 +417,28 @@ def test_cycle_detect_waits_for_detach(golden_root, tmp_path):
     assert server.engine.skipped_turns > 0
     ctl.close()
     assert server.wait(30)
+
+
+def test_wire_decompression_bomb_rejected():
+    """The 64 MiB frame cap bounds compressed size only — a receiver
+    must never inflate a hostile payload past the raw ceiling, and a
+    board decode is bounded by the exact raster size its own header
+    states (ADVICE r4)."""
+    import zlib
+
+    from gol_tpu.distributed.wire import WireError, _decompress
+
+    blob = zlib.compress(bytes(1 << 20), 1)  # 1 MiB of zeros, ~1 KB wire
+    with pytest.raises(WireError):
+        _decompress(blob, limit=1 << 10)
+    assert _decompress(blob, limit=1 << 20) == bytes(1 << 20)
+    with pytest.raises(WireError):  # truncated stream: no silent partials
+        _decompress(blob[:-4])
+
+    msg = board_to_msg(1, np.zeros((256, 256), np.uint8))
+    msg["height"] = msg["width"] = 4  # lie about the raster size
+    with pytest.raises(WireError):
+        msg_to_board(msg)
+    with pytest.raises(WireError):
+        msg_to_board({"t": "board", "turn": 0, "height": -1, "width": 8,
+                      "data": ""})
