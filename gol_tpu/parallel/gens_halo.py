@@ -110,13 +110,15 @@ def halo_step_states_uneven(
 
 def _gens_ring_stepper(name, devices, step_n, put, fetch,
                        fetch_diffs=None, one_turn=None,
-                       packed_diffs=False):
+                       packed_diffs=False, strip=None):
     """Shared Stepper assembly for the sharded gens variants (the
     _ring_stepper analog, plus the family's alive-only count and
     alive_mask). `one_turn` overrides the single-turn step the diff
     scan uses — the packed ring passes its per-turn halo step so the
     watched path never pays deep-block ghost traffic or a pallas
-    launch per scanned turn."""
+    launch per scanned turn. `strip` (balanced packed split) maps a
+    padded (n*Sw, W) word-row array to the canonical (H/32, W) layout
+    so step_with_diff masks come out at the true board height."""
     from gol_tpu.parallel.stepper import Stepper, scan_diffs
 
     @jax.jit
@@ -133,7 +135,9 @@ def _gens_ring_stepper(name, devices, step_n, put, fetch,
             x = old[0] ^ new[0]
             for i in range(1, old.shape[0]):
                 x = x | (old[i] ^ new[i])
-            h = old.shape[1] * WORD
+            if strip is not None:
+                x = strip(x)
+            h = x.shape[0] * WORD
             return bitlife.unpack(x, h) != 0
         return old != new
 
@@ -318,7 +322,8 @@ def halo_step_packed_gens(planes: jax.Array, rule: GenRule,
 
 
 def gens_local_block_mode(strip_words: int, width: int, rule: GenRule,
-                          on_tpu: bool, force: bool | None = None) -> tuple:
+                          on_tpu: bool, force: bool | None = None,
+                          max_h: int | None = None) -> tuple:
     """(ghost word-rows h, local stepping mode) for packed gens deep
     blocks — the packed_halo.local_block_mode analog with the gens
     kernels' own VMEM cost models (plane count scales the working
@@ -332,6 +337,7 @@ def gens_local_block_mode(strip_words: int, width: int, rule: GenRule,
     if width % 128 == 0 and (on_tpu or force):
         ext = strip_words + 2 * _GENS_DEEP_WORDS
         if (ext % 8 == 0
+                and (max_h is None or _GENS_DEEP_WORDS <= max_h)
                 and pallas_bitgens.fits_pallas_gens(ext * WORD, width, rule)):
             return _GENS_DEEP_WORDS, "whole"
 
@@ -346,7 +352,7 @@ def gens_local_block_mode(strip_words: int, width: int, rule: GenRule,
             # exactly what step_n_packed_gens_pallas_tiled2d_raw runs.
             return pallas_bitgens._gens_tile2d_plan(e, width, rule)
 
-        found = search_local_block_mode(strip_words, plan_1d, plan_2d)
+        found = search_local_block_mode(strip_words, plan_1d, plan_2d, max_h)
         if found is not None:
             return found
     return 1, "xla"
@@ -472,4 +478,211 @@ def packed_gens_sharded_stepper(rule: GenRule, devices: list, height: int,
     return _gens_ring_stepper(
         f"gens-packed-halo-ring-{n}", devices, step_n, put, fetch,
         fetch_diffs=spmd_fetch, one_turn=_one_turn, packed_diffs=True,
+    )
+
+
+def packable_gens_sharded_uneven(height: int, shards: int) -> bool:
+    """Word-granular balanced split for the gens planes: every shard
+    owns at least one whole 32-row word (packed_halo.
+    packable_sharded_uneven, applied to the plane stacks)."""
+    from gol_tpu.parallel.packed_halo import packable_sharded_uneven
+
+    return packable_sharded_uneven(height, shards)
+
+
+def halo_step_packed_gens_balanced(planes: jax.Array, rule: GenRule,
+                                   real, axis: str = AXIS) -> jax.Array:
+    """One turn on balanced-split packed plane strips: the first `real`
+    word-rows of each shard's Sw-row strip are owned, padding below
+    stays zero — the packed_halo.halo_step_packed_balanced treatment
+    with only the ALIVE plane riding the ring (a gens cell's update
+    needs alive-neighbour counts only)."""
+    Sw = planes.shape[1]
+    alive = planes[0]
+    down, up = ring_perms(lax.axis_size(axis))
+    send_down = lax.dynamic_slice(
+        alive, (real - 1, jnp.int32(0)), (1, alive.shape[1])
+    )
+    above_last = lax.ppermute(send_down, axis, down)
+    below_first = lax.ppermute(alive[:1], axis, up)
+
+    carry_up = jnp.concatenate([above_last, alive[:-1]], axis=0)
+    up_b = (alive << jnp.uint32(1)) | (carry_up >> jnp.uint32(WORD - 1))
+    carry_down = jnp.concatenate([alive[1:], below_first], axis=0)
+    carry_down = lax.dynamic_update_slice(
+        carry_down, below_first, (real - 1, jnp.int32(0))
+    )
+    down_b = (alive >> jnp.uint32(1)) | (carry_down << jnp.uint32(WORD - 1))
+
+    new = jnp.stack(bitgens.step_planes(
+        tuple(planes[i] for i in range(planes.shape[0])), rule, up_b, down_b
+    ))
+    wid = lax.broadcasted_iota(jnp.int32, (1, Sw, 1), 1)
+    return jnp.where(wid < real, new, jnp.zeros_like(new))
+
+
+def packed_gens_sharded_stepper_uneven(rule: GenRule, devices: list,
+                                       height: int,
+                                       force_local_pallas: bool | None = None):
+    """Balanced-split packed Generations ring: (C-1, n*Sw, W) one-hot
+    planes, each shard owning the first `real` word-rows of its strip
+    (packed_halo.balanced_words), padding zero. Non-divisor shard
+    counts keep the SWAR planes, deep halos and pallas local blocks —
+    the family parity of VERDICT r4 Missing #1, matching the Life
+    ring's packed_sharded_stepper_uneven construction exactly (ghost
+    slabs extend ALL planes: a ghost cell's local evolution needs its
+    age)."""
+    from gol_tpu.parallel.packed_halo import balanced_words
+
+    n = len(devices)
+    if not packable_gens_sharded_uneven(height, n):
+        raise ValueError(
+            f"height {height} not balance-packable over {n} shards"
+        )
+    total_words = height // WORD
+    Sw, real_list = balanced_words(height, n)
+    rem_words = total_words % n
+    floor_words = total_words // n
+    offsets = np.concatenate([[0], np.cumsum(real_list)])
+    mesh = Mesh(np.asarray(devices), (AXIS,))
+    sharding = NamedSharding(mesh, P(None, AXIS, None))
+    spec = P(None, AXIS, None)
+    on_tpu = devices[0].platform == "tpu"
+
+    def _real():
+        idx = lax.axis_index(AXIS)
+        return jnp.where(idx < rem_words, jnp.int32(Sw), jnp.int32(Sw - 1))
+
+    def deep_block(planes, h: int, mode: str, turns: int, real):
+        """One h-word all-plane exchange, `turns` <= 32h exact local
+        turns — the Life balanced deep_block per plane (same
+        light-cone argument; the spliced below-ghost keeps real rows
+        contiguous)."""
+        from gol_tpu.ops import pallas_bitgens
+
+        assert 1 <= turns <= WORD * h
+        down, up = ring_perms(n)
+        swapped = jnp.swapaxes(planes, 0, 1)  # (rows, C-1, W)
+        send_down = lax.dynamic_slice(
+            swapped,
+            (real - h, jnp.int32(0), jnp.int32(0)),
+            (h, swapped.shape[1], swapped.shape[2]),
+        )
+        above = lax.ppermute(send_down, AXIS, down)
+        below = lax.ppermute(swapped[:h], AXIS, up)
+        ext = jnp.concatenate(
+            [above, swapped, jnp.zeros_like(swapped[:h])], axis=0
+        )
+        ext = lax.dynamic_update_slice(
+            ext, below, (h + real, jnp.int32(0), jnp.int32(0))
+        )
+        ext = jnp.swapaxes(ext, 0, 1)  # (C-1, rows + 2h, W)
+        if mode == "whole":
+            ext = pallas_bitgens.step_n_packed_gens_pallas_raw(
+                ext, turns, rule, interpret=not on_tpu
+            )
+        elif mode == "tiled":
+            ext = pallas_bitgens.step_n_packed_gens_pallas_tiled_raw(
+                ext, turns, rule, interpret=not on_tpu
+            )
+        elif mode == "tiled2d":
+            ext = pallas_bitgens.step_n_packed_gens_pallas_tiled2d_raw(
+                ext, turns, rule, interpret=not on_tpu
+            )
+        else:
+            ext = lax.fori_loop(
+                0, turns, lambda _, q: bitgens.step_packed_gens(q, rule), ext
+            )
+        out = ext[:, h : h + Sw]
+        wid = lax.broadcasted_iota(jnp.int32, (1, Sw, 1), 1)
+        return jnp.where(wid < real, out, jnp.zeros_like(out))
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def step_n(p, k):
+        h, mode = gens_local_block_mode(
+            Sw, p.shape[2], rule, on_tpu, force_local_pallas,
+            max_h=floor_words,
+        )
+        big, k2 = divmod(max(k, 0), WORD * h)
+        if mode == "xla":
+            mid, rem_t = divmod(k2, WORD)
+        else:
+            mid, rem_t = 0, 0
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P()),
+            check_vma=mode == "xla",
+        )
+        def _many(planes):
+            real = _real()
+            planes = lax.fori_loop(
+                0, big,
+                lambda _, q: deep_block(q, h, mode, WORD * h, real), planes
+            )
+            if mode != "xla" and k2:
+                planes = deep_block(planes, h, mode, k2, real)
+            planes = lax.fori_loop(
+                0, mid,
+                lambda _, q: deep_block(q, 1, "xla", WORD, real), planes
+            )
+            planes = lax.fori_loop(
+                0, rem_t,
+                lambda _, q: halo_step_packed_gens_balanced(q, rule, real),
+                planes,
+            )
+            count = lax.psum(bitlife.count_packed(planes[0]), AXIS)
+            return planes, count
+
+        return _many(p)
+
+    from gol_tpu.parallel.multihost import spmd_fetch, spmd_put
+
+    def _strip(d):
+        """Padded (..., n*Sw, W) word-rows -> canonical (..., H/32, W)."""
+        return jnp.concatenate(
+            [d[..., i * Sw : i * Sw + real_list[i], :] for i in range(n)],
+            axis=-2,
+        )
+
+    def put(levels_world):
+        words = bitgens.pack_states(
+            gens.states_from_levels(levels_world, rule), rule
+        )
+        padded = np.zeros((words.shape[0], n * Sw, words.shape[2]),
+                          np.uint32)
+        for i in range(n):
+            padded[:, i * Sw : i * Sw + real_list[i]] = (
+                words[:, offsets[i] : offsets[i + 1]]
+            )
+        return spmd_put(sharding, padded)
+
+    def fetch(arr):
+        if getattr(arr, "dtype", None) == jnp.uint32:
+            host = spmd_fetch(arr)
+            words = np.concatenate(
+                [host[:, i * Sw : i * Sw + real_list[i]] for i in range(n)],
+                axis=1,
+            )
+            return gens.levels_from_states(
+                bitgens.unpack_states(words, height, rule), rule
+            )
+        return spmd_fetch(arr)
+
+    def fetch_diffs(d):
+        host = spmd_fetch(d)
+        return np.concatenate(
+            [host[:, i * Sw : i * Sw + real_list[i]] for i in range(n)],
+            axis=1,
+        )
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec
+    )
+    def _one_turn(planes):
+        return halo_step_packed_gens_balanced(planes, rule, _real())
+
+    return _gens_ring_stepper(
+        f"gens-packed-halo-ring-uneven-{n}", devices, step_n, put, fetch,
+        fetch_diffs=fetch_diffs, one_turn=_one_turn, packed_diffs=True,
+        strip=_strip,
     )

@@ -383,3 +383,29 @@ def test_diff_chunk_cap_sized_from_actual_row_bytes(images_dir, tmp_path):
     assert cap(side, packed=True, pipelined=True) == cap(side, True) // 2
     # Small boards are bounded by DIFF_CHUNK elsewhere, not the budget.
     assert cap(512, packed=True) > DIFF_CHUNK
+
+
+def test_step_n_with_diffs_packed_uneven():
+    """The balanced-split packed ring's diff stack: per-turn rows are
+    fetched in the canonical (k, H/32, W) layout (padding word-rows
+    stripped) and expand to the exact per-turn masks."""
+    side = 128  # 4 word-rows over 3 shards = 2/1/1
+    world0 = np.asarray(life.random_world(side, W, seed=4))
+    s = make_stepper(threads=3, height=side, width=W)
+    assert s.name == "packed-halo-ring-uneven-3"
+
+    ref_masks, cur = [], s.put(world0)
+    for _ in range(TURNS):
+        cur, m, _ = s.step_with_diff(cur)
+        ref_masks.append(np.asarray(m) != 0)
+    want_world = s.fetch(cur)
+
+    new, diffs, count = s.step_n_with_diffs(s.put(world0), TURNS)
+    host = s.fetch_diffs(diffs)
+    assert host.shape == (TURNS, side // 32, W)
+    for i in range(TURNS):
+        np.testing.assert_array_equal(
+            _expand(host[i], side), ref_masks[i], err_msg=f"turn {i}"
+        )
+    np.testing.assert_array_equal(s.fetch(new), want_world)
+    assert int(count) == s.alive_count(new)

@@ -579,3 +579,111 @@ def test_random_rule_cross_backend_agreement(seed):
         np.testing.assert_array_equal(
             s.fetch(q), want, err_msg=f"{s.name} {rule}")
         assert int(count) == int(np.count_nonzero(want))
+
+
+# --- word-granular balanced split (packed uneven ring, VERDICT r4 #2) ---
+
+
+@pytest.mark.parametrize("shards", [3, 5, 7])
+@pytest.mark.parametrize("turns", [1, 37, 100])
+def test_packed_uneven_matches_dense(shards, turns):
+    """The balanced split (ceil/floor word-rows per shard) must be
+    bit-exact vs the serial dense engine at per-turn, deep-block and
+    mixed turn counts. 256 rows = 8 word-rows over 3/5/7."""
+    import jax
+
+    from gol_tpu.parallel.packed_halo import packed_sharded_stepper_uneven
+
+    world = random_world(256, 64, seed=shards)
+    s = packed_sharded_stepper_uneven(LIFE, jax.devices()[:shards], 256)
+    assert s.name == f"packed-halo-ring-uneven-{shards}"
+    p = s.put(world)
+    np.testing.assert_array_equal(s.fetch(p), np.asarray(world))  # turn 0
+    p, count = s.step_n(p, turns)
+    want = np.asarray(life.step_n(world, turns))
+    np.testing.assert_array_equal(
+        s.fetch(p), want, err_msg=f"shards={shards} turns={turns}"
+    )
+    assert int(count) == int(np.count_nonzero(want))
+
+
+def test_packed_uneven_diff_and_count():
+    """step_with_diff on the balanced split: the mask is the canonical
+    (H, W) dense diff — padding word-rows stripped before unpack."""
+    s = make_stepper(threads=3, height=128, width=64)
+    assert s.name == "packed-halo-ring-uneven-3"
+    world = random_world(128, 64, seed=2)
+    p = s.put(world)
+    new, mask, count = s.step_with_diff(p)
+    dense_new = np.asarray(life.step(world))
+    assert np.asarray(mask).shape == (128, 64)
+    np.testing.assert_array_equal(s.fetch(new), dense_new)
+    np.testing.assert_array_equal(
+        np.asarray(mask), (np.asarray(world) != 0) != (dense_new != 0)
+    )
+    assert int(s.alive_count_async(new)) == int(count)
+
+
+def test_packed_uneven_pallas_local_blocks_match_dense():
+    """The pallas local-block fast path on the balanced split, forced
+    on the CPU mesh via interpreter mode: 1504 rows = 47 word-rows over
+    3 shards (16/16/15), so the ghost-extended block is 16+2*4 = 24
+    word-rows — whole-VMEM eligible with the 4-word slab under the
+    floor-shard cap. 165 turns = one 128-turn pallas block + a 37-turn
+    partial block (mode != xla runs the whole tail as one kernel)."""
+    import jax
+
+    from gol_tpu.parallel.packed_halo import (
+        local_block_mode,
+        packed_sharded_stepper_uneven,
+    )
+
+    assert local_block_mode(16, 128, on_tpu=False, force=True,
+                            max_h=15) == (4, "whole")
+    world = random_world(1504, 128, seed=9)
+    s = packed_sharded_stepper_uneven(
+        LIFE, jax.devices()[:3], 1504, force_local_pallas=True
+    )
+    p = s.put(world)
+    p, count = s.step_n(p, 165)
+    want = np.asarray(life.step_n(world, 165))
+    np.testing.assert_array_equal(s.fetch(p), want)
+    assert int(count) == int(np.count_nonzero(want))
+
+
+def test_local_block_mode_shortest_shard_cap():
+    """`max_h` caps the ghost slab at the shortest shard: every ghost
+    must come whole from ONE ring neighbour."""
+    from gol_tpu.parallel.packed_halo import local_block_mode
+
+    assert local_block_mode(8, 128, on_tpu=True, max_h=4) == (4, "whole")
+    assert local_block_mode(8, 128, on_tpu=True, max_h=3) == (1, "xla")
+    assert local_block_mode(256, 16384, on_tpu=True, max_h=8)[0] <= 8
+
+
+def test_balanced_split_rejects_divisor_counts():
+    """Divisor shard counts belong to the even ring: the balanced
+    constructors' own gate excludes them (a rem==0 split would make
+    the `real` arithmetic degenerate), and balanced_words stays
+    total-preserving either way."""
+    import jax
+
+    from gol_tpu.parallel.gens_halo import packed_gens_sharded_stepper_uneven
+    from gol_tpu.parallel.packed_halo import (
+        balanced_words,
+        packable_sharded_uneven,
+        packed_sharded_stepper_uneven,
+    )
+
+    assert not packable_sharded_uneven(128, 2)  # 4 words over 2: even
+    assert not packable_sharded_uneven(96, 3)   # 3 words over 3: even
+    assert packable_sharded_uneven(128, 3)
+    assert balanced_words(128, 2) == (2, [2, 2])
+    assert sum(balanced_words(512, 3)[1]) == 16
+    with pytest.raises(ValueError):
+        packed_sharded_stepper_uneven(LIFE, jax.devices()[:2], 128)
+    with pytest.raises(ValueError):
+        from gol_tpu.models.rules import get_rule as _gr
+
+        packed_gens_sharded_stepper_uneven(_gr("B2/S/C3"),
+                                           jax.devices()[:2], 128)
