@@ -46,6 +46,7 @@ class Controller:
         timeout: float = 30.0,
         secret: "str | None" = None,
         batch: bool = False,
+        binary: bool = True,
     ):
         #: batch=True delivers each turn's flips as ONE events.FlipBatch
         #: ndarray instead of per-cell CellFlipped objects — the form
@@ -69,10 +70,14 @@ class Controller:
         # handshake failure closes the socket and the event stream.
         self._sock = socket.create_connection((host, port), timeout=timeout)
         try:
-            # "compact" advertises the zlib'd-int32 flips encoding; a
-            # server that predates it just ignores the field and sends
-            # legacy JSON pairs (decodable either way).
-            hello = {"t": "hello", "want_flips": want_flips, "compact": True}
+            # "compact" advertises the zlib'd-int32 flips encoding and
+            # "binary" the raw tag+header+zlib frames; a server that
+            # predates either just ignores the field and sends what it
+            # knows (decodable on every path — recv_msg dispatches on
+            # the first payload byte). `binary=False` pins the JSON
+            # encodings (tests exercise the negotiation both ways).
+            hello = {"t": "hello", "want_flips": want_flips,
+                     "compact": True, "binary": bool(binary)}
             if secret is not None:
                 hello["secret"] = secret
             wire.send_msg(self._sock, hello)

@@ -40,7 +40,13 @@ import numpy as np
 from gol_tpu.checkpoint import snapshot_turn
 from gol_tpu.distributed import wire
 from gol_tpu.engine.distributor import Engine
-from gol_tpu.events import BoardSync, CellFlipped, FlipBatch, TurnComplete
+from gol_tpu.events import (
+    BoardSync,
+    CellFlipped,
+    FinalTurnComplete,
+    FlipBatch,
+    TurnComplete,
+)
 from gol_tpu.io.pgm import read_pgm
 from gol_tpu.params import Params
 
@@ -55,7 +61,7 @@ class _Conn:
     _next_token = itertools.count(1).__next__  # only the accept thread draws
 
     def __init__(self, sock: socket.socket, want_flips: bool,
-                 compact: bool = False):
+                 compact: bool = False, binary: bool = False):
         self.sock = sock
         # Send-side timeout only (SO_SNDTIMEO, not settimeout: the read
         # side must keep blocking forever — controllers send verbs
@@ -73,6 +79,11 @@ class _Conn:
         #: older controllers get legacy JSON pair lists (the skew the
         #: serve/connect split exists for runs both ways).
         self.compact = compact
+        #: Peer advertised raw binary frames (tag + header + zlib) for
+        #: the bulk plane — flips, board syncs, final alive sets ride
+        #: without the base64-inside-JSON inflation (~33% on a
+        #: link-bound watched run, VERDICT r4 Weak #4).
+        self.binary = binary
         #: Matches this connection to the BoardSync it requested.
         self.token = _Conn._next_token()
         # No events flow until this connection's BoardSync has been sent:
@@ -84,6 +95,10 @@ class _Conn:
     def send(self, msg: dict) -> None:
         with self._lock:
             wire.send_msg(self.sock, msg)
+
+    def send_raw(self, payload: bytes) -> None:
+        with self._lock:
+            wire.send_frame(self.sock, payload)
 
     def close(self) -> None:
         with contextlib.suppress(OSError):
@@ -198,7 +213,8 @@ class EngineServer:
                 continue
 
             conn = _Conn(sock, bool(hello.get("want_flips", False)),
-                         compact=bool(hello.get("compact", False)))
+                         compact=bool(hello.get("compact", False)),
+                         binary=bool(hello.get("binary", False)))
             with self._conn_lock:
                 if self._conn is not None:
                     busy = True
@@ -341,21 +357,33 @@ class EngineServer:
                         self._refresh_flips()
                         continue
                     flips = []  # the sync supersedes any batched diff
-                    conn.send(wire.board_to_msg(ev.completed_turns, ev.world,
-                                                ev.token))
+                    if conn.binary:
+                        conn.send_raw(wire.board_to_frame(
+                            ev.completed_turns, ev.world, ev.token
+                        ))
+                    else:
+                        conn.send(wire.board_to_msg(
+                            ev.completed_turns, ev.world, ev.token
+                        ))
                     conn.synced = True
                     continue
                 if not conn.synced:
                     continue  # pre-sync events are not this controller's
                 if len(flips) and isinstance(ev, TurnComplete):
-                    conn.send(
-                        wire.flips_to_msg(flips_turn, flips)
-                        if conn.compact
-                        else {"t": "flips", "turn": flips_turn,
-                              "cells": np.asarray(flips).tolist()}
-                    )
+                    if conn.binary:
+                        conn.send_raw(wire.flips_to_frame(flips_turn, flips))
+                    elif conn.compact:
+                        conn.send(wire.flips_to_msg(flips_turn, flips))
+                    else:
+                        conn.send({"t": "flips", "turn": flips_turn,
+                                   "cells": np.asarray(flips).tolist()})
                     flips = []
-                conn.send(wire.event_to_msg(ev))
+                if conn.binary and isinstance(ev, FinalTurnComplete):
+                    conn.send_raw(wire.final_to_frame(
+                        ev.completed_turns, ev.alive
+                    ))
+                else:
+                    conn.send(wire.event_to_msg(ev))
             except (wire.WireError, OSError):
                 self._detach(conn)
                 flips = []

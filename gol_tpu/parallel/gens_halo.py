@@ -637,12 +637,11 @@ def packed_gens_sharded_stepper_uneven(rule: GenRule, devices: list,
 
     from gol_tpu.parallel.multihost import spmd_fetch, spmd_put
 
+    from gol_tpu.parallel.packed_halo import strip_padding
+
     def _strip(d):
         """Padded (..., n*Sw, W) word-rows -> canonical (..., H/32, W)."""
-        return jnp.concatenate(
-            [d[..., i * Sw : i * Sw + real_list[i], :] for i in range(n)],
-            axis=-2,
-        )
+        return strip_padding(d, Sw, real_list)
 
     def put(levels_world):
         words = bitgens.pack_states(
@@ -658,22 +657,14 @@ def packed_gens_sharded_stepper_uneven(rule: GenRule, devices: list,
 
     def fetch(arr):
         if getattr(arr, "dtype", None) == jnp.uint32:
-            host = spmd_fetch(arr)
-            words = np.concatenate(
-                [host[:, i * Sw : i * Sw + real_list[i]] for i in range(n)],
-                axis=1,
-            )
+            words = strip_padding(spmd_fetch(arr), Sw, real_list)
             return gens.levels_from_states(
                 bitgens.unpack_states(words, height, rule), rule
             )
         return spmd_fetch(arr)
 
     def fetch_diffs(d):
-        host = spmd_fetch(d)
-        return np.concatenate(
-            [host[:, i * Sw : i * Sw + real_list[i]] for i in range(n)],
-            axis=1,
-        )
+        return strip_padding(spmd_fetch(d), Sw, real_list)
 
     @functools.partial(
         jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec

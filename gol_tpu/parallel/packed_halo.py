@@ -413,6 +413,22 @@ def balanced_words(height: int, n: int) -> tuple:
     return Sw, [Sw if i < rem else Sw - 1 for i in range(n)]
 
 
+def strip_padding(arr, Sw: int, real_list, axis: int = -2):
+    """Cut the balanced split's padding out of a padded word-row axis:
+    (..., n*Sw, ...) -> (..., total_words, ...), keeping each shard's
+    first real_list[i] rows. The ONE definition of the padded->canonical
+    layout map — device-side (_strip under jit) and host-side
+    (fetch/fetch_diffs) callers in both families share it, so the
+    layout cannot drift between the six call sites."""
+    xp = jnp if isinstance(arr, jax.Array) else np
+    index = [slice(None)] * arr.ndim
+    parts = []
+    for i, real in enumerate(real_list):
+        index[axis] = slice(i * Sw, i * Sw + real)
+        parts.append(arr[tuple(index)])
+    return xp.concatenate(parts, axis=axis)
+
+
 def packed_sharded_stepper_uneven(rule: Rule, devices: list, height: int,
                                   force_local_pallas: bool | None = None):
     """The balanced-split variant of `packed_sharded_stepper`: device
@@ -537,12 +553,8 @@ def packed_sharded_stepper_uneven(rule: Rule, devices: list, height: int,
         return step_n(p, 1)[0]
 
     def _strip(d):
-        """(..., n*Sw, W) padded word-rows -> (..., total_words, W)
-        canonical layout (static slices; runs under jit or on host)."""
-        return jnp.concatenate(
-            [d[..., i * Sw : i * Sw + real_list[i], :] for i in range(n)],
-            axis=-2,
-        )
+        """(..., n*Sw, W) padded word-rows -> (..., total_words, W)."""
+        return strip_padding(d, Sw, real_list)
 
     @jax.jit
     def step_with_diff(p):
@@ -567,10 +579,7 @@ def packed_sharded_stepper_uneven(rule: Rule, devices: list, height: int,
 
     def fetch(arr):
         if getattr(arr, "dtype", None) == jnp.uint32:
-            host = spmd_fetch(arr)
-            words = np.concatenate(
-                [host[i * Sw : i * Sw + real_list[i]] for i in range(n)]
-            )
+            words = strip_padding(spmd_fetch(arr), Sw, real_list)
             return bitlife.unpack_np(words, height)
         return spmd_fetch(arr)
 
@@ -578,11 +587,7 @@ def packed_sharded_stepper_uneven(rule: Rule, devices: list, height: int,
         # (k, n*Sw, W) padded diff stack -> (k, total_words, W): padding
         # rows are zero on both sides of every turn but must be cut out
         # so word-row indices map to global rows.
-        host = spmd_fetch(d)
-        return np.concatenate(
-            [host[:, i * Sw : i * Sw + real_list[i]] for i in range(n)],
-            axis=1,
-        )
+        return strip_padding(spmd_fetch(d), Sw, real_list)
 
     # Per-turn ring halos for the diff scan, exactly as the even ring.
     @functools.partial(
