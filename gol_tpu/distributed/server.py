@@ -186,7 +186,9 @@ class EngineServer:
             except OSError:
                 return  # listener closed
             try:
-                hello = wire.recv_msg(sock)
+                # Control-only receive: an unauthenticated peer must
+                # never make the server inflate a bulk zlib payload.
+                hello = wire.recv_msg(sock, allow_binary=False)
                 if not hello or hello.get("t") != "hello":
                     raise wire.WireError(f"bad hello: {hello!r}")
             except (wire.WireError, OSError, ValueError) as e:
@@ -287,7 +289,8 @@ class EngineServer:
     def _reader_loop(self, conn: _Conn) -> None:
         while True:
             try:
-                msg = wire.recv_msg(conn.sock)
+                # Controllers only ever send JSON control messages.
+                msg = wire.recv_msg(conn.sock, allow_binary=False)
             except (wire.WireError, OSError):
                 msg = None
             if msg is None:  # controller went away (crash or close)

@@ -106,11 +106,20 @@ def send_msg(sock: socket.socket, msg: dict) -> None:
     send_frame(sock, json.dumps(msg, separators=(",", ":")).encode())
 
 
-def recv_msg(sock: socket.socket) -> Optional[dict]:
+def recv_msg(sock: socket.socket,
+             allow_binary: bool = True) -> Optional[dict]:
     """Next message, or None on clean EOF at a frame boundary. Binary
     frames decode to the same dict shapes the JSON forms produce, with
     payloads already parsed (see _parse_frame) — consumers dispatch on
-    "t" either way."""
+    "t" either way. Every malformed payload raises WireError (JSON
+    included: a JSONDecodeError escaping here would kill reader
+    threads whose handlers expect WireError/OSError only).
+
+    `allow_binary=False` rejects bulk frames WITHOUT parsing them —
+    the engine server's receive side (hellos, key verbs) is
+    JSON-only, and refusing early means an unauthenticated peer can
+    never make the server inflate a zlib payload (the bulk decoders
+    allocate up to MAX_RAW on legitimate frames)."""
     header = _recv_exact(sock, _LEN.size, allow_eof=True)
     if header is None:
         return None
@@ -119,7 +128,12 @@ def recv_msg(sock: socket.socket) -> Optional[dict]:
         raise WireError(f"frame too large: {n} bytes")
     payload = _recv_exact(sock, n, allow_eof=False)
     if payload[:1] == b"{":
-        return json.loads(payload.decode())
+        try:
+            return json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise WireError(f"malformed JSON frame: {e}") from None
+    if not allow_binary:
+        raise WireError("unexpected binary frame on a control-only link")
     return _parse_frame(payload)
 
 
@@ -145,14 +159,19 @@ _BOARD_HDR = struct.Struct("<BQIIQ")    # tag, turn, width, height, token
 _FINAL_HDR = struct.Struct("<BQ")       # tag, turn
 
 
-def flips_to_frame(turn: int, cells) -> bytes:
-    """One turn's flip batch as a raw binary frame: header + zlib'd
-    int32 (x, y) pairs — the compact JSON form minus its ~33% base64
-    inflation on a link-bound path."""
+def _coords_to_frame(hdr: struct.Struct, tag: int, turn: int,
+                     cells) -> bytes:
+    """The one coordinate-list encoding (header + zlib'd int32 x,y
+    pairs) behind both the flips and final frames — the encode twin of
+    `_coords_from`."""
     coords = np.ascontiguousarray(np.asarray(cells, np.int32).reshape(-1, 2))
-    return _FLIPS_HDR.pack(_TAG_FLIPS, turn) + zlib.compress(
-        coords.tobytes(), 1
-    )
+    return hdr.pack(tag, turn) + zlib.compress(coords.tobytes(), 1)
+
+
+def flips_to_frame(turn: int, cells) -> bytes:
+    """One turn's flip batch as a raw binary frame — the compact JSON
+    form minus its ~33% base64 inflation on a link-bound path."""
+    return _coords_to_frame(_FLIPS_HDR, _TAG_FLIPS, turn, cells)
 
 
 def board_to_frame(turn: int, world: np.ndarray, token: int = 0) -> bytes:
@@ -162,10 +181,7 @@ def board_to_frame(turn: int, world: np.ndarray, token: int = 0) -> bytes:
 
 
 def final_to_frame(turn: int, alive) -> bytes:
-    coords = np.ascontiguousarray(np.asarray(alive, np.int32).reshape(-1, 2))
-    return _FINAL_HDR.pack(_TAG_FINAL, turn) + zlib.compress(
-        coords.tobytes(), 1
-    )
+    return _coords_to_frame(_FINAL_HDR, _TAG_FINAL, turn, alive)
 
 
 def _coords_from(blob: bytes) -> np.ndarray:

@@ -110,7 +110,8 @@ def halo_step_states_uneven(
 
 def _gens_ring_stepper(name, devices, step_n, put, fetch,
                        fetch_diffs=None, one_turn=None,
-                       packed_diffs=False, strip=None):
+                       packed_diffs=False, strip=None,
+                       sparse_post=None):
     """Shared Stepper assembly for the sharded gens variants (the
     _ring_stepper analog, plus the family's alive-only count and
     alive_mask). `one_turn` overrides the single-turn step the diff
@@ -156,6 +157,20 @@ def _gens_ring_stepper(name, devices, step_n, put, fetch,
         return old != new
 
     _snd = scan_diffs(one_turn or (lambda w: step_n(w, 1)[0]), _diff, count)
+    # Sparse rows for the packed rings (VERDICT r4 Missing #2): same
+    # per-turn scan, diff stripped to the canonical word layout on
+    # device, rows replicated (see packed_halo.replicate_rows).
+    _snd_sparse = None
+    if packed_diffs and one_turn is not None:
+        from gol_tpu.parallel.stepper import sparse_scan_diffs
+
+        def _diff_canonical(old, new):
+            x = _diff(old, new)
+            return x if strip is None else strip(x)
+
+        _snd_sparse = sparse_scan_diffs(
+            one_turn, _diff_canonical, count, post=sparse_post
+        )
     _sync = cpu_serializing_sync(devices)
 
     def alive_mask(levels) -> np.ndarray:
@@ -176,6 +191,10 @@ def _gens_ring_stepper(name, devices, step_n, put, fetch,
         step_n_with_diffs=lambda w, k: _sync(_snd(w, int(k))),
         fetch_diffs=fetch_diffs,
         packed_diffs=packed_diffs,
+        step_n_with_diffs_sparse=(
+            None if _snd_sparse is None
+            else lambda w, k, cap: _sync(_snd_sparse(w, int(k), int(cap)))
+        ),
     )
 
 
@@ -475,9 +494,12 @@ def packed_gens_sharded_stepper(rule: GenRule, devices: list, height: int,
     def _one_turn(planes):
         return halo_step_packed_gens(planes, rule)
 
+    from gol_tpu.parallel.packed_halo import replicate_rows
+
     return _gens_ring_stepper(
         f"gens-packed-halo-ring-{n}", devices, step_n, put, fetch,
         fetch_diffs=spmd_fetch, one_turn=_one_turn, packed_diffs=True,
+        sparse_post=replicate_rows(mesh),
     )
 
 
@@ -672,8 +694,10 @@ def packed_gens_sharded_stepper_uneven(rule: GenRule, devices: list,
     def _one_turn(planes):
         return halo_step_packed_gens_balanced(planes, rule, _real())
 
+    from gol_tpu.parallel.packed_halo import replicate_rows
+
     return _gens_ring_stepper(
         f"gens-packed-halo-ring-uneven-{n}", devices, step_n, put, fetch,
         fetch_diffs=fetch_diffs, one_turn=_one_turn, packed_diffs=True,
-        strip=_strip,
+        strip=_strip, sparse_post=replicate_rows(mesh),
     )

@@ -131,6 +131,7 @@ def device_count() -> int:
 _OP_PUT, _OP_STEP, _OP_STEP_N, _OP_DIFF, _OP_COUNT = 0, 1, 2, 3, 4
 _OP_FETCH_WORLD, _OP_FETCH_MASK, _OP_STOP = 5, 6, 7
 _OP_STEP_N_DIFFS, _OP_FETCH_DIFFS = 8, 9
+_OP_STEP_N_DIFFS_SPARSE, _OP_STEP_N_DIFFS_REDO = 10, 11
 
 
 def _bcast(value: np.ndarray) -> np.ndarray:
@@ -143,12 +144,13 @@ def _bcast(value: np.ndarray) -> np.ndarray:
     )
 
 
-def _bcast_cmd(op: int, arg: int = 0) -> tuple[int, int]:
+def _bcast_cmd(op: int, arg: int = 0, arg2: int = 0) -> tuple[int, int, int]:
     # int64: `arg` carries fused chunk sizes, and an int32 would wrap a
     # user --chunk >= 2^31 into a different k on the workers than the
-    # coordinator runs — a silent ring deadlock.
-    got = _bcast(np.asarray([op, arg], np.int64))
-    return int(got[0]), int(got[1])
+    # coordinator runs — a silent ring deadlock. `arg2` carries the
+    # sparse cap (a second static argument of the sparse diff scan).
+    got = _bcast(np.asarray([op, arg, arg2], np.int64))
+    return int(got[0]), int(got[1]), int(got[2])
 
 
 def round_robin_devices() -> list:
@@ -235,11 +237,36 @@ def spmd_stepper(inner):
             _bcast_cmd(_OP_FETCH_WORLD)
         return inner.fetch(arr)
 
+    # The one legal NON-linear dispatch: after a sparse-overflow, the
+    # engine redoes the chunk densely FROM THE SPARSE CALL'S INPUT
+    # (distributor._diff_consume). Workers replay against their own
+    # state refs, so that redo must be its own opcode telling them to
+    # step from the state they saved before the sparse dispatch —
+    # replaying it as a plain _OP_STEP_N_DIFFS would mix coordinator
+    # pre-chunk state with worker post-chunk state and silently
+    # diverge the ring. Detected by handle identity: the engine hands
+    # the redo exactly the array object it gave the sparse call.
+    _sparse_in = {"world": None}
+
     step_n_with_diffs = None
     if inner.step_n_with_diffs is not None:
         def step_n_with_diffs(world, k):
-            _bcast_cmd(_OP_STEP_N_DIFFS, int(k))
+            if world is not None and world is _sparse_in["world"]:
+                _bcast_cmd(_OP_STEP_N_DIFFS_REDO, int(k))
+            else:
+                _bcast_cmd(_OP_STEP_N_DIFFS, int(k))
+            _sparse_in["world"] = None
             return inner.step_n_with_diffs(world, int(k))
+
+    step_n_with_diffs_sparse = None
+    if inner.step_n_with_diffs_sparse is not None:
+        def step_n_with_diffs_sparse(world, k, cap):
+            # Both static arguments ride the opcode so every process
+            # compiles the identical sparse scan (a cap mismatch would
+            # be a divergent program and a silent deadlock).
+            _sparse_in["world"] = world
+            _bcast_cmd(_OP_STEP_N_DIFFS_SPARSE, int(k), int(cap))
+            return inner.step_n_with_diffs_sparse(world, int(k), int(cap))
 
     fetch_diffs = None
     if inner.step_n_with_diffs is not None:
@@ -265,6 +292,7 @@ def spmd_stepper(inner):
         step_n_with_diffs=step_n_with_diffs,
         fetch_diffs=fetch_diffs,
         packed_diffs=inner.packed_diffs,
+        step_n_with_diffs_sparse=step_n_with_diffs_sparse,
     )
 
 
@@ -275,8 +303,9 @@ def spmd_worker_loop(inner, height: int, width: int) -> None:
     state = None
     mask = None
     diffs = None
+    pre_sparse = None
     while True:
-        op, arg = _bcast_cmd(_OP_STOP)
+        op, arg, arg2 = _bcast_cmd(_OP_STOP)
         if op == _OP_PUT:
             host = _bcast(np.zeros((height, width), np.uint8))
             state = inner.put(host)
@@ -288,6 +317,20 @@ def spmd_worker_loop(inner, height: int, width: int) -> None:
             state, mask, _ = inner.step_with_diff(state)
         elif op == _OP_STEP_N_DIFFS:
             state, diffs, _ = inner.step_n_with_diffs(state, arg)
+        elif op == _OP_STEP_N_DIFFS_SPARSE:
+            # The sparse rows are replicated; the coordinator reads its
+            # local copy, workers just co-execute the scan. The rows go
+            # to a throwaway — NOT `diffs` — so a later _OP_FETCH_DIFFS
+            # still gathers the dense stack the coordinator holds. The
+            # pre-sparse state is kept for a possible overflow redo.
+            pre_sparse = state
+            state, _rows, _ = inner.step_n_with_diffs_sparse(
+                state, arg, arg2
+            )
+        elif op == _OP_STEP_N_DIFFS_REDO:
+            # Sparse-overflow redo: the coordinator re-steps the chunk
+            # densely from the sparse call's input (see spmd_stepper).
+            state, diffs, _ = inner.step_n_with_diffs(pre_sparse, arg)
         elif op == _OP_COUNT:
             inner.alive_count_async(state)
         elif op == _OP_FETCH_WORLD:

@@ -332,7 +332,7 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
             return bitlife.unpack_np(spmd_fetch(arr), height)
         return spmd_fetch(arr)
 
-    from gol_tpu.parallel.stepper import scan_diffs
+    from gol_tpu.parallel.stepper import scan_diffs, sparse_scan_diffs
 
     # Per-turn ring halos inside one scanned program; the diff stack
     # stays packed (k, H/32, W) and word-row-sharded until the engine's
@@ -345,6 +345,14 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
         return halo_step_packed(block, rule)
 
     _snd = scan_diffs(_one_turn, lambda old, new: old ^ new, count)
+    # Sparse rows over the same per-turn scan (VERDICT r4 Missing #2):
+    # the encode runs under jit over the sharded diff (XLA gathers),
+    # and the rows are pinned replicated so a multiprocess coordinator
+    # can materialize them with a plain np.asarray.
+    _snd_sparse = sparse_scan_diffs(
+        _one_turn, lambda old, new: old ^ new, count,
+        post=replicate_rows(mesh),
+    )
 
     _sync = cpu_serializing_sync(devices)
 
@@ -360,6 +368,9 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
         step_n_with_diffs=lambda p, k: _sync(_snd(p, int(k))),
         fetch_diffs=spmd_fetch,
         packed_diffs=True,
+        step_n_with_diffs_sparse=lambda p, k, cap: _sync(
+            _snd_sparse(p, int(k), int(cap))
+        ),
     )
 
 
@@ -411,6 +422,19 @@ def balanced_words(height: int, n: int) -> tuple:
     if rem == 0:  # divisible: every shard owns exactly Sw (even split)
         return Sw, [Sw] * n
     return Sw, [Sw if i < rem else Sw - 1 for i in range(n)]
+
+
+def replicate_rows(mesh):
+    """`post` hook for sparse_scan_diffs on ring steppers: pin the
+    per-turn sparse rows fully replicated over `mesh`, so np.asarray
+    materializes them on any process without a host collective."""
+    def post(new, rows, count):
+        rows = jax.lax.with_sharding_constraint(
+            rows, NamedSharding(mesh, P())
+        )
+        return new, rows, count
+
+    return post
 
 
 def strip_padding(arr, Sw: int, real_list, axis: int = -2):
@@ -597,6 +621,15 @@ def packed_sharded_stepper_uneven(rule: Rule, devices: list, height: int,
         return halo_step_packed_balanced(block, rule, _real())
 
     _snd = scan_diffs(_one_turn, lambda old, new: old ^ new, count)
+    # Sparse rows over the canonical layout: the diff is stripped of
+    # padding ON DEVICE, so the encode covers exactly (H/32)*W words —
+    # the engine's decoder needs no balanced-split awareness.
+    from gol_tpu.parallel.stepper import sparse_scan_diffs
+
+    _snd_sparse = sparse_scan_diffs(
+        _one_turn, lambda old, new: _strip(old ^ new), count,
+        post=replicate_rows(mesh),
+    )
 
     _sync = cpu_serializing_sync(devices)
 
@@ -612,4 +645,7 @@ def packed_sharded_stepper_uneven(rule: Rule, devices: list, height: int,
         step_n_with_diffs=lambda p, k: _sync(_snd(p, int(k))),
         fetch_diffs=fetch_diffs,
         packed_diffs=True,
+        step_n_with_diffs_sparse=lambda p, k, cap: _sync(
+            _snd_sparse(p, int(k), int(cap))
+        ),
     )

