@@ -169,20 +169,15 @@ def main(argv: Optional[list[str]] = None) -> int:
     print("Width:", args.w)
     print("Height:", args.h)
 
-    # The live visualiser is two-state; a generations rule runs
-    # headless, and the decision must land BEFORE the chunk default so
-    # the run gets the fused/auto-calibrated fast path like any -noVis.
+    # Multi-state rules visualise as gray levels (r5): the board runs
+    # in level mode and flip batches carry per-cell levels — no more
+    # forced-headless carve-out for the Generations family.
     from gol_tpu.models.rules import GenRule, get_rule
     try:
         rule_obj = get_rule(args.rule)
     except ValueError as e:
         raise SystemExit(f"error: {e}") from None
-    if isinstance(rule_obj, GenRule) and not args.novis:
-        if args.serve is None and args.connect is None:
-            print("warning: the live visualiser is two-state; running "
-                  "the generations rule headless (as with -noVis)",
-                  file=sys.stderr)
-            args.novis = True
+    vis_levels = isinstance(rule_obj, GenRule)
 
     # All engines default to chunk 0 (no cap): headless runs
     # auto-calibrate their fused dispatches, and a local visualiser
@@ -289,7 +284,8 @@ def main(argv: Optional[list[str]] = None) -> int:
             else:
                 from gol_tpu.visual import run_loop
 
-                run_loop(params, engine.events, keypresses)
+                run_loop(params, engine.events, keypresses,
+                         levels=vis_levels)
         except KeyboardInterrupt:
             keypresses.put("q")
         finally:
@@ -363,10 +359,17 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
     from gol_tpu.distributed import Controller
 
     host, port = _addr(args.connect)
+    from gol_tpu.models.rules import GenRule
+
+    # params.rule already holds the parsed rule object (main validated
+    # it) — one derivation point for the level-mode decision.
+    vis_levels = isinstance(params.rule, GenRule)
     # batch=True: the visualiser applies each turn's flips as one
-    # vectorized XOR (events.FlipBatch) instead of per-cell objects.
+    # vectorized XOR (events.FlipBatch) instead of per-cell objects;
+    # levels follows the rule family (gray-level gens batches, r5).
     ctl = Controller(host, port, want_flips=not args.novis,
-                     secret=args.secret, batch=not args.novis)
+                     secret=args.secret, batch=not args.novis,
+                     levels=vis_levels and not args.novis)
 
     class _WireKeys:
         """queue.Queue-shaped sink that forwards verbs over the wire —
@@ -413,7 +416,7 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
             params = dataclasses.replace(
                 params, image_width=w, image_height=h
             )
-            run_loop(params, ctl.events, wire_keys)
+            run_loop(params, ctl.events, wire_keys, levels=vis_levels)
         return 0
     finally:
         ctl.close()

@@ -47,6 +47,7 @@ class Controller:
         secret: "str | None" = None,
         batch: bool = False,
         binary: bool = True,
+        levels: bool = False,
     ):
         #: batch=True delivers each turn's flips as ONE events.FlipBatch
         #: ndarray instead of per-cell CellFlipped objects — the form
@@ -55,6 +56,10 @@ class Controller:
         #: watched run at ~30 turns/s. Default stays per-cell (the
         #: reference event contract).
         self._batch = batch
+        #: levels=True (multi-state rules, r5): board syncs replay as
+        #: level-setting batches and flips messages carrying levels
+        #: surface them on the FlipBatch — pair with a level-mode board.
+        self._levels = levels
         self.events = EventQueue()
         #: Board state from the attach sync (None until it arrives).
         self.board: Optional[np.ndarray] = None
@@ -77,7 +82,8 @@ class Controller:
             # the first payload byte). `binary=False` pins the JSON
             # encodings (tests exercise the negotiation both ways).
             hello = {"t": "hello", "want_flips": want_flips,
-                     "compact": True, "binary": bool(binary)}
+                     "compact": True, "binary": bool(binary),
+                     "levels": bool(levels)}
             if secret is not None:
                 hello["secret"] = secret
             wire.send_msg(self._sock, hello)
@@ -142,21 +148,39 @@ class Controller:
             # Replay as a flip burst + a render tick so any attached
             # visualiser shows the synced board immediately. Flips are
             # XOR for consumers, so the burst is the *difference* from
-            # the previous known state — idempotent under repeated syncs.
+            # the previous known state — idempotent under repeated
+            # syncs. Level mode compares gray grids directly and SETS
+            # the changed cells' levels instead (no rule needed: the
+            # raster IS the level grid).
             prev = self.board
-            diff = board != 0 if prev is None else (board != 0) ^ (prev != 0)
-            self.board = board
-            if self._batch:
-                self.events.put(FlipBatch(self.sync_turn, xy_from_mask(diff)))
+            if self._levels:
+                diff = board != (np.zeros_like(board) if prev is None else prev)
+                self.board = board
+                self.events.put(FlipBatch(
+                    self.sync_turn, xy_from_mask(diff), levels=board[diff]
+                ))
             else:
-                for cell in cells_from_mask(diff):
-                    self.events.put(CellFlipped(self.sync_turn, cell))
+                diff = (board != 0 if prev is None
+                        else (board != 0) ^ (prev != 0))
+                self.board = board
+                if self._batch:
+                    self.events.put(
+                        FlipBatch(self.sync_turn, xy_from_mask(diff))
+                    )
+                else:
+                    for cell in cells_from_mask(diff):
+                        self.events.put(CellFlipped(self.sync_turn, cell))
             self.events.put(TurnComplete(self.sync_turn))
             self.synced.set()
             return True
         if t == "flips" and self._batch:
             turn, coords = wire.msg_flips_array(msg)
-            self.events.put(FlipBatch(turn, coords))
+            lv = wire.msg_flips_levels(msg) if self._levels else None
+            if lv is not None and len(lv) != len(coords):
+                raise wire.WireError(
+                    f"{len(coords)} cells vs {len(lv)} levels"
+                )
+            self.events.put(FlipBatch(turn, coords, levels=lv))
             return True
         if t in ("ev", "flips"):
             for ev in wire.msg_to_events(msg):

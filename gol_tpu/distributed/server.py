@@ -61,7 +61,8 @@ class _Conn:
     _next_token = itertools.count(1).__next__  # only the accept thread draws
 
     def __init__(self, sock: socket.socket, want_flips: bool,
-                 compact: bool = False, binary: bool = False):
+                 compact: bool = False, binary: bool = False,
+                 levels: bool = False):
         self.sock = sock
         # Send-side timeout only (SO_SNDTIMEO, not settimeout: the read
         # side must keep blocking forever — controllers send verbs
@@ -84,6 +85,12 @@ class _Conn:
         #: without the base64-inside-JSON inflation (~33% on a
         #: link-bound watched run, VERDICT r4 Weak #4).
         self.binary = binary
+        #: Peer can apply per-cell gray levels (multi-state batches,
+        #: r5). Without it, level batches downgrade to plain flips —
+        #: a pre-r5 peer must keep receiving frames it understands
+        #: rather than ignorable unknown tags (a silently frozen
+        #: display).
+        self.levels = levels
         #: Matches this connection to the BoardSync it requested.
         self.token = _Conn._next_token()
         # No events flow until this connection's BoardSync has been sent:
@@ -216,7 +223,8 @@ class EngineServer:
 
             conn = _Conn(sock, bool(hello.get("want_flips", False)),
                          compact=bool(hello.get("compact", False)),
-                         binary=bool(hello.get("binary", False)))
+                         binary=bool(hello.get("binary", False)),
+                         levels=bool(hello.get("levels", False)))
             with self._conn_lock:
                 if self._conn is not None:
                     busy = True
@@ -325,6 +333,7 @@ class EngineServer:
         (the engine's vectorized form) or by batching a CellFlipped
         burst (engines injected with the per-cell contract)."""
         flips: "list | object" = []
+        flips_levels = None  # (N,) gray levels of a multi-state batch
         flips_turn = 0
         for ev in self.engine.events:
             conn = self._conn
@@ -332,6 +341,7 @@ class EngineServer:
                 if conn is not None and conn.want_flips and len(ev.cells):
                     flips_turn = ev.completed_turns
                     flips = ev.cells
+                    flips_levels = getattr(ev, "levels", None)
                 continue
             if isinstance(ev, CellFlipped):
                 if conn is not None and conn.want_flips:
@@ -342,6 +352,7 @@ class EngineServer:
                 continue
             if conn is None:
                 flips = []
+                flips_levels = None
                 if isinstance(ev, BoardSync):
                     # Sync requested by a controller that vanished: drop
                     # the stale enable_flips so a detached engine pays
@@ -360,6 +371,7 @@ class EngineServer:
                         self._refresh_flips()
                         continue
                     flips = []  # the sync supersedes any batched diff
+                    flips_levels = None
                     if conn.binary:
                         conn.send_raw(wire.board_to_frame(
                             ev.completed_turns, ev.world, ev.token
@@ -373,14 +385,25 @@ class EngineServer:
                 if not conn.synced:
                     continue  # pre-sync events are not this controller's
                 if len(flips) and isinstance(ev, TurnComplete):
+                    # Levels ride only to peers that advertised them.
+                    lv = flips_levels if conn.levels else None
                     if conn.binary:
-                        conn.send_raw(wire.flips_to_frame(flips_turn, flips))
+                        conn.send_raw(
+                            wire.level_flips_to_frame(flips_turn, flips, lv)
+                            if lv is not None
+                            else wire.flips_to_frame(flips_turn, flips)
+                        )
                     elif conn.compact:
-                        conn.send(wire.flips_to_msg(flips_turn, flips))
+                        conn.send(wire.flips_to_msg(
+                            flips_turn, flips, levels=lv
+                        ))
                     else:
+                        # Legacy JSON peers are two-state; levels are
+                        # dropped (they could not apply them anyway).
                         conn.send({"t": "flips", "turn": flips_turn,
                                    "cells": np.asarray(flips).tolist()})
                     flips = []
+                    flips_levels = None
                 if conn.binary and isinstance(ev, FinalTurnComplete):
                     conn.send_raw(wire.final_to_frame(
                         ev.completed_turns, ev.alive
@@ -390,6 +413,7 @@ class EngineServer:
             except (wire.WireError, OSError):
                 self._detach(conn)
                 flips = []
+                flips_levels = None
                 continue
         # Engine stream closed: the run is over (final turn, 'k', or stop).
         with self._conn_lock:

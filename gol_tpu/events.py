@@ -105,6 +105,12 @@ class FlipBatch(Event):
     cells: "object" = dataclasses.field(
         default_factory=lambda: np.zeros((0, 2), np.int32)
     )
+    # Optional (N,) uint8 gray levels of the listed cells (the
+    # Generations family's injective PGM levels). None = two-state
+    # batch, applied as an XOR; with levels the batch SETS each cell's
+    # level — the multi-state visual contract (r5: gray-level gens
+    # visualisation, no more forced-headless carve-out).
+    levels: "object" = None
 
 
 @dataclasses.dataclass(frozen=True)

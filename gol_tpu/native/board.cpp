@@ -188,6 +188,65 @@ void golvis_flip_mask(Board* b, const uint8_t* mask) {
     if (mask[i]) b->pixels[i] ^= 0xFFFFFFFFu;
 }
 
+// ---- gray-level mode (multi-state Generations rules, r5) ------------------
+// A level v in 0..255 renders as the gray ARGB pixel FF·vvvvvv (0 stays
+// fully dead/black). The two-state ops above remain valid on the same
+// framebuffer: 255 encodes to 0xFFFFFFFF, exactly the lit pixel.
+
+static inline uint32_t encode_level(uint8_t v) {
+  return v ? (0xFF000000u | ((uint32_t)v * 0x010101u)) : 0u;
+}
+
+// Bulk load a full gray byte grid — golvis_load_mask generalized to levels.
+void golvis_load_levels(Board* b, const uint8_t* levels) {
+  if (!b || !levels) return;
+  const size_t total = (size_t)b->w * b->h;
+  for (size_t i = 0; i < total; ++i) b->pixels[i] = encode_level(levels[i]);
+}
+
+// Set every masked cell to its grid level — the bulk form of a level
+// FlipBatch (levels SET cells; two-state batches XOR them).
+void golvis_update_levels(Board* b, const uint8_t* mask,
+                          const uint8_t* levels) {
+  if (!b || !mask || !levels) return;
+  const size_t total = (size_t)b->w * b->h;
+  for (size_t i = 0; i < total; ++i)
+    if (mask[i]) b->pixels[i] = encode_level(levels[i]);
+}
+
+int golvis_set_level(Board* b, int x, int y, int level) {
+  if (!b || x < 0 || x >= b->w || y < 0 || y >= b->h) return -1;
+  if (level < 0 || level > 255) return -1;
+  b->pixels[(size_t)y * b->w + x] = encode_level((uint8_t)level);
+  return 0;
+}
+
+int golvis_get_level(Board* b, int x, int y) {
+  if (!b || x < 0 || x >= b->w || y < 0 || y >= b->h) return -1;
+  return (int)(b->pixels[(size_t)y * b->w + x] & 0xFFu);
+}
+
+// Two-state toggle on a gray board: nonzero -> dead, dead -> alive
+// (full level). The raw ARGB XOR of golvis_flip_mask would turn grays
+// into invalid encodings; this keeps every pixel a valid level.
+void golvis_toggle_mask(Board* b, const uint8_t* mask) {
+  if (!b || !mask) return;
+  const size_t total = (size_t)b->w * b->h;
+  for (size_t i = 0; i < total; ++i)
+    if (mask[i]) b->pixels[i] = b->pixels[i] ? 0u : encode_level(255);
+}
+
+// Count of cells at exactly this gray level (255 = the alive count the
+// protocol tests assert; dying levels give the per-level histogram).
+long golvis_count_level(Board* b, int level) {
+  if (!b || level < 0 || level > 255) return -1;
+  const uint32_t want = encode_level((uint8_t)level);
+  long n = 0;
+  const size_t total = (size_t)b->w * b->h;
+  for (size_t i = 0; i < total; ++i) n += b->pixels[i] == want;
+  return n;
+}
+
 // Present the framebuffer (ref: sdl/window.go:56-64). No-op headless.
 void golvis_render(Board* b) {
   if (!b || !b->tex) return;

@@ -67,6 +67,16 @@ def _load_native() -> ctypes.CDLL | None:
         ("golvis_clear", None, [ctypes.c_void_p]),
         ("golvis_load_mask", None, [ctypes.c_void_p, ctypes.c_char_p]),
         ("golvis_flip_mask", None, [ctypes.c_void_p, ctypes.c_char_p]),
+        # Gray-level mode (multi-state rules, r5).
+        ("golvis_load_levels", None, [ctypes.c_void_p, ctypes.c_char_p]),
+        ("golvis_update_levels", None,
+         [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]),
+        ("golvis_set_level", ctypes.c_int,
+         [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]),
+        ("golvis_get_level", ctypes.c_int,
+         [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]),
+        ("golvis_count_level", ctypes.c_long, [ctypes.c_void_p, ctypes.c_int]),
+        ("golvis_toggle_mask", None, [ctypes.c_void_p, ctypes.c_char_p]),
         ("golvis_render", None, [ctypes.c_void_p]),
         ("golvis_poll_key", ctypes.c_int, [ctypes.c_void_p]),
         ("golvis_destroy", None, [ctypes.c_void_p]),
@@ -227,13 +237,168 @@ class NumpyBoard:
         pass
 
 
-def make_board(width: int, height: int, want_window: bool = False):
+def _level_batch(cells, levels, width: int, height: int):
+    """(N, 2) x,y pairs + (N,) gray levels -> (mask, grid) full-board
+    byte arrays for the bulk native call, bounds-checked like
+    `_batch_mask`; (None, None) for an empty batch."""
+    cells = np.asarray(cells, dtype=np.int64).reshape(-1, 2)
+    levels = np.asarray(levels, dtype=np.uint8).reshape(-1)
+    if len(cells) != len(levels):
+        raise ValueError(f"{len(cells)} cells vs {len(levels)} levels")
+    if len(cells) == 0:
+        return None, None
+    xs, ys = cells[:, 0], cells[:, 1]
+    if (xs.min() < 0 or ys.min() < 0
+            or int(xs.max()) >= width or int(ys.max()) >= height):
+        raise IndexError("pixel out of range")
+    mask = np.zeros((height, width), np.uint8)
+    grid = np.zeros((height, width), np.uint8)
+    mask[ys, xs] = 1
+    grid[ys, xs] = levels
+    return mask, grid
+
+
+class NativeLevelBoard(NativeBoard):
+    """Gray-level mode over the same native core (multi-state rules):
+    levels SET cells, `count()` is the ALIVE (level 255) count, and
+    `count_level` gives the per-level histogram the protocol tests
+    assert on. Two-state events (flip/flip_batch) toggle dead<->alive
+    at the LEVEL semantics — never the raw ARGB XOR, which would turn
+    grays into invalid encodings — so both level-board variants agree
+    on mixed streams."""
+
+    def flip(self, x: int, y: int) -> None:
+        self.set_level(x, y, 0 if self.get_level(x, y) else 255)
+
+    def flip_mask(self, mask: np.ndarray) -> None:
+        self._lib.golvis_toggle_mask(self._h, self._as_bytes(mask))
+
+    def load_levels(self, grid: np.ndarray) -> None:
+        self._lib.golvis_load_levels(self._h, self._as_bytes(grid))
+
+    def update_levels(self, cells, levels) -> None:
+        mask, grid = _level_batch(cells, levels, self.width, self.height)
+        if mask is not None:
+            self._lib.golvis_update_levels(
+                self._h, mask.tobytes(), grid.tobytes()
+            )
+
+    def set_level(self, x: int, y: int, level: int) -> None:
+        self._check(self._lib.golvis_set_level(self._h, x, y, int(level)))
+
+    def get_level(self, x: int, y: int) -> int:
+        rc = self._lib.golvis_get_level(self._h, x, y)
+        self._check(rc)
+        return rc
+
+    def count(self) -> int:
+        return self.count_level(255)
+
+    def count_level(self, level: int) -> int:
+        n = self._lib.golvis_count_level(self._h, int(level))
+        if n < 0:
+            raise ValueError(f"bad level {level}")
+        return n
+
+
+class NumpyLevelBoard:
+    """Pure-python gray-level shadow board — the NumpyBoard analog for
+    multi-state rules. Storage is the uint8 level grid itself.
+    Two-state events toggle dead<->alive at level semantics, matching
+    NativeLevelBoard on mixed streams."""
+
+    has_window = False
+
+    def __init__(self, width: int, height: int, want_window: bool = False):
+        self.width, self.height = width, height
+        self._px = np.zeros((height, width), dtype=np.uint8)
+
+    def flip(self, x: int, y: int) -> None:
+        self.set_level(x, y, 0 if self.get_level(x, y) else 255)
+
+    def set(self, x: int, y: int, on: bool) -> None:
+        self.set_level(x, y, 255 if on else 0)
+
+    def get(self, x: int, y: int) -> bool:
+        return self.get_level(x, y) != 0
+
+    def _checked(self, grid) -> np.ndarray:
+        g = np.asarray(grid, np.uint8)
+        if g.shape != (self.height, self.width):
+            raise ValueError(
+                f"grid shape {g.shape} != {(self.height, self.width)}"
+            )
+        return g
+
+    def load_levels(self, grid) -> None:
+        self._px[:] = self._checked(grid)
+
+    def update_levels(self, cells, levels) -> None:
+        mask, grid = _level_batch(cells, levels, self.width, self.height)
+        if mask is not None:
+            self._px = np.where(mask != 0, grid, self._px)
+
+    def flip_batch(self, cells) -> None:
+        # Two-state batches still arrive (e.g. a Life peer's board-sync
+        # replay): toggle between dead and full-level alive.
+        mask = _batch_mask(cells, self.width, self.height)
+        if mask is not None:
+            self.flip_mask(mask)
+
+    def flip_mask(self, mask: np.ndarray) -> None:
+        m = np.asarray(mask)
+        if m.shape != (self.height, self.width):
+            raise ValueError(
+                f"mask shape {m.shape} != {(self.height, self.width)}"
+            )
+        self._px = np.where(
+            m != 0,
+            np.where(self._px != 0, 0, 255).astype(np.uint8),
+            self._px,
+        )
+
+    def set_level(self, x: int, y: int, level: int) -> None:
+        if not (0 <= x < self.width and 0 <= y < self.height):
+            raise IndexError("pixel out of range")
+        self._px[y, x] = level
+
+    def get_level(self, x: int, y: int) -> int:
+        if not (0 <= x < self.width and 0 <= y < self.height):
+            raise IndexError("pixel out of range")
+        return int(self._px[y, x])
+
+    def count(self) -> int:
+        return self.count_level(255)  # alive cells, not dying grays
+
+    def count_level(self, level: int) -> int:
+        return int((self._px == np.uint8(level)).sum())
+
+    def clear(self) -> None:
+        self._px[:] = 0
+
+    def render(self) -> None:
+        pass
+
+    def poll_key(self) -> "str | None":
+        return None
+
+    def destroy(self) -> None:
+        pass
+
+
+def make_board(width: int, height: int, want_window: bool = False,
+               levels: bool = False):
     """Best available board: native (windowed if SDL2 + display exist),
     NumPy shadow board otherwise. `GOL_TPU_NO_NATIVE=1` forces the
-    fallback (for tests)."""
+    fallback (for tests). `levels=True` builds the gray-level variant
+    (multi-state Generations rules, r5)."""
     if os.environ.get("GOL_TPU_NO_NATIVE") != "1":
         try:
+            if levels:
+                return NativeLevelBoard(width, height, want_window)
             return NativeBoard(width, height, want_window)
         except RuntimeError:
             pass
+    if levels:
+        return NumpyLevelBoard(width, height, want_window)
     return NumpyBoard(width, height, want_window)

@@ -41,6 +41,7 @@ def run_loop(
     want_window: bool = True,
     on_turn: Optional[Callable[[int, int], None]] = None,
     printer: Callable[[str], None] = print,
+    levels: bool = False,
 ):
     """Drive `board` from `events` until the run ends; returns the board
     (not yet destroyed when the caller supplied it, for assertions).
@@ -48,10 +49,14 @@ def run_loop(
     `on_turn(completed_turns, board_count)` fires after each rendered
     turn — the hook the protocol tests use to compare the shadow board
     against expected alive counts (ref: sdl_test.go:62-74,110-116).
-    """
+
+    `levels=True` builds a gray-level board (multi-state Generations
+    rules, r5): FlipBatch events carrying per-cell levels SET those
+    cells; the board's count() is the ALIVE (level-255) count."""
     own_board = board is None
     if own_board:
-        board = make_board(params.image_width, params.image_height, want_window)
+        board = make_board(params.image_width, params.image_height,
+                           want_window, levels=levels)
 
     try:
         while True:
@@ -78,9 +83,13 @@ def run_loop(
             if isinstance(ev, CellFlipped):
                 board.flip(ev.cell.x, ev.cell.y)
             elif isinstance(ev, FlipBatch):
-                # One vectorized XOR per turn (the opt-in batch form —
-                # semantically N CellFlipped events).
-                board.flip_batch(ev.cells)
+                if getattr(ev, "levels", None) is not None:
+                    # Multi-state batch: SET each cell's gray level.
+                    board.update_levels(ev.cells, ev.levels)
+                else:
+                    # One vectorized XOR per turn (the opt-in batch
+                    # form — semantically N CellFlipped events).
+                    board.flip_batch(ev.cells)
             elif isinstance(ev, TurnComplete):
                 board.render()
                 if on_turn is not None:

@@ -74,3 +74,38 @@ def test_resume_beyond_turns_errors(tmp_path):
     with pytest.raises(SystemExit, match="turn 300, beyond -turns 100"):
         main(["-w", "64", "-h", "64", "-turns", "100", "-noVis",
               "--out", str(tmp_path), "--resume", "latest"])
+
+
+def test_gens_visual_run_no_longer_forced_headless(golden_root, tmp_path,
+                                                   capsys, monkeypatch):
+    """A multi-state rule without -noVis runs the gray-level visualiser
+    (shadow board in CI) instead of being forced headless — the r5
+    close of the last family carve-out. The final PGM still matches the
+    oracle levels exactly."""
+    import numpy as np
+
+    from gol_tpu.io.pgm import read_pgm
+    from gol_tpu.models.rules import get_rule
+    from gol_tpu.ops import generations as gens
+
+    monkeypatch.setenv("GOL_TPU_NO_NATIVE", "1")
+    rc = main([
+        "-w", "16", "-h", "16", "-turns", "3", "-t", "1",
+        "--rule", "B2/S/C3",
+        "--images", str(golden_root / "images"), "--out", str(tmp_path),
+    ])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "two-state" not in captured.err  # the old forced-headless warn
+    assert "File 16x16x3 output complete" in captured.out
+
+    rule = get_rule("B2/S/C3")
+    states = gens.states_from_levels(
+        np.asarray(read_pgm(golden_root / "images" / "16x16.pgm")), rule
+    )
+    for _ in range(3):
+        states = np.asarray(gens.step_states(states, rule))
+    np.testing.assert_array_equal(
+        np.asarray(read_pgm(tmp_path / "16x16x3.pgm")),
+        gens.levels_from_states(states, rule),
+    )

@@ -594,3 +594,106 @@ def test_wire_control_only_receive_and_json_hardening():
     finally:
         a.close()
         b.close()
+
+
+def test_remote_gens_gray_level_stream(golden_root, tmp_path):
+    """The gray-level gens visual contract over the wire (r5): a
+    Brian's Brain engine server streams level batches (binary level
+    frames), the controller replays sync + flips onto a level-mode
+    shadow board, and the final grid equals the engine's own final
+    gray PGM byte-for-byte."""
+    from gol_tpu.io.pgm import write_pgm
+    from gol_tpu.models.rules import get_rule
+    from gol_tpu.ops import generations as gens
+    from gol_tpu.visual.board import NumpyLevelBoard
+
+    rule = get_rule("B2/S/C3")
+    server = make_server(golden_root, tmp_path, turns=40,
+                         rule="B2/S/C3").start()
+    ctl = Controller(*server.address, want_flips=True, batch=True,
+                     levels=True)
+    board = NumpyLevelBoard(64, 64)
+    final = None
+    from gol_tpu.events import FlipBatch
+
+    for ev in ctl.events:
+        if isinstance(ev, FlipBatch):
+            if ev.levels is not None:
+                board.update_levels(ev.cells, ev.levels)
+            else:
+                board.flip_batch(ev.cells)
+        elif isinstance(ev, FinalTurnComplete):
+            final = ev
+    assert server.wait(30)
+    ctl.close()
+    assert final is not None and final.completed_turns == 40
+
+    want = np.asarray(read_pgm(tmp_path / "out" / "64x64x40.pgm"))
+    np.testing.assert_array_equal(board._px, want)
+    # Alive payload counts only state-1 cells; dying grays excluded.
+    assert len(final.alive) == int((want == 255).sum())
+    assert board.count() == len(final.alive)
+
+
+def test_wire_level_flips_roundtrip_both_encodings():
+    """Level flips ride both the binary frame and the compact JSON
+    form; lengths must agree and mismatches are rejected."""
+    import socket
+
+    from gol_tpu.distributed import wire
+
+    rng = np.random.default_rng(3)
+    cells = rng.integers(0, 64, size=(500, 2)).astype(np.int32)
+    levels = rng.integers(0, 256, size=500).astype(np.uint8)
+
+    a, b = socket.socketpair()
+    try:
+        wire.send_frame(a, wire.level_flips_to_frame(12, cells, levels))
+        msg = wire.recv_msg(b)
+        turn, coords = wire.msg_flips_array(msg)
+        lv = wire.msg_flips_levels(msg)
+        assert turn == 12
+        np.testing.assert_array_equal(coords, cells)
+        np.testing.assert_array_equal(lv, levels)
+    finally:
+        a.close()
+        b.close()
+
+    msg = wire.flips_to_msg(12, cells, levels=levels)
+    import json
+
+    json.dumps(msg)  # pure-JSON encodable
+    _, coords = wire.msg_flips_array(msg)
+    np.testing.assert_array_equal(coords, cells)
+    np.testing.assert_array_equal(wire.msg_flips_levels(msg), levels)
+    assert wire.msg_flips_levels({"t": "flips", "turn": 1,
+                                  "cells": [[1, 2]]}) is None
+    with pytest.raises(ValueError):
+        wire.level_flips_to_frame(1, cells, levels[:-1])
+    bad = wire.level_flips_to_frame(1, cells[:3], levels[:3])
+    # Corrupt the coords-blob length to overrun the frame.
+    broken = wire._LFLIPS_HDR.pack(wire._TAG_LFLIPS, 1, 10**6) \
+        + bad[wire._LFLIPS_HDR.size:]
+    with pytest.raises(wire.WireError):
+        wire._parse_frame(broken)
+
+
+def test_gens_levels_downgrade_for_peers_without_capability(golden_root,
+                                                           tmp_path):
+    """A peer that did not advertise 'levels' in its hello must keep
+    receiving plain flips frames from a gens server (not ignorable
+    unknown tags that would freeze its display silently)."""
+    from gol_tpu.events import FlipBatch
+
+    server = make_server(golden_root, tmp_path, turns=30,
+                         rule="B2/S/C3").start()
+    ctl = Controller(*server.address, want_flips=True, batch=True,
+                     levels=False)  # pre-r5 peer shape
+    batches = 0
+    for ev in ctl.events:
+        if isinstance(ev, FlipBatch) and len(ev.cells):
+            assert ev.levels is None  # downgraded to plain flips
+            batches += 1
+    assert batches > 0
+    assert server.wait(30)
+    ctl.close()

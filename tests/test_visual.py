@@ -144,3 +144,103 @@ def test_shadow_board_tracks_engine(golden_root, tmp_path):
     assert len(counts) == 100
     assert board.count() == int(np.count_nonzero(golden))
     np.testing.assert_array_equal(board._px, golden != 0)
+
+
+# --- gray-level boards + loop (multi-state rules, r5) ---
+
+
+def _level_boards():
+    from gol_tpu.visual.board import NativeLevelBoard, NumpyLevelBoard
+
+    yield NumpyLevelBoard
+    if native_lib() is not None:
+        yield NativeLevelBoard
+
+
+@pytest.mark.parametrize("cls", _level_boards())
+def test_level_board_ops(cls):
+    b = cls(8, 4)
+    try:
+        grid = np.zeros((4, 8), np.uint8)
+        grid[1, 2], grid[3, 7] = 255, 170
+        b.load_levels(grid)
+        assert b.count() == 1           # alive = level 255 only
+        assert b.count_level(170) == 1
+        assert b.count_level(0) == 30
+        assert b.get_level(2, 1) == 255 and b.get_level(7, 3) == 170
+        b.update_levels(np.array([[2, 1], [0, 0]]), np.array([85, 255]))
+        assert b.get_level(2, 1) == 85 and b.get_level(0, 0) == 255
+        assert b.count() == 1 and b.count_level(85) == 1
+        b.set_level(0, 0, 0)
+        assert b.count() == 0
+        # Two-state events on a level board: dead<->alive toggles at
+        # level semantics (a gray flips to dead, never to a raw-XOR
+        # junk encoding) — identical across both variants.
+        b.set_level(3, 2, 170)
+        b.flip(3, 2)
+        assert b.get_level(3, 2) == 0
+        b.flip(3, 2)
+        assert b.get_level(3, 2) == 255
+        b.flip_batch(np.array([[3, 2], [4, 2]]))
+        assert b.get_level(3, 2) == 0 and b.get_level(4, 2) == 255
+        assert b.count() == 1
+        with pytest.raises(IndexError):
+            b.update_levels(np.array([[8, 0]]), np.array([1]))
+        with pytest.raises((IndexError, ValueError)):
+            b.get_level(9, 9)
+        b.render()
+    finally:
+        b.destroy()
+
+
+def test_gens_gray_level_loop(golden_root):
+    """The r5 gray-level visual contract (the VERDICT r4 Missing #3
+    carve-out, closed): a Brian's Brain engine run drives a level-mode
+    shadow board through the standard loop, and after EVERY turn the
+    board's full gray grid equals the oracle's levels — dying cells at
+    their injective grays, alive at 255 — with per-level counts
+    matching (the multi-state analog of ref: sdl_test.go:62-74)."""
+    from gol_tpu.models.rules import get_rule
+    from gol_tpu.ops import generations as gens
+    from gol_tpu.visual.board import NumpyLevelBoard
+
+    rule = get_rule("B2/S/C3")
+    world0 = np.asarray(read_pgm(golden_root / "images" / "64x64.pgm"))
+    turns = 8
+    # Oracle level grids for turns 1..8.
+    states = gens.states_from_levels(world0, rule)
+    grids = {}
+    for t in range(1, turns + 1):
+        states = np.asarray(gens.step_states(states, rule))
+        grids[t] = gens.levels_from_states(states, rule)
+
+    p = Params(turns=turns, threads=1, image_width=64, image_height=64,
+               rule="B2/S/C3", chunk=1, tick_seconds=60.0,
+               image_dir=str(golden_root / "images"), out_dir="/tmp/unused")
+    engine = Engine(p, events=EventQueue(), emit_flips=True,
+                    emit_flip_batches=True)
+    board = NumpyLevelBoard(64, 64)
+    checked = []
+
+    def on_turn(turn, count):
+        if turn == 0:
+            return  # the initial burst's render tick, pre-oracle
+        np.testing.assert_array_equal(
+            board._px, grids[turn], err_msg=f"turn {turn}"
+        )
+        assert count == int((grids[turn] == 255).sum())
+        for s in range(1, rule.states):
+            lv = gens.levels(rule)[s]
+            assert board.count_level(int(lv)) == int(
+                (gens.states_from_levels(grids[turn], rule) == s).sum()
+            )
+        checked.append(turn)
+
+    engine.start()
+    try:
+        run_loop(p, engine.events, board=board, on_turn=on_turn)
+    finally:
+        engine.join(timeout=120)
+    if engine.error is not None:
+        raise engine.error
+    assert checked == list(range(1, turns + 1))
