@@ -94,6 +94,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run as a headless engine server on this address")
     ap.add_argument("--connect", default=None, metavar="HOST:PORT",
                     help="run as a controller attached to a remote engine")
+    ap.add_argument("--observe", action="store_true",
+                    help="with --connect: attach read-only (board sync "
+                         "+ events; steering verbs rejected) — any "
+                         "number of observers may watch alongside the "
+                         "one driving controller")
     ap.add_argument("--secret", default=os.environ.get("GOL_SECRET"),
                     metavar="TOKEN",
                     help="shared secret for --serve/--connect: a serving "
@@ -369,7 +374,8 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
     # levels follows the rule family (gray-level gens batches, r5).
     ctl = Controller(host, port, want_flips=not args.novis,
                      secret=args.secret, batch=not args.novis,
-                     levels=vis_levels and not args.novis)
+                     levels=vis_levels and not args.novis,
+                     observe=args.observe)
 
     class _WireKeys:
         """queue.Queue-shaped sink that forwards verbs over the wire —

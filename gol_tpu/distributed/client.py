@@ -48,6 +48,7 @@ class Controller:
         batch: bool = False,
         binary: bool = True,
         levels: bool = False,
+        observe: bool = False,
     ):
         #: batch=True delivers each turn's flips as ONE events.FlipBatch
         #: ndarray instead of per-cell CellFlipped objects — the form
@@ -84,6 +85,11 @@ class Controller:
             hello = {"t": "hello", "want_flips": want_flips,
                      "compact": True, "binary": bool(binary),
                      "levels": bool(levels)}
+            if observe:
+                # Read-only attach (r5 multi-observer serving): the
+                # driver slot stays free, steering verbs are rejected
+                # by the server; 'q' still detaches this observer.
+                hello["role"] = "observe"
             if secret is not None:
                 hello["secret"] = secret
             wire.send_msg(self._sock, hello)

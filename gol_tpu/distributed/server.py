@@ -9,9 +9,12 @@ capability, working:
 - owns the Engine (device turn loop) and keeps it evolving whether or
   not a controller is attached — the fault story's first half
   (SURVEY.md §5: "engine keeps evolving without a controller");
-- accepts ONE controller at a time over TCP; on attach it syncs the
-  full board (the role of the commented GetCurrentBoard RPC,
-  ref: gol/distributor.go:489-498) and then streams events;
+- accepts ONE DRIVING controller at a time over TCP, plus any number
+  of read-only OBSERVERS (hello role:"observe" — r5 multi-observer
+  serving: the broadcaster already fans out one event stream, and only
+  steering verbs need arbitration); on attach each peer gets a full
+  board sync (the role of the commented GetCurrentBoard RPC,
+  ref: gol/distributor.go:489-498) and then the event stream;
 - per-turn CellFlipped diffs are streamed only while a controller that
   asked for them is attached (`hello.want_flips`) — flips-off engines
   run the chunked fast path, so a detached engine pays zero event tax;
@@ -28,6 +31,7 @@ from __future__ import annotations
 import contextlib
 import hmac
 import itertools
+import json
 import logging
 import queue
 import socket
@@ -62,7 +66,11 @@ class _Conn:
 
     def __init__(self, sock: socket.socket, want_flips: bool,
                  compact: bool = False, binary: bool = False,
-                 levels: bool = False):
+                 levels: bool = False, role: str = "drive"):
+        #: "drive" (exclusive slot, verbs accepted) or "observe"
+        #: (read-only: BoardSync + events, verbs rejected) — r5
+        #: multi-observer serving (VERDICT r4 next #7).
+        self.role = role
         self.sock = sock
         # Send-side timeout only (SO_SNDTIMEO, not settimeout: the read
         # side must keep blocking forever — controllers send verbs
@@ -98,16 +106,79 @@ class _Conn:
         # TurnComplete it has no context for.
         self.synced = False
         self._lock = threading.Lock()
+        # Outbound frames ride a bounded per-connection queue drained
+        # by this connection's OWN writer thread (started at attach):
+        # the broadcaster fans out to driver + observers sequentially,
+        # and a single wedged peer (SIGSTOP, blackholed path) blocking
+        # a direct sendall would stall every OTHER peer's stream for
+        # up to the 30s send timeout per frame. With the queue, a peer
+        # more than QUEUE_DEPTH frames behind is declared dead
+        # wait-free and detached by its own writer.
+        QUEUE_DEPTH = 1024
+        self._out: "queue.Queue[bytes | None]" = queue.Queue(QUEUE_DEPTH)
+        self._dead = threading.Event()
+        self._writer: Optional[threading.Thread] = None
+
+    def start_writer(self, on_error) -> None:
+        """Begin queue-drained sending; `on_error(conn)` fires (from
+        the writer thread) when the peer's socket fails."""
+        self._writer = threading.Thread(
+            target=self._write_loop, args=(on_error,),
+            name="gol-conn-writer", daemon=True,
+        )
+        self._writer.start()
+
+    def _write_loop(self, on_error) -> None:
+        while True:
+            payload = self._out.get()
+            if payload is None:
+                return
+            try:
+                with self._lock:
+                    wire.send_frame(self.sock, payload)
+            except (wire.WireError, OSError):
+                self._dead.set()
+                on_error(self)
+                return
+
+    def _enqueue(self, payload: bytes) -> None:
+        if self._dead.is_set():
+            raise wire.WireError("peer is gone")
+        if self._writer is None:
+            # Pre-attach (handshake replies): direct, no queue yet.
+            with self._lock:
+                wire.send_frame(self.sock, payload)
+            return
+        try:
+            self._out.put_nowait(payload)
+        except queue.Full:
+            # The peer is QUEUE_DEPTH frames behind: declare it dead
+            # without ever blocking the broadcaster.
+            self._dead.set()
+            raise wire.WireError("peer send queue overflow") from None
 
     def send(self, msg: dict) -> None:
-        with self._lock:
-            wire.send_msg(self.sock, msg)
+        self._enqueue(json.dumps(msg, separators=(",", ":")).encode())
 
     def send_raw(self, payload: bytes) -> None:
-        with self._lock:
-            wire.send_frame(self.sock, payload)
+        self._enqueue(payload)
+
+    def finish(self, timeout: float = 30.0) -> None:
+        """Flush the outbound queue (writer drains everything already
+        enqueued — including a farewell — then exits on the sentinel)
+        before the caller closes the socket. A direct farewell would
+        OVERTAKE queued stream events (the client stops at bye/detached,
+        losing its FinalTurnComplete)."""
+        if self._writer is None:
+            return
+        with contextlib.suppress(queue.Full):
+            self._out.put_nowait(None)
+        self._writer.join(timeout)
 
     def close(self) -> None:
+        self._dead.set()
+        with contextlib.suppress(queue.Full):
+            self._out.put_nowait(None)  # release the writer
         with contextlib.suppress(OSError):
             self.sock.shutdown(socket.SHUT_RDWR)
         with contextlib.suppress(OSError):
@@ -148,6 +219,11 @@ class EngineServer:
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
         self._conn: Optional[_Conn] = None
+        #: Read-only observers fanned out from the same event stream —
+        #: the controller ⇄ broker ⇄ workers topology's natural "one
+        #: driver plus N watchers" shape (ref: README.md:201-207 keeps
+        #: the DRIVER singular; nothing about watching is exclusive).
+        self._observers: "list[_Conn]" = []
         self._conn_lock = threading.Lock()
         self._shutdown = threading.Event()
         self.done = threading.Event()
@@ -172,14 +248,25 @@ class EngineServer:
             self.engine.stop()
         with contextlib.suppress(OSError):
             self._listener.close()
-        with self._conn_lock:
-            conn, self._conn = self._conn, None
-        if conn is not None:
-            with contextlib.suppress(Exception):
-                conn.send({"t": "bye"})
-            conn.close()
+        self._drain_conns()
         self.engine.join(timeout=60)
         self.done.set()
+
+    def _drain_conns(self) -> None:
+        """Collect-and-clear every attached connection under the lock,
+        then farewell + close each — the one teardown used by
+        shutdown() and the broadcast epilogue."""
+        with self._conn_lock:
+            conns = list(self._observers)
+            if self._conn is not None:
+                conns.append(self._conn)
+            self._conn = None
+            self._observers = []
+        for conn in conns:
+            with contextlib.suppress(Exception):
+                conn.send({"t": "bye"})
+            conn.finish()
+            conn.close()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done.wait(timeout)
@@ -221,17 +308,27 @@ class EngineServer:
                 sock.close()
                 continue
 
+            role = ("observe" if hello.get("role") == "observe"
+                    else "drive")
             conn = _Conn(sock, bool(hello.get("want_flips", False)),
                          compact=bool(hello.get("compact", False)),
                          binary=bool(hello.get("binary", False)),
-                         levels=bool(hello.get("levels", False)))
-            with self._conn_lock:
-                if self._conn is not None:
-                    busy = True
-                else:
-                    self._conn, busy = conn, False
+                         levels=bool(hello.get("levels", False)),
+                         role=role)
+            if role == "observe":
+                # Observers fan out freely — only the DRIVER slot is
+                # exclusive (its verbs steer the run).
+                with self._conn_lock:
+                    self._observers.append(conn)
+                busy = False
+            else:
+                with self._conn_lock:
+                    if self._conn is not None:
+                        busy = True
+                    else:
+                        self._conn, busy = conn, False
             if busy:
-                # One controller at a time (the reference's controller is
+                # One DRIVER at a time (the reference's controller is
                 # singular too, ref: README.md:201-207).
                 with contextlib.suppress(Exception):
                     wire.send_msg(sock, {"t": "error", "reason": "busy"})
@@ -249,6 +346,7 @@ class EngineServer:
             except (wire.WireError, OSError):
                 self._detach(conn)
                 continue
+            conn.start_writer(self._detach)
             self._attach(conn)
             threading.Thread(
                 target=self._reader_loop, args=(conn,),
@@ -271,26 +369,44 @@ class EngineServer:
         )
 
     def _release(self, conn: _Conn) -> None:
-        """Free the controller slot (without closing the socket)."""
+        """Free the connection's slot (driver or observer) without
+        closing the socket, re-deriving the engine flags from whoever
+        remains attached."""
         with self._conn_lock:
             if self._conn is conn:
                 self._conn = None
-                self.engine.emit_flips = False
-                self.engine.emit_turns = False
+            elif conn in self._observers:
+                self._observers.remove(conn)
+            self._set_flags_locked()
 
     def _detach(self, conn: _Conn) -> None:
         self._release(conn)
         conn.close()
 
+    def _set_flags_locked(self) -> None:
+        """Engine flag refresh — call with _conn_lock held: per-turn
+        events flow while ANY connection is attached, flips while any
+        attached connection wants them."""
+        conns = list(self._observers)
+        if self._conn is not None:
+            conns.append(self._conn)
+        self.engine.emit_flips = any(c.want_flips for c in conns)
+        self.engine.emit_turns = bool(conns)
+
+    def _all_conns(self) -> "list[_Conn]":
+        with self._conn_lock:
+            conns = list(self._observers)
+            if self._conn is not None:
+                conns.append(self._conn)
+        return conns
+
     def _refresh_flips(self) -> None:
         """Re-derive engine.emit_flips/emit_turns from the currently
-        attached connection, atomically against attach/detach — the
+        attached connections, atomically against attach/detach — the
         single writer discipline that keeps broadcaster-side corrections
         from racing a concurrent _detach or a fresh attach."""
         with self._conn_lock:
-            cur = self._conn
-            self.engine.emit_flips = cur is not None and cur.want_flips
-            self.engine.emit_turns = cur is not None
+            self._set_flags_locked()
 
     # --- controller → engine ---
 
@@ -307,6 +423,13 @@ class EngineServer:
             if msg.get("t") != "key":
                 continue
             key = msg.get("key")
+            if conn.role == "observe" and key != "q":
+                # Observers are read-only: steering verbs are rejected
+                # (the driver slot exists precisely to arbitrate them);
+                # 'q' below only detaches the observer itself.
+                with contextlib.suppress(Exception):
+                    conn.send({"t": "error", "reason": "observer"})
+                continue
             if key in ("p", "s"):
                 self._keys.put(key)
             elif key == "q":
@@ -318,6 +441,7 @@ class EngineServer:
                 self._release(conn)
                 with contextlib.suppress(Exception):
                     conn.send({"t": "detached"})
+                conn.finish()
                 conn.close()
                 return
             elif key == "k":
@@ -327,99 +451,110 @@ class EngineServer:
 
     # --- engine → controller ---
 
+    def _send_flips(self, conn: _Conn, turn: int, flips,
+                    flips_levels) -> None:
+        """One turn's batched flips in this connection's negotiated
+        encoding (binary frame / compact JSON / legacy pairs; levels
+        ride only to peers that advertised the capability)."""
+        lv = flips_levels if conn.levels else None
+        if conn.binary:
+            conn.send_raw(
+                wire.level_flips_to_frame(turn, flips, lv)
+                if lv is not None
+                else wire.flips_to_frame(turn, flips)
+            )
+        elif conn.compact:
+            conn.send(wire.flips_to_msg(turn, flips, levels=lv))
+        else:
+            # Legacy JSON peers are two-state; levels are dropped
+            # (they could not apply them anyway).
+            conn.send({"t": "flips", "turn": turn,
+                       "cells": np.asarray(flips).tolist()})
+
+    def _send_stream_event(self, conn: _Conn, ev) -> None:
+        """One post-sync event in this connection's encoding."""
+        if conn.binary and isinstance(ev, FinalTurnComplete):
+            conn.send_raw(wire.final_to_frame(ev.completed_turns, ev.alive))
+        else:
+            conn.send(wire.event_to_msg(ev))
+
     def _broadcast_loop(self) -> None:
-        """Single consumer of the engine's event stream; each turn's
-        flips become one wire message — from a FlipBatch array directly
-        (the engine's vectorized form) or by batching a CellFlipped
-        burst (engines injected with the per-cell contract)."""
+        """Single consumer of the engine's event stream, fanning out to
+        the driver and every observer (r5 multi-observer serving); each
+        turn's flips become one wire message per interested connection
+        — from a FlipBatch array directly (the engine's vectorized
+        form) or by batching a CellFlipped burst (engines injected with
+        the per-cell contract)."""
         flips: "list | object" = []
         flips_levels = None  # (N,) gray levels of a multi-state batch
         flips_turn = 0
         for ev in self.engine.events:
-            conn = self._conn
+            conns = self._all_conns()
             if isinstance(ev, FlipBatch):
-                if conn is not None and conn.want_flips and len(ev.cells):
+                if len(ev.cells) and any(c.want_flips for c in conns):
                     flips_turn = ev.completed_turns
                     flips = ev.cells
                     flips_levels = getattr(ev, "levels", None)
                 continue
             if isinstance(ev, CellFlipped):
-                if conn is not None and conn.want_flips:
+                if any(c.want_flips for c in conns):
                     flips_turn = ev.completed_turns
                     if not isinstance(flips, list):
+                        # Mixed batch/per-cell stream: the stale batch
+                        # AND its levels both reset (a leftover levels
+                        # array would fail the flush's length check).
                         flips = []
+                        flips_levels = None
                     flips.append([ev.cell.x, ev.cell.y])
                 continue
-            if conn is None:
+            if not conns:
                 flips = []
                 flips_levels = None
                 if isinstance(ev, BoardSync):
-                    # Sync requested by a controller that vanished: drop
-                    # the stale enable_flips so a detached engine pays
-                    # zero diff tax (re-derived under the lock — a new
-                    # controller may have just attached).
+                    # Sync requested by a connection that vanished: drop
+                    # the stale enable_flips so a watcher-less engine
+                    # pays zero diff tax (re-derived under the lock — a
+                    # new connection may have just attached).
                     self._refresh_flips()
                 continue
-            try:
-                if isinstance(ev, BoardSync):
-                    if ev.token != conn.token:
-                        # Sync for a controller that vanished before it
-                        # was serviced; re-derive the subscription from
-                        # the *current* connection (by want_flips alone —
-                        # its own sync may still be queued behind this
-                        # one, so keying off synced would freeze it).
-                        self._refresh_flips()
-                        continue
-                    flips = []  # the sync supersedes any batched diff
-                    flips_levels = None
-                    if conn.binary:
-                        conn.send_raw(wire.board_to_frame(
-                            ev.completed_turns, ev.world, ev.token
-                        ))
-                    else:
-                        conn.send(wire.board_to_msg(
-                            ev.completed_turns, ev.world, ev.token
-                        ))
-                    conn.synced = True
+            if isinstance(ev, BoardSync):
+                target = next(
+                    (c for c in conns if c.token == ev.token), None
+                )
+                if target is None:
+                    # Sync for a connection that vanished before it was
+                    # serviced; re-derive the subscription from the
+                    # CURRENT connections (by want_flips alone — their
+                    # own syncs may still be queued behind this one).
+                    self._refresh_flips()
                     continue
-                if not conn.synced:
-                    continue  # pre-sync events are not this controller's
-                if len(flips) and isinstance(ev, TurnComplete):
-                    # Levels ride only to peers that advertised them.
-                    lv = flips_levels if conn.levels else None
-                    if conn.binary:
-                        conn.send_raw(
-                            wire.level_flips_to_frame(flips_turn, flips, lv)
-                            if lv is not None
-                            else wire.flips_to_frame(flips_turn, flips)
-                        )
-                    elif conn.compact:
-                        conn.send(wire.flips_to_msg(
-                            flips_turn, flips, levels=lv
+                try:
+                    if target.binary:
+                        target.send_raw(wire.board_to_frame(
+                            ev.completed_turns, ev.world, ev.token
                         ))
                     else:
-                        # Legacy JSON peers are two-state; levels are
-                        # dropped (they could not apply them anyway).
-                        conn.send({"t": "flips", "turn": flips_turn,
-                                   "cells": np.asarray(flips).tolist()})
-                    flips = []
-                    flips_levels = None
-                if conn.binary and isinstance(ev, FinalTurnComplete):
-                    conn.send_raw(wire.final_to_frame(
-                        ev.completed_turns, ev.alive
-                    ))
-                else:
-                    conn.send(wire.event_to_msg(ev))
-            except (wire.WireError, OSError):
-                self._detach(conn)
+                        target.send(wire.board_to_msg(
+                            ev.completed_turns, ev.world, ev.token
+                        ))
+                    target.synced = True
+                except (wire.WireError, OSError):
+                    self._detach(target)
+                continue
+            flush = len(flips) and isinstance(ev, TurnComplete)
+            for conn in conns:
+                if not conn.synced:
+                    continue  # pre-sync events are not this peer's
+                try:
+                    if flush and conn.want_flips:
+                        self._send_flips(conn, flips_turn, flips,
+                                         flips_levels)
+                    self._send_stream_event(conn, ev)
+                except (wire.WireError, OSError):
+                    self._detach(conn)
+            if flush:
                 flips = []
                 flips_levels = None
-                continue
         # Engine stream closed: the run is over (final turn, 'k', or stop).
-        with self._conn_lock:
-            conn, self._conn = self._conn, None
-        if conn is not None:
-            with contextlib.suppress(Exception):
-                conn.send({"t": "bye"})
-            conn.close()
+        self._drain_conns()
         self.shutdown(stop_engine=False)

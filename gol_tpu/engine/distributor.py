@@ -969,10 +969,15 @@ class Engine:
             except queue.Empty:
                 return
             self._handle_key(key, turn)
-            if self._paused:
+            if self._paused and not self._emitting:
                 # Block on further keys while paused (ref: gol/distributor.go:264-277),
                 # but keep servicing count requests so alive_count_now
-                # callers aren't stalled for their whole timeout.
+                # callers aren't stalled for their whole timeout. A
+                # pause entered MID-CHUNK-EMISSION must not block here:
+                # sync servicing is deferred while _emitting (stream
+                # ordering), so waiting would starve attaches for the
+                # whole pause — finish the chunk's rows first, then the
+                # run loop's boundary poll blocks with syncs live.
                 while self._paused and self._stop_reason is None:
                     self._service_requests()
                     try:
@@ -990,6 +995,11 @@ class Engine:
             self._paused = False
         elif key == "p":
             self._paused = not self._paused
+            # Byte-for-byte the reference's pause prints: the current
+            # turn on pause, "Continuing" on resume, from the engine
+            # itself (ref: gol/distributor.go:264-277 — fmt.Println of
+            # *turn, then of the literal).
+            print(turn if self._paused else "Continuing")
             self.events.put(
                 StateChange(turn, State.PAUSED if self._paused else State.EXECUTING)
             )

@@ -109,3 +109,39 @@ def test_gens_visual_run_no_longer_forced_headless(golden_root, tmp_path,
         np.asarray(read_pgm(tmp_path / "16x16x3.pgm")),
         gens.levels_from_states(states, rule),
     )
+
+
+def test_pause_resume_prints_reference_lines(golden_root, tmp_path, capsys):
+    """'p' parity, byte-for-byte (ref: gol/distributor.go:264-277): the
+    engine prints the current turn on pause and "Continuing" on resume
+    — exactly one line each, nothing else."""
+    import queue
+    import time
+
+    from gol_tpu.engine.distributor import Engine, EventQueue
+    from gol_tpu.events import State, StateChange
+    from gol_tpu.params import Params
+
+    keys: queue.Queue = queue.Queue()
+    p = Params(turns=10**9, threads=1, image_width=16, image_height=16,
+               chunk=1, tick_seconds=60.0,
+               image_dir=str(golden_root / "images"), out_dir=str(tmp_path))
+    engine = Engine(p, events=EventQueue(), keypresses=keys,
+                    emit_flips=False, emit_turns=True)
+    engine.start()
+    changes = []
+    try:
+        deadline = time.monotonic() + 60
+        while engine.completed_turns < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        keys.put("p")
+        keys.put("p")
+        keys.put("q")
+        engine.join(timeout=120)
+        assert engine.error is None
+        changes = [e for e in engine.events if isinstance(e, StateChange)]
+    finally:
+        engine.join(timeout=10)
+    paused = next(e for e in changes if e.new_state is State.PAUSED)
+    out = capsys.readouterr().out
+    assert out == f"{paused.completed_turns}\nContinuing\n"

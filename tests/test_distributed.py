@@ -697,3 +697,75 @@ def test_gens_levels_downgrade_for_peers_without_capability(golden_root,
     assert batches > 0
     assert server.wait(30)
     ctl.close()
+
+
+def test_one_driver_two_observers(golden_root, tmp_path):
+    """r5 multi-observer serving: one driving controller plus two
+    read-only observers follow the same watched run — every peer
+    reconstructs the identical final board; a second DRIVER still
+    bounces off 'busy'; observer steering verbs are rejected without
+    touching the run."""
+    server = make_server(golden_root, tmp_path, turns=120, chunk=2).start()
+    driver = Controller(*server.address, want_flips=True)
+    obs = [Controller(*server.address, want_flips=True, observe=True)
+           for _ in range(2)]
+    # The driver slot stays exclusive while observers are attached.
+    with pytest.raises(ServerBusyError):
+        Controller(*server.address, want_flips=False)
+    # An observer's steering verb must not pause/stop the run (the
+    # server replies with an error the client ignores).
+    obs[0].send_key("p")
+    obs[0].send_key("k")
+
+    def follow(ctl):
+        board = NumpyBoard(64, 64)
+        final = None
+        for ev in ctl.events:
+            if isinstance(ev, CellFlipped):
+                board.flip(ev.cell.x, ev.cell.y)
+            elif isinstance(ev, FinalTurnComplete):
+                final = ev
+        return board, final
+
+    boards = []
+    threads = []
+    results = [None] * 3
+    for i, c in enumerate([driver] + obs):
+        t = threading.Thread(target=lambda i=i, c=c: results.__setitem__(
+            i, follow(c)), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    assert server.wait(30)
+    golden = read_pgm(golden_root / "check" / "images" / "64x64x100.pgm")
+    import gol_tpu.ops.life as life
+
+    want = np.asarray(life.step_n(np.asarray(golden), 20)) != 0
+    for i, (board, final) in enumerate(results):
+        assert final is not None and final.completed_turns == 120, i
+        np.testing.assert_array_equal(board._px, want, err_msg=f"peer {i}")
+    for c in [driver] + obs:
+        c.close()
+
+
+def test_observer_detach_leaves_run_untouched(golden_root, tmp_path):
+    """An observer's 'q' detaches only itself: the driver keeps
+    streaming and the engine keeps evolving."""
+    server = make_server(golden_root, tmp_path, turns=400, chunk=1).start()
+    driver = Controller(*server.address, want_flips=False)
+    ob = Controller(*server.address, want_flips=False, observe=True)
+    for ev in ob.events:
+        if isinstance(ev, TurnComplete) and ev.completed_turns >= 3:
+            break
+    assert ob.detach(30)
+    assert not server.done.is_set()
+    final = None
+    for ev in driver.events:
+        if isinstance(ev, FinalTurnComplete):
+            final = ev
+    assert final is not None and final.completed_turns == 400
+    assert server.wait(30)
+    driver.close()
+    ob.close()
