@@ -381,14 +381,111 @@ def measure_diff_rate(latency: float) -> dict:
             "turns_per_sec": kernel["turns_per_sec"]}
 
 
-def measure_wire_watched() -> dict:
+def _delivered_sparse(stepper, settle_turns: int = 10_000) -> dict:
+    """Delivered turns/s of the SPARSE diff rows on a settled board —
+    the engine's steady-state watched dispatch for any packed stepper
+    (single-device or ring): settle, observe one dense chunk to size
+    the cap, then time sparse chunks fetched + expanded to flip cells
+    exactly as the engine consumes them."""
+    import numpy as np
+
+    from gol_tpu.engine.distributor import DIFF_CHUNK
+    from gol_tpu.ops.bitlife import unpack_np
+    from gol_tpu.parallel.stepper import (
+        sparse_bitmap_words,
+        sparse_decode_rows,
+    )
+    from gol_tpu.utils.cell import cells_from_mask
+
+    kd, chunks = DIFF_CHUNK, 4
+    p = stepper.put(_world(W))
+    q, _ = stepper.step_n(p, settle_turns)
+    q, diffs, count = stepper.step_n_with_diffs(q, kd)
+    int(count)
+    host = (stepper.fetch_diffs or np.asarray)(diffs)
+    host = np.asarray(host).copy()
+    max_words = max(int(np.count_nonzero(host[i])) for i in range(kd))
+    hw = H // 32
+    nb = sparse_bitmap_words(hw * W)
+    capd = min(max(64, 1 << (2 * max_words - 1).bit_length()), hw * W // 2)
+    q2, buf, count = stepper.step_n_with_diffs_sparse(q, kd, capd)  # warm
+    int(count)
+    q2, total_flips = q, 0
+    t0 = time.perf_counter()
+    for _ in range(chunks):
+        q2, buf, count = stepper.step_n_with_diffs_sparse(q2, kd, capd)
+        rows = np.ascontiguousarray(np.asarray(buf)).view(np.uint32)
+        rows = rows.copy()  # force materialization (lazy on axon)
+        for words in sparse_decode_rows(rows, hw * W):
+            total_flips += len(
+                cells_from_mask(unpack_np(words.reshape(hw, W), H))
+            )
+    dt = time.perf_counter() - t0
+    return {
+        "backend": stepper.name,
+        "turns_per_sec": round(chunks * kd / dt, 1),
+        "chunk": kd,
+        "cap_words": capd,
+        "link_bytes_per_turn": (1 + nb + capd) * 4,
+        "flips_per_turn": round(total_flips / (chunks * kd), 1),
+        "board": f"settled (turn {settle_turns}+)",
+    }
+
+
+def _counting_proxy(target) -> tuple:
+    """Loopback TCP forwarder that counts engine->controller bytes —
+    the true link cost of the watched wire, measured outside both
+    endpoints. Returns ((host, port), stats_dict)."""
+    import socket
+    import threading
+
+    lsock = socket.create_server(("127.0.0.1", 0))
+    stats = {"down": 0}
+
+    def pump(src, dst, key=None):
+        while True:
+            try:
+                data = src.recv(1 << 16)
+            except OSError:
+                break
+            if not data:
+                break
+            if key is not None:
+                stats[key] += len(data)
+            try:
+                dst.sendall(data)
+            except OSError:
+                break
+        for s in (src, dst):
+            with contextlib.suppress(OSError):
+                s.close()
+
+    def serve():
+        with contextlib.suppress(OSError):
+            c, _ = lsock.accept()
+            u = socket.create_connection(target)
+            threading.Thread(target=pump, args=(c, u), daemon=True).start()
+            threading.Thread(target=pump, args=(u, c, "down"),
+                             daemon=True).start()
+
+    threading.Thread(target=serve, daemon=True).start()
+    return lsock.getsockname(), stats
+
+
+def measure_wire_watched(binary: bool = True) -> dict:
     """The fully assembled watched product path: a real EngineServer on
     this TPU, a controller attached over loopback TCP with
     want_flips=True, delivered TurnComplete rate at the controller —
     device diff stacks (sparse when the board settles) + wire flip
     frames end to end. On a tunnel-attached chip this sits at the
     device-link bound (see diff_kernel_512x512.delivered); on local
-    hardware the wire becomes the ceiling."""
+    hardware the wire becomes the ceiling.
+
+    The controller attaches THROUGH a byte-counting loopback proxy, so
+    `link_bytes_per_turn` is the true engine->controller wire cost of
+    the measured window. `binary=False` pins the legacy compact
+    (base64-inside-JSON) encodings — the A/B behind the r5 binary
+    frames (VERDICT r4 Weak #4)."""
     import queue as _q
     import threading
 
@@ -401,21 +498,26 @@ def measure_wire_watched() -> dict:
                chunk=0, tick_seconds=60.0,
                image_dir=str(img_dir), out_dir="out")
     server = EngineServer(p, port=0).start()
+    proxy_addr, stats = _counting_proxy(server.address)
     # batch=True is the product visualiser configuration (per-turn
     # FlipBatch arrays end to end — see events.FlipBatch).
-    ctl = Controller(*server.address, want_flips=True, batch=True)
+    ctl = Controller(*proxy_addr, want_flips=True, batch=True,
+                     binary=binary)
     counts: _q.Queue = _q.Queue()
 
     def drain():
         seen = 0
         t0 = None
+        b0 = 0
         for ev in ctl.events:
             if isinstance(ev, TurnComplete):
                 if t0 is None:
                     t0 = time.perf_counter()  # start after the sync
+                    b0 = stats["down"]
                 seen += 1
                 if seen >= 2_000:
-                    counts.put((seen - 1, time.perf_counter() - t0))
+                    counts.put((seen - 1, time.perf_counter() - t0,
+                                stats["down"] - b0))
                     return
 
     t = threading.Thread(target=drain, daemon=True)
@@ -430,8 +532,10 @@ def measure_wire_watched() -> dict:
     ctl.close()
     if got is None:
         return {"error": "no turns delivered within 300s"}
-    turns, secs = got
-    return {"turns_per_sec": round(turns / secs, 1), "turns": turns}
+    turns, secs, nbytes = got
+    return {"turns_per_sec": round(turns / secs, 1), "turns": turns,
+            "encoding": "binary-frames" if binary else "compact-json",
+            "link_bytes_per_turn": round(nbytes / turns, 1)}
 
 
 def expected_alive() -> int | None:
@@ -542,6 +646,47 @@ def main() -> None:
         detail["wire_watched_512x512"] = measure_wire_watched()
     except Exception as e:
         detail["wire_watched_512x512"] = {"error": repr(e)}
+    # The binary-frame A/B: the same watched path forced onto the
+    # legacy compact (base64-inside-JSON) encodings (r5 wire change).
+    try:
+        detail["wire_watched_512x512_json"] = measure_wire_watched(
+            binary=False
+        )
+    except Exception as e:
+        detail["wire_watched_512x512_json"] = {"error": repr(e)}
+    # Sparse delivery through the RING stepper (r5: the steady-state
+    # watched relief is no longer single-device only). 1-device ring:
+    # the same program as a multi-chip mesh.
+    try:
+        from gol_tpu.models.rules import LIFE as _LIFE
+        from gol_tpu.parallel.packed_halo import (
+            packed_sharded_stepper as _ring,
+        )
+
+        detail["diff_ring1_512x512_sparse"] = _delivered_sparse(
+            _ring(_LIFE, [_jax.devices()[0]], H)
+        )
+    except Exception as e:
+        detail["diff_ring1_512x512_sparse"] = {"error": repr(e)}
+    # Balanced-split vs divisible-count packed ring parity (r5; needs
+    # n devices for n shards, so it runs on the virtual CPU mesh in a
+    # subprocess and reports ratios — see the probe's docstring).
+    try:
+        pp = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "ring_uneven_probe.py")],
+            env={**os.environ, "PYTHONPATH": pp.rstrip(os.pathsep)},
+            capture_output=True, text=True, timeout=600, cwd="/tmp",
+        )
+        line = next((l for l in proc.stdout.splitlines()
+                     if l.startswith("{")), None)
+        if line is None:
+            raise RuntimeError(
+                f"probe rc={proc.returncode}: {proc.stderr[-500:]}"
+            )
+        detail["ring_uneven_parity_cpu"] = json.loads(line)
+    except Exception as e:
+        detail["ring_uneven_parity_cpu"] = {"error": repr(e)}
     detail["first_alive_report_s"] = first_report
     # The pallas-packed vs XLA-packed-fori_loop ratio the README quotes.
     try:
@@ -555,7 +700,18 @@ def main() -> None:
             detail["pallas_vs_xla_packed_512x512"] = round(
                 pallas["turns_per_sec"] / xla["turns_per_sec"], 2
             )
-    (REPO / "BENCH_DETAIL.json").write_text(json.dumps(detail, indent=2))
+    # Study captures (scripts/kernel_ab.py --json, scripts/ilp_study.py
+    # --json) merge their results into BENCH_DETAIL under their own
+    # keys; carry them forward across this rewrite so one file holds
+    # the whole capture the docs cite.
+    bd_path = REPO / "BENCH_DETAIL.json"
+    if bd_path.exists():
+        with contextlib.suppress(Exception):
+            old = json.loads(bd_path.read_text())
+            for k in ("kernel_ab", "ilp_study", "split_interleave"):
+                if k in old:
+                    detail[k] = old[k]
+    bd_path.write_text(json.dumps(detail, indent=2))
 
     print(
         json.dumps(

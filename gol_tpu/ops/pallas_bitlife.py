@@ -89,19 +89,73 @@ def _turns_body(rule: Rule, unroll: int):
     return body
 
 
-def _run_turns(p: jax.Array, n_turns: int, rule: Rule) -> jax.Array:
-    """`n_turns` in-kernel turns: an UNROLL-deep loop plus remainder."""
+def _split_turn(parts: list, rule: Rule) -> list:
+    """One exact toroidal turn on k row-slices of one board, all k
+    updated per call: each slice's cross-word carries come from its
+    ring-neighbour slices (concatenated edge word-rows instead of the
+    whole-board sublane roll). Bit-identical to `_pallas_turn` on the
+    concatenated board; the point is the SCHEDULE — k mostly-
+    independent dependency chains interleave on the VPU where one
+    chain stalls it (the ilp_study finding, productized: drift-
+    cancelled A/Bs measured +13% at 1024² and +23% at 2048² for
+    8-row slices; BENCH_DETAIL split_interleave)."""
+    one, top = 1, WORD - 1
+    k = len(parts)
+    out = []
+    for i, a in enumerate(parts):
+        cu = jnp.concatenate([parts[(i - 1) % k][-1:], a[:-1]], axis=0)
+        cd = jnp.concatenate([a[1:], parts[(i + 1) % k][:1]], axis=0)
+        up = (a << one) | (cu >> top)
+        down = (a >> one) | (cd << top)
+        out.append(combine_packed(a, up, down, rule, roll=pltpu.roll))
+    return out
+
+
+def _interleave_k(rows: int) -> int:
+    """Slice count for the whole-board kernel's interleaved form:
+    8-row slices (the sublane tile) measured best at every size that
+    can form at least two of them; capped at 8 (beyond that the
+    unrolled body bloats compile with no further measured gain)."""
+    for k in (8, 4, 2):
+        if rows % k == 0 and rows // k >= 8:
+            return k
+    return 1
+
+
+def _run_turns(p: jax.Array, n_turns: int, rule: Rule,
+               interleave: bool = False) -> jax.Array:
+    """`n_turns` in-kernel turns: an UNROLL-deep loop plus remainder.
+    `interleave` runs the k-way sliced form (see _split_turn) — the
+    whole-board kernel's configuration; the tiled kernels keep the
+    single chain (their strips stream through the grid pipeline,
+    a different scheduling regime)."""
+    k = _interleave_k(p.shape[0]) if interleave else 1
+    if k == 1:
+        whole, rem = divmod(n_turns, UNROLL)
+        if whole:
+            p = lax.fori_loop(0, whole, _turns_body(rule, UNROLL), p)
+        for _ in range(rem):
+            p = _pallas_turn(p, rule)
+        return p
+    rows = p.shape[0]
+    parts = tuple(p[i * rows // k : (i + 1) * rows // k] for i in range(k))
+
+    def body(_, ps):
+        for _ in range(UNROLL):
+            ps = tuple(_split_turn(list(ps), rule))
+        return ps
+
     whole, rem = divmod(n_turns, UNROLL)
     if whole:
-        p = lax.fori_loop(0, whole, _turns_body(rule, UNROLL), p)
+        parts = lax.fori_loop(0, whole, body, parts)
     for _ in range(rem):
-        p = _pallas_turn(p, rule)
-    return p
+        parts = tuple(_split_turn(list(parts), rule))
+    return jnp.concatenate(parts, axis=0)
 
 
 def _make_kernel(n_turns: int, rule: Rule):
     def kernel(in_ref, out_ref):
-        out_ref[:] = _run_turns(in_ref[:], n_turns, rule)
+        out_ref[:] = _run_turns(in_ref[:], n_turns, rule, interleave=True)
 
     return kernel
 

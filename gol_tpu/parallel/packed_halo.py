@@ -114,11 +114,15 @@ DEEP_WORDS = 4
 
 def _strip_shape_factor(r: int) -> float:
     """Throughput discount of thin tile heights — the dependency-chain
-    wall (docs/PERF.md, the 512² study). r/(r+6) approximately fits
-    the measured forced-r rates at 2048² (r=16: ~0.73, r=32: ~0.85,
-    r=64: ~0.92 of the halo-adjusted whole-board rate, each ±0.04 of
-    the formula)."""
-    return r / (r + 6)
+    wall (docs/PERF.md, the 512² study). r/(r+2.6) is the r5
+    multi-shape fit: forced-r sweeps at 2048²/8192²/16384² (r in
+    8..64, scripts/kernel_ab.py, BENCH_DETAIL kernel_ab.fit) agree on
+    c=1.9-3.1 per shape, c=2.6 jointly at 2.4% relative rms. The r4
+    single-shape constant (6) overstated the thin-strip penalty; at
+    the one config in a 104-point selection sweep where the pick
+    changes (1024-word shards 8192 wide), the refit's choice measured
+    11% faster on hardware (kernel_ab.selection_ab)."""
+    return r / (r + 2.6)
 
 
 def search_local_block_mode(strip_words: int, plan_1d, plan_2d,

@@ -28,14 +28,18 @@ Round-4 findings (this script reproduces them):
   per-stream ceiling. (Boards at and above 1024² already run wide
   enough ops to fill the pipeline: device_rates.)
 
-Usage: python scripts/ilp_study.py  (needs the TPU; ~2 min)
+Usage: python scripts/ilp_study.py [--json]  (needs the TPU; ~2 min)
+--json merges the capture into BENCH_DETAIL.json under "ilp_study"
+(bench.py carries the key forward across its own rewrites).
 """
 
+import json
 import pathlib
 import sys
 import time
 
-sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
 
 import jax
 import jax.numpy as jnp
@@ -50,7 +54,7 @@ from gol_tpu.ops.pallas_bitlife import _pallas_turn
 
 H = W = 512
 N, CHAIN = 100_000, 20
-LINK_LATENCY = 0.104  # measured via bench.measure_link_latency
+LINK_LATENCY = 0.104  # fallback; main() measures the live value
 
 ONE, TOP = 1, WORD - 1
 
@@ -160,7 +164,7 @@ def make_coupled(pair_turn, unroll=8):
     return jax.jit(lambda q: f(q))
 
 
-def measure(name, f, boards):
+def measure(name, f, boards, latency=LINK_LATENCY):
     q = f(*boards)
     int(jnp.sum(q[0] if isinstance(q, (tuple, list)) else q))  # warm
     t0 = time.perf_counter()
@@ -169,7 +173,7 @@ def measure(name, f, boards):
         out = f(*state)
         state = tuple(out) if isinstance(out, (tuple, list)) else (out,)
     int(jnp.sum(state[0]))
-    dt = time.perf_counter() - t0 - LINK_LATENCY
+    dt = time.perf_counter() - t0 - latency
     tps = CHAIN * N / dt
     agg = len(boards) * tps * H * W / 1e12
     print(f"{name:24s} {tps/1e6:6.2f}M turns/s/board   {agg:.2f} Tcells/s aggregate")
@@ -177,6 +181,9 @@ def measure(name, f, boards):
 
 
 def main():
+    from bench import measure_link_latency
+
+    latency = measure_link_latency()
     p0, p1 = _board(1), _board(2)
     # Bit-exactness of the coupled variants before timing them.
     want = jax.jit(lambda q: step_n_packed_raw(q, 16, LIFE))(p0)
@@ -193,10 +200,32 @@ def main():
         assert (jnp.asarray(got) == jnp.asarray(want)).all(), pt.__name__
     print("coupled variants bit-exact: OK\n")
 
-    measure("A baseline", make_baseline(), (p0,))
-    measure("B two independent", make_two_boards(), (p0, p1))
-    measure("C coupled roll+select", make_coupled(_pair_turn_select), (p0,))
-    measure("D coupled concat", make_coupled(_pair_turn_concat), (p0,))
+    a = measure("A baseline", make_baseline(), (p0,), latency)
+    b = measure("B two independent", make_two_boards(), (p0, p1), latency)
+    c = measure("C coupled roll+select", make_coupled(_pair_turn_select),
+                (p0,), latency)
+    d = measure("D coupled concat", make_coupled(_pair_turn_concat),
+                (p0,), latency)
+    headroom = b / a
+    print(f"\nILP headroom (B/A): {headroom:.2f}x — a ghost-decoupled "
+          "split costs >=2x compute (8-sublane alignment), so the net "
+          f"is a {'loss' if headroom < 2 else 'WASH OR WIN'} at this "
+          "capture's numbers")
+    if "--json" in sys.argv:
+        bd_path = REPO / "BENCH_DETAIL.json"
+        bd = json.loads(bd_path.read_text()) if bd_path.exists() else {}
+        bd["ilp_study"] = {
+            "board": f"{H}x{W}",
+            "link_latency_ms": round(latency * 1e3, 2),
+            "A_baseline_tcells": round(a, 2),
+            "B_two_independent_aggregate_tcells": round(b, 2),
+            "C_coupled_select_tcells": round(c, 2),
+            "D_coupled_concat_tcells": round(d, 2),
+            "ilp_headroom_B_over_A": round(headroom, 2),
+            "ghost_split_compute_cost": ">=2x (8-sublane alignment)",
+        }
+        bd_path.write_text(json.dumps(bd, indent=2))
+        print(f"merged under ilp_study in {bd_path}")
 
 
 if __name__ == "__main__":

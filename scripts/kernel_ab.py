@@ -1,66 +1,195 @@
 #!/usr/bin/env python
 """A/B the 1-D strip-tiled kernel against the 2-D tiled kernel on
-hardware — the capture behind docs/PERF.md's wide-board numbers
-(1-D thin strips vs width+height tiles with corner ghosts), plus the
-thin-strip diagnostic that motivated the 2-D design: strips of r=16
-word-rows forced onto a 2048² board (which the whole-board kernel runs
-at full rate) reproduce the wide-board fall-off exactly, pinning the
-cause on op shape rather than on HBM traffic or halo compute.
+hardware, and SWEEP the forced strip height r across board shapes to
+fit the thin-strip shape factor r/(r+c) that scores the local-block
+kernel search (packed_halo._strip_shape_factor; VERDICT r4 Weak #5:
+the constant was fitted at 2048² only, yet steers kernel selection at
+every width and for the Generations plane stacks).
 
-Usage: python scripts/kernel_ab.py   (needs the TPU; ~3 min)
+Model per shape s:  tps_s(r) = base_s * (r / (r + 2h)) * (r / (r + c))
+— the halo-overhead term is exact (h ghost words per side per 32h-turn
+block), the r/(r+c) term is the empirical dependency-chain discount of
+thin op shapes. `c` is fitted jointly over all shapes (base_s free per
+shape) by grid search on mean squared relative residual.
+
+Usage: python scripts/kernel_ab.py [--json]   (needs the TPU; ~6 min)
+--json merges the capture into BENCH_DETAIL.json under "kernel_ab"
+(bench.py carries the key forward across its own rewrites).
 """
 
+import json
 import pathlib
 import sys
 import time
 
-sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
 
 import jax
 import jax.numpy as jnp
 
-from gol_tpu.models.rules import LIFE
+from gol_tpu.models.rules import LIFE, get_rule
+from gol_tpu.ops.bitgens import pack_states
 from gol_tpu.ops.bitlife import pack
+from gol_tpu.ops.generations import states_from_levels
 from gol_tpu.ops.life import random_world, to_bits
+from gol_tpu.ops.pallas_bitgens import step_n_packed_gens_pallas_tiled_raw
 from gol_tpu.ops.pallas_bitlife import (
     step_n_packed_pallas_raw,
     step_n_packed_pallas_tiled2d_raw,
     step_n_packed_pallas_tiled_raw,
 )
 
-LINK_LATENCY = 0.104  # measured via bench.measure_link_latency
+HALO = 2  # fixed ghost depth for every forced-r point (uniform h term)
 
 
-def rate(side, fn, n, chain, **kw):
-    p0 = jax.jit(lambda w: pack(to_bits(w)))(
+def _life_board(side):
+    return jax.jit(lambda w: pack(to_bits(w)))(
         jnp.asarray(random_world(side, side, seed=1))
     )
-    f = jax.jit(lambda q: fn(q, n, LIFE, **kw))
-    q = f(p0)
+
+
+def _gens_board(side, rule):
+    levels = (jnp.asarray(random_world(side, side, seed=2),
+                          jnp.uint8))
+    return pack_states(states_from_levels(levels, rule), rule)
+
+
+def rate(board, fn, n, chain, latency, **kw):
+    f = jax.jit(lambda q: fn(q, n, **kw))
+    q = f(board)
     int(jnp.sum(q))  # warm (realize; block_until_ready is lazy here)
     t0 = time.perf_counter()
-    q = p0
+    q = board
     for _ in range(chain):
         q = f(q)
     int(jnp.sum(q))
-    dt = time.perf_counter() - t0 - LINK_LATENCY
-    tps = chain * n / dt
-    return tps, tps * side * side / 1e12
+    dt = time.perf_counter() - t0 - latency
+    return chain * n / dt
+
+
+def fit_c(points):
+    """points: [(shape_key, r, h, tps)] -> (best c, rel rms residual).
+    base_s eliminated per shape at each candidate c (ratio mean)."""
+    best = None
+    by_shape = {}
+    for s, r, h, tps in points:
+        by_shape.setdefault(s, []).append((r, h, tps))
+    for c10 in range(0, 161):
+        c = c10 / 10.0
+        sq, n = 0.0, 0
+        for s, pts in by_shape.items():
+            preds = [(r / (r + 2 * h)) * (r / (r + c)) for r, h, _ in pts]
+            base = sum(t / p for (_, _, t), p in zip(pts, preds)) / len(pts)
+            for (r, h, t), p in zip(pts, preds):
+                sq += ((t - base * p) / t) ** 2
+                n += 1
+        rms = (sq / n) ** 0.5
+        if best is None or rms < best[1]:
+            best = (c, rms)
+    return best
 
 
 def main():
+    emit_json = "--json" in sys.argv
+    from bench import measure_link_latency
+
+    latency = measure_link_latency()
+    out = {"halo_words": HALO, "link_latency_ms": round(latency * 1e3, 2),
+           "ab_1d_vs_2d": {}, "forced_r": [], }
+
+    # --- 1-D vs 2-D tiled A/B at the wide sizes (unchanged check) ---
     for side, n, chain in ((8192, 12_000, 8), (16384, 4_000, 6)):
-        for name, fn in (("1-D tiled", step_n_packed_pallas_tiled_raw),
-                         ("2-D tiled", step_n_packed_pallas_tiled2d_raw)):
-            tps, t = rate(side, fn, n, chain)
-            print(f"{side}² {name:10s}: {tps:8.0f} turns/s = {t:.2f} Tcells/s")
-    # Thin-strip diagnostic at a size the whole-board kernel handles.
-    tps, t = rate(2048, step_n_packed_pallas_raw, 30_000, 10)
-    print(f"2048² whole-board  : {tps:8.0f} turns/s = {t:.2f} Tcells/s")
-    tps, t = rate(2048, step_n_packed_pallas_tiled_raw, 30_000, 10,
-                  strip_rows=16, halo_words=2)
-    print(f"2048² forced r=16  : {tps:8.0f} turns/s = {t:.2f} Tcells/s "
-          "(the wide-board thin-strip wall, reproduced)")
+        b = _life_board(side)
+        for name, fn in (("tiled1d", step_n_packed_pallas_tiled_raw),
+                         ("tiled2d", step_n_packed_pallas_tiled2d_raw)):
+            tps = rate(b, fn, n, chain, latency, rule=LIFE)
+            t = tps * side * side / 1e12
+            out["ab_1d_vs_2d"][f"{side}_{name}"] = {
+                "turns_per_sec": round(tps), "tcells_per_sec": round(t, 2)}
+            print(f"{side}² {name:8s}: {tps:8.0f} turns/s = {t:.2f} Tcells/s")
+
+    # --- forced-r sweep: Life at three widths + one gens config ---
+    bb = get_rule("B2/S/C3")
+    sweeps = [
+        ("life_2048", 2048, None, (8, 16, 32, 64), 30_000, 8),
+        ("life_8192", 8192, None, (8, 16, 32), 10_000, 6),
+        ("life_16384", 16384, None, (8, 16), 4_000, 5),
+        ("gens_8192_C3", 8192, bb, (8, 16), 8_000, 5),
+    ]
+    points = []
+    for key, side, rule, rs, n, chain in sweeps:
+        if rule is None:
+            b, fn, kw = _life_board(side), step_n_packed_pallas_tiled_raw, \
+                {"rule": LIFE}
+        else:
+            b, fn = _gens_board(side, rule), step_n_packed_gens_pallas_tiled_raw
+            kw = {"rule": rule}
+        for r in rs:
+            try:
+                tps = rate(b, fn, n, chain, latency,
+                           strip_rows=r, halo_words=HALO, **kw)
+            except Exception as e:
+                print(f"{key} r={r}: skipped ({type(e).__name__})")
+                continue
+            t = tps * side * side / 1e12
+            points.append((key, r, HALO, tps))
+            out["forced_r"].append({
+                "shape": key, "r": r, "halo_words": HALO,
+                "turns_per_sec": round(tps),
+                "tcells_per_sec": round(t, 3)})
+            print(f"{key:14s} r={r:3d}: {tps:9.0f} turns/s = {t:.2f} Tcells/s")
+
+    # Anchor: the 2048² whole-board kernel (no tiling, no halo) — the
+    # rate thin strips are discounted FROM.
+    tps = rate(_life_board(2048), step_n_packed_pallas_raw, 30_000, 8,
+               latency, rule=LIFE)
+    out["whole_2048"] = {"turns_per_sec": round(tps),
+                         "tcells_per_sec": round(tps * 2048 * 2048 / 1e12, 2)}
+    print(f"2048² whole-board : {tps:8.0f} turns/s = "
+          f"{tps * 2048 * 2048 / 1e12:.2f} Tcells/s")
+
+    c, rms = fit_c(points)
+    life = [p for p in points if p[0].startswith("life")]
+    cl, rmsl = fit_c(life)
+    per_shape = {
+        s: fit_c([p for p in points if p[0] == s])[0]
+        for s in sorted({p[0] for p in life})
+    }
+    # The production constant, read from the code (never hardcoded
+    # here — the capture must compare against what actually ships).
+    from gol_tpu.parallel.packed_halo import _strip_shape_factor
+
+    prod_c = round(8 / _strip_shape_factor(8) - 8, 2)
+    out["fit"] = {"model": "base_s * r/(r+2h) * r/(r+c)",
+                  "c": c, "rel_rms_residual": round(rms, 4),
+                  "n_points": len(points),
+                  "note": "joint fit includes the gens points; see "
+                          "fit_life_only for why they distort c",
+                  "production_constant": prod_c}
+    out["fit_life_only"] = {
+        "c": cl, "rel_rms_residual": round(rmsl, 4),
+        "per_shape_c": per_shape,
+        "note": "gens excluded: plane-scaled VMEM pressure can invert "
+                "the r trend there (r=16 slower than r=8 at 8192² C3 "
+                "in the r5 capture), which is a cost-model effect, not "
+                "a shape-factor one — the production constant follows "
+                "THIS fit",
+    }
+    print(f"\njoint fit: c = {c:.1f} (rms {rms:.3f}); life-only: "
+          f"c = {cl:.1f} (rms {rmsl:.3f}); production r/(r+{prod_c})")
+
+    if emit_json:
+        bd_path = REPO / "BENCH_DETAIL.json"
+        bd = json.loads(bd_path.read_text()) if bd_path.exists() else {}
+        old = bd.get("kernel_ab", {})
+        if "selection_ab" in old:
+            # The selection A/B is a separate hardware run; keep its
+            # capture across refreshes of the sweep.
+            out.setdefault("selection_ab", old["selection_ab"])
+        bd["kernel_ab"] = out
+        bd_path.write_text(json.dumps(bd, indent=2))
+        print(f"merged under kernel_ab in {bd_path}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Follow-up to ilp_study: the r5 capture measured the COUPLED
+two-half kernel ~17% ABOVE the single-chain baseline at 512² (r4 had
+recorded a collapse — within that capture's noise). This experiment
+pins it down with repeats and generalizes: k-way row splits of ONE
+board, cross-carries from ring neighbours (bit-exact), interleaved
+per loop iteration.
+
+Usage: python scripts/split_experiment.py  (needs the TPU)
+"""
+
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gol_tpu.models.rules import LIFE
+from gol_tpu.ops.bitlife import WORD, pack, step_n_packed_raw
+from gol_tpu.ops.life import random_world, to_bits
+
+# The experiment measures the EXACT production body — importing it
+# keeps the A/B honest if the kernel ever changes.
+from gol_tpu.ops.pallas_bitlife import _split_turn
+
+
+def _board(side, seed=1):
+    return jax.jit(lambda w: pack(to_bits(w)))(
+        jnp.asarray(random_world(side, side, seed=seed))
+    )
+
+
+def split_turn(parts):
+    return _split_turn(list(parts), LIFE)
+
+
+def make_split(side, k, n, unroll=8):
+    rows = side // WORD
+    assert rows % k == 0
+
+    def kernel(in_ref, out_ref):
+        parts = [in_ref[i * rows // k : (i + 1) * rows // k]
+                 for i in range(k)]
+
+        def body(_, ps):
+            for _ in range(unroll):
+                ps = split_turn(list(ps))
+            return tuple(ps)
+
+        parts = lax.fori_loop(0, n // unroll, body, tuple(parts))
+        for i in range(k):
+            out_ref[i * rows // k : (i + 1) * rows // k] = parts[i]
+
+    shape = jax.ShapeDtypeStruct((rows, side), jnp.uint32)
+    f = pl.pallas_call(
+        kernel, out_shape=shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )
+    return jax.jit(lambda q: f(q))
+
+
+def measure(f, board, n, chain, latency, reps=3):
+    best = None
+    q = f(board)
+    int(jnp.sum(q))  # warm
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        q = board
+        for _ in range(chain):
+            q = f(q)
+        int(jnp.sum(q))
+        dt = time.perf_counter() - t0 - latency
+        best = dt if best is None else min(best, dt)
+    return chain * n / best
+
+
+def main():
+    from bench import measure_link_latency
+
+    lat = measure_link_latency()
+    for side, n, chain in ((512, 100_000, 20), (1024, 50_000, 10)):
+        b = _board(side)
+        want = jax.jit(lambda q: step_n_packed_raw(q, 16, LIFE))(b)
+        base = None
+        for k in (1, 2, 4, 8):
+            if (side // WORD) % k:
+                continue
+            if k > 1:  # bit-exactness vs the plain kernel
+                f16 = make_split(side, k, 16, unroll=16)
+                assert (jnp.asarray(f16(b)) == jnp.asarray(want)).all(), k
+            f = make_split(side, k, n)
+            tps = measure(f, b, n, chain, lat)
+            t = tps * side * side / 1e12
+            if k == 1:
+                base = tps
+            print(f"{side}² split k={k}: {tps/1e6:6.2f}M turns/s "
+                  f"= {t:.2f} Tcells/s ({tps/base:.2f}x vs k=1)")
+        print()
+
+
+if __name__ == "__main__":
+    main()

@@ -423,6 +423,12 @@ def test_local_block_mode_selection():
     assert local_block_mode(8, 128, on_tpu=False) == (1, "xla")
     assert local_block_mode(8, 128, on_tpu=False, force=True) == (4, "whole")
     assert local_block_mode(8, 128, on_tpu=True, force=False) == (1, "xla")
+    # The one selection the r5 shape-factor refit (r/(r+2.6), fitted
+    # over 2048²/8192²/16384² forced-r sweeps) changes vs the old
+    # single-shape constant: 1024-word shards 8192 wide pick the
+    # deeper-h 1-D plan, measured 11% faster on hardware
+    # (BENCH_DETAIL kernel_ab.selection_ab).
+    assert local_block_mode(1024, 8192, on_tpu=True) == (8, "tiled")
 
 
 def test_packed_sharded_pallas_local_blocks_match_dense():
