@@ -149,18 +149,23 @@ def _sustained_rate(stepper, side: int, turns: int, latency: float) -> dict:
     dispatches, realize once, subtract the measured link latency.
     Dispatches are large (100k turns where the budget allows): each
     dispatch is an RPC through the tunnel, and 25k-turn chunks at the
-    512² kernel rate made dispatch overhead ~10% of the measurement."""
+    512² kernel rate made dispatch overhead ~10% of the measurement.
+    Best-of-2: single chains occasionally catch a tunnel stall or a
+    chip slow window and record 30-40% low (the r5 capture's 2048²
+    outlier vs the same-day kernel_ab anchor); one retry damps it."""
     p = stepper.put(_world(side))
     n = min(100_000, turns)
     k = max(1, turns // n)
     int(stepper.step_n(p, n)[1])
-    t0 = time.perf_counter()
-    q = p
-    for _ in range(k):
-        q, count = stepper.step_n(q, n)
-    int(count)
-    dt = time.perf_counter() - t0 - latency
-    tps = k * n / dt
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        q = p
+        for _ in range(k):
+            q, count = stepper.step_n(q, n)
+        int(count)
+        best = min(best, time.perf_counter() - t0 - latency)
+    tps = k * n / best
     return {
         "backend": stepper.name,
         "turns_per_sec": round(tps, 1),

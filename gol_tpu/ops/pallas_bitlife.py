@@ -115,9 +115,14 @@ def _interleave_k(rows: int) -> int:
     """Slice count for the whole-board kernel's interleaved form:
     8-row slices (the sublane tile) measured best at every size that
     can form at least two of them; capped at 8 (beyond that the
-    unrolled body bloats compile with no further measured gain)."""
+    unrolled body bloats compile with no further measured gain).
+    Slices must stay sublane-ALIGNED (a multiple of 8 rows): the
+    ghost-extended ring strips are e.g. 40 word-rows, and k=4 there
+    would make misaligned 10-row slices — measured 27% BELOW the
+    un-interleaved kernel (the r5 capture's ring1_1024 regression);
+    such shapes keep the single chain."""
     for k in (8, 4, 2):
-        if rows % k == 0 and rows // k >= 8:
+        if rows % (8 * k) == 0:  # k slices, each a whole multiple of 8
             return k
     return 1
 

@@ -170,11 +170,12 @@ def main():
     out["fit_life_only"] = {
         "c": cl, "rel_rms_residual": round(rmsl, 4),
         "per_shape_c": per_shape,
-        "note": "gens excluded: plane-scaled VMEM pressure can invert "
-                "the r trend there (r=16 slower than r=8 at 8192² C3 "
-                "in the r5 capture), which is a cost-model effect, not "
-                "a shape-factor one — the production constant follows "
-                "THIS fit",
+        "note": "gens excluded: the gens points' r trend is noisier "
+                "(one r5 capture even measured r=16 below r=8 at 8192² "
+                "C3; a later same-day capture showed the normal order) "
+                "— plane-scaled VMEM pressure is a cost-model effect, "
+                "not a shape-factor one, so the production constant "
+                "follows THIS fit",
     }
     print(f"\njoint fit: c = {c:.1f} (rms {rms:.3f}); life-only: "
           f"c = {cl:.1f} (rms {rmsl:.3f}); production r/(r+{prod_c})")
