@@ -66,21 +66,74 @@ def _gens_turn(planes: tuple, rule: GenRule) -> tuple:
     return bitgens.step_planes(planes, rule, up, down, roll=pltpu.roll)
 
 
-def _run_gens_turns(planes: tuple, n_turns: int, rule: GenRule) -> tuple:
-    """`n_turns` in-kernel turns on a plane tuple: an UNROLL-deep loop
-    plus remainder — the gens mirror of pallas_bitlife._run_turns."""
+def _gens_split_turn(slices: list, rule: GenRule) -> list:
+    """One exact toroidal turn on k row-slices of the plane stack —
+    the gens twin of pallas_bitlife._split_turn: only the ALIVE plane
+    carries across slice seams (a gens cell's update needs
+    alive-neighbour counts only), every plane is sliced alike.
+    Measured +12.5% at 1024² C3 (drift-cancelled medians), mirroring
+    the Life kernel's interleave win."""
+    one, top = 1, WORD - 1
+    k = len(slices)
+    out = []
+    for i, planes in enumerate(slices):
+        alive = planes[0]
+        cu = jnp.concatenate(
+            [slices[(i - 1) % k][0][-1:], alive[:-1]], axis=0
+        )
+        cd = jnp.concatenate(
+            [alive[1:], slices[(i + 1) % k][0][:1]], axis=0
+        )
+        up = (alive << one) | (cu >> top)
+        down = (alive >> one) | (cd << top)
+        out.append(bitgens.step_planes(planes, rule, up, down,
+                                       roll=pltpu.roll))
+    return out
 
-    def body(_, pl_):
+
+def _run_gens_turns(planes: tuple, n_turns: int, rule: GenRule,
+                    interleave: bool = False) -> tuple:
+    """`n_turns` in-kernel turns on a plane tuple: an UNROLL-deep loop
+    plus remainder — the gens mirror of pallas_bitlife._run_turns,
+    including the whole-board slice interleave (sublane-aligned k via
+    the SAME _interleave_k policy; tiled callers keep the single
+    chain)."""
+    from gol_tpu.ops.pallas_bitlife import _interleave_k
+
+    k = _interleave_k(planes[0].shape[0]) if interleave else 1
+    if k == 1:
+        def body(_, pl_):
+            for _ in range(UNROLL):
+                pl_ = _gens_turn(pl_, rule)
+            return pl_
+
+        whole, rem = divmod(n_turns, UNROLL)
+        if whole:
+            planes = lax.fori_loop(0, whole, body, planes)
+        for _ in range(rem):
+            planes = _gens_turn(planes, rule)
+        return planes
+
+    rows = planes[0].shape[0]
+    slices = tuple(
+        tuple(p[i * rows // k : (i + 1) * rows // k] for p in planes)
+        for i in range(k)
+    )
+
+    def body(_, ss):
         for _ in range(UNROLL):
-            pl_ = _gens_turn(pl_, rule)
-        return pl_
+            ss = tuple(_gens_split_turn(ss, rule))
+        return ss
 
     whole, rem = divmod(n_turns, UNROLL)
     if whole:
-        planes = lax.fori_loop(0, whole, body, planes)
+        slices = lax.fori_loop(0, whole, body, slices)
     for _ in range(rem):
-        planes = _gens_turn(planes, rule)
-    return planes
+        slices = tuple(_gens_split_turn(slices, rule))
+    return tuple(
+        jnp.concatenate([s[j] for s in slices], axis=0)
+        for j in range(len(planes))
+    )
 
 
 def _make_kernel(n_turns: int, rule: GenRule):
@@ -88,7 +141,7 @@ def _make_kernel(n_turns: int, rule: GenRule):
 
     def kernel(*refs):
         planes = tuple(r[:] for r in refs[:nplanes])
-        planes = _run_gens_turns(planes, n_turns, rule)
+        planes = _run_gens_turns(planes, n_turns, rule, interleave=True)
         for out_ref, plane in zip(refs[nplanes:], planes):
             out_ref[:] = plane
 

@@ -533,3 +533,25 @@ def test_gens_packed_uneven_diff_stack_and_local_pallas():
     ps, cs = slow.step_n(slow.put(world), 37)
     np.testing.assert_array_equal(fast.fetch(pf), slow.fetch(ps))
     assert int(cf) == int(cs)
+
+
+@pytest.mark.parametrize("notation", ["B2/S/C3", "B2/S345/C4"])
+def test_pallas_gens_interleaved_whole_board_interpret(notation):
+    """The r5 slice-interleaved whole-board gens kernel (k row-slices,
+    alive-plane carries across seams) must stay bit-exact vs the XLA
+    packed gens step at a size where k > 1 engages (512² = 16 word-
+    rows -> k=2), interpret mode."""
+    from gol_tpu.ops import bitgens
+    from gol_tpu.ops.pallas_bitgens import step_n_packed_gens_pallas_raw
+    from gol_tpu.ops.pallas_bitlife import _interleave_k
+
+    assert _interleave_k(16) == 2  # the config this test pins
+    rule = get_rule(notation)
+    world = np.asarray(life.random_world(512, 512, density=0.3, seed=31))
+    planes = bitgens.pack_states(gens.states_from_levels(world, rule), rule)
+    import jax.numpy as jnp
+
+    planes = jnp.asarray(planes)
+    want = bitgens.step_n_packed_gens_raw(planes, 19, rule)
+    got = step_n_packed_gens_pallas_raw(planes, 19, rule, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
