@@ -208,15 +208,37 @@ def gens_sharded_stepper(rule: GenRule, devices: list, height: int):
     mesh = Mesh(np.asarray(devices), (AXIS,))
     sharding = NamedSharding(mesh, P(AXIS, None))
     spec = P(AXIS, None)
+    from gol_tpu.parallel.halo import DEEP_ROWS
+
+    deep = min(DEEP_ROWS, height // n)
+
+    def deep_block(block):
+        """One deep-row STATE ghost exchange, `deep` exact local turns
+        of the plain toroidal gens kernel (the halo.sharded_stepper
+        deep block with state rows — a ghost cell's multi-turn
+        evolution needs its age, which travels with the row; r5
+        brought the dense gens ring into the communication-avoiding
+        story alongside everything else)."""
+        top, bottom = edge_exchange(block, AXIS, depth=deep)
+        ext = jnp.concatenate([top, block, bottom], axis=0)
+        ext = lax.fori_loop(
+            0, deep, lambda _, b: gens.step_states(b, rule), ext
+        )
+        return ext[deep:-deep]
 
     @functools.partial(jax.jit, static_argnames=("k",))
     def step_n(state, k):
+        blocks, rem_t = divmod(max(k, 0), deep) if deep >= 2 else (0, k)
+
         @functools.partial(
             jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P())
         )
         def _many(block):
             block = lax.fori_loop(
-                0, k, lambda _, b: halo_step_states(b, rule, AXIS), block
+                0, blocks, lambda _, b: deep_block(b), block
+            )
+            block = lax.fori_loop(
+                0, rem_t, lambda _, b: halo_step_states(b, rule, AXIS), block
             )
             count = lax.psum(
                 jnp.sum(block == 1, dtype=jnp.int32), AXIS
@@ -257,23 +279,20 @@ def _gens_sharded_stepper_uneven(rule: GenRule, devices: list, height: int):
     sharding = NamedSharding(mesh, P(AXIS, None))
     spec = P(AXIS, None)
 
-    @functools.partial(jax.jit, static_argnames=("k",))
-    def step_n(state, k):
-        @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P())
-        )
-        def _many(block):
-            block = lax.fori_loop(
-                0, k,
-                lambda _, b: halo_step_states_uneven(b, rule, n, height),
-                block,
-            )
-            # Padding rows are forced dead by the step, so the plain
-            # local alive reduction + psum is exact.
-            count = lax.psum(jnp.sum(block == 1, dtype=jnp.int32), AXIS)
-            return block, count
+    from gol_tpu.parallel.halo import DEEP_ROWS, balanced_deep_step_n
 
-        return _many(state)
+    deep = min(DEEP_ROWS, strip - 1)  # every ghost from ONE neighbour
+
+    # Deep-halo blocks on the balanced split (r5): ghost STATE rows (a
+    # ghost cell's multi-turn evolution needs its age), one d-row
+    # exchange per d exact local turns of the plain toroidal gens
+    # kernel — the ONE dispatch builder shared with the Life ring.
+    step_n = balanced_deep_step_n(
+        mesh, spec, n, strip, rem, deep,
+        deep_step=lambda b: gens.step_states(b, rule),
+        per_turn=lambda b: halo_step_states_uneven(b, rule, n, height),
+        count_local=lambda b: jnp.sum(b == 1, dtype=jnp.int32),
+    )
 
     from gol_tpu.parallel.multihost import spmd_fetch, spmd_put
 

@@ -693,3 +693,36 @@ def test_balanced_split_rejects_divisor_counts():
 
         packed_gens_sharded_stepper_uneven(_gr("B2/S/C3"),
                                            jax.devices()[:2], 128)
+
+
+@pytest.mark.parametrize("rule_s,name", [
+    ("B3/S23", "halo-ring-uneven-3"),
+    ("B2/S345/C4", "gens-halo-ring-uneven-3"),
+])
+def test_dense_uneven_deep_blocks_match_serial(rule_s, name):
+    """The balanced dense rings run deep-halo blocks for fused
+    dispatches since r5 (one d-row ghost exchange per d local turns —
+    the last per-turn-collective path closed). Height 100 is not a
+    whole number of words, so the dense split is guaranteed; 53 turns
+    = 3 sixteen-turn blocks + a 5-turn per-turn tail, bit-exact vs the
+    serial engine."""
+    from gol_tpu.models.rules import GenRule, get_rule
+    from gol_tpu.ops import generations as gens
+
+    rule = get_rule(rule_s)
+    world = np.asarray(life.random_world(100, 64, density=0.3, seed=12))
+    s = make_stepper(threads=3, height=100, width=64, rule=rule_s)
+    assert s.name == name
+    p = s.put(world)
+    p, count = s.step_n(p, 53)
+    if isinstance(rule, GenRule):
+        states = gens.states_from_levels(world, rule)
+        for _ in range(53):
+            states = np.asarray(gens.step_states(states, rule))
+        want = gens.levels_from_states(states, rule)
+        want_count = int((states == 1).sum())
+    else:
+        want = np.asarray(life.step_n(world, 53))
+        want_count = int(np.count_nonzero(want))
+    np.testing.assert_array_equal(s.fetch(p), want)
+    assert int(count) == want_count
