@@ -1,0 +1,45 @@
+"""Machine-checked guardrails for the codebase's two failure planes.
+
+The repo's correctness rests on invariants nothing used to check: JAX
+hazards that silently erase perf wins (host syncs inside jitted hot
+paths, per-call recompiles, Python control flow on tracers, dtype drift
+between the packed and dense kernel families, donation decisions on the
+ring steppers' carried state), and distributed protocol orderings the
+server and SPMD mirror merely assumed (FlipBatch/TurnComplete
+adjacency, no flips across a BoardSync, monotone turns, sparse-redo
+dispatch identity). This package makes both machine-checked:
+
+- `jaxlint` + `checks/`: a pure-AST static linter over the package
+  (`python -m gol_tpu.analysis`, tier-1 via tests/test_analysis.py).
+  Pre-existing findings live in `allowlist.txt` WITH a reason each;
+  new hazards fail CI, and `scripts/check_analysis.sh` keeps the
+  allowlist shrink-only.
+- `invariants`: a runtime event-stream / dispatch-order monitor wired
+  into the engine server's broadcaster and the stepper dispatch chain
+  behind the `GOL_TPU_CHECK_INVARIANTS` opt-in (cli `--check-invariants`),
+  and turned on in the test suite.
+
+The linter imports neither jax nor the package it lints — it must run
+(and fail usefully) even when the code under analysis cannot import.
+"""
+
+from gol_tpu.analysis.core import Allowlist, Finding
+from gol_tpu.analysis.jaxlint import lint_paths
+from gol_tpu.analysis.invariants import (
+    DispatchLinearityChecker,
+    EventStreamChecker,
+    InvariantViolation,
+    checked_stepper,
+    invariants_enabled,
+)
+
+__all__ = [
+    "Allowlist",
+    "DispatchLinearityChecker",
+    "EventStreamChecker",
+    "Finding",
+    "InvariantViolation",
+    "checked_stepper",
+    "invariants_enabled",
+    "lint_paths",
+]

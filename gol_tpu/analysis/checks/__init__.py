@@ -1,0 +1,15 @@
+"""Check registry. Each module: CHECK name + run(ctx) -> findings."""
+
+from gol_tpu.analysis.checks import (
+    donation,
+    dtype_drift,
+    host_sync,
+    recompile,
+    tracer_branch,
+)
+
+#: Every check the CLI and the tier-1 test run, in report order.
+ALL_CHECKS = [host_sync, tracer_branch, recompile, dtype_drift, donation]
+
+__all__ = ["ALL_CHECKS", "donation", "dtype_drift", "host_sync",
+           "recompile", "tracer_branch"]

@@ -1,0 +1,64 @@
+"""donation — carried world state without an explicit donation decision.
+
+The ring steppers' multi-turn entry points carry the world through
+`lax.scan`/`fori_loop` and hand back a fresh array every dispatch; at
+production board sizes the input buffer is the single biggest device
+allocation, and jit will happily keep both live unless the input is
+donated. BUT donation is not free here: the engine retains references
+to dispatched worlds (the committed (turn, world) pair served to
+BoardSync/snapshot fetches, cycle-detector anchors, the sparse-overflow
+redo input), and donating a buffer something still reads is a
+use-after-free the CPU test mesh never exercises (donation is a no-op
+off TPU). So the check does not demand donation — it demands the
+decision be EXPLICIT: every multi-turn jitted stepper over a carried
+world either donates or carries an allowlist entry saying why not.
+
+Flagged: jit-decorated functions in `parallel/` modules with a
+multi-turn static argument (k/n) whose first traced parameter is a
+recognized carry name, with no donate_argnums/donate_argnames.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from gol_tpu.analysis.core import Finding, ModuleContext
+
+CHECK = "donation"
+
+#: First-parameter spellings of carried device state in this codebase.
+_CARRY_NAMES = {"world", "state", "p", "q", "w", "planes", "block"}
+_MULTI_TURN_STATICS = {"k", "n"}
+
+
+def _has_donation(node) -> bool:
+    for dec in node.decorator_list:
+        for sub in ast.walk(dec):
+            if isinstance(sub, ast.keyword) and sub.arg in (
+                    "donate_argnums", "donate_argnames"):
+                return True
+    return False
+
+
+def run(ctx: ModuleContext) -> Iterator[Finding]:
+    if "parallel/" not in ctx.rel:
+        return
+    for node, info in ctx.jitted.items():
+        if isinstance(node, ast.Lambda):
+            continue
+        if not (info.static_names & _MULTI_TURN_STATICS):
+            continue  # single-turn wrappers: both buffers are transient
+        params = [a.arg for a in node.args.args]
+        if not params or params[0] not in _CARRY_NAMES:
+            continue
+        if params[0] in info.static_names:
+            continue
+        if _has_donation(node):
+            continue
+        yield ctx.finding(
+            CHECK, node,
+            f"multi-turn stepper '{info.qualname}' carries world state "
+            f"'{params[0]}' without donate_argnums — donate it, or "
+            "allowlist with the reason the input must stay live",
+        )

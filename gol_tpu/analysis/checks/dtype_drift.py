@@ -1,0 +1,73 @@
+"""dtype-drift — off-contract dtypes in the kernel plane.
+
+Every kernel family in this repo speaks exactly four dtypes: uint8
+boards ({0,255} cells / gray levels), uint32 packed words (SWAR rows,
+diff bitmaps), int32 counts/indices/bitcast rows, and bool masks. The
+packed and dense families stay bit-exact against each other (the
+cross-backend tests) precisely because nothing ever routes through a
+float or a differently-sized integer — a float32 neighbour sum or an
+int16 index sneaking into `ops/bitlife.py` or `parallel/packed_halo.py`
+is drift between the families even when it happens to round-trip.
+
+The check walks dtype references (`jnp.float32`, `dtype="float64"`,
+`.astype('int16')`) in kernel modules — selected by filename stem, so
+the families cannot drift by adding a new kernel file either — and
+flags any dtype outside the contract set.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Iterator
+
+from gol_tpu.analysis.core import Finding, ModuleContext
+
+CHECK = "dtype-drift"
+
+#: The kernel plane's entire dtype vocabulary (see module docstring).
+KERNEL_DTYPES = {"uint8", "uint32", "int32", "bool_", "bool"}
+
+#: Dtype tokens worth flagging when seen outside the contract set.
+_ALL_DTYPES = {
+    "uint8", "uint16", "uint32", "uint64",
+    "int8", "int16", "int32", "int64",
+    "float16", "float32", "float64", "bfloat16",
+    "complex64", "complex128", "bool_", "bool",
+}
+
+#: Kernel modules by filename stem: the ops/ families and the ring
+#: steppers. (multihost/board/wire host plumbing legitimately uses
+#: int64 and is not kernel code.)
+_KERNEL_STEM = re.compile(
+    r"(^|_)(bit\w*|pallas\w*|halo|life|gens|generations|stepper)$"
+)
+
+
+def is_kernel_module(rel: str) -> bool:
+    return bool(_KERNEL_STEM.search(pathlib.PurePosixPath(rel).stem))
+
+
+def run(ctx: ModuleContext) -> Iterator[Finding]:
+    if not is_kernel_module(ctx.rel):
+        return
+    for node in ast.walk(ctx.tree):
+        token = None
+        if isinstance(node, ast.Attribute) and node.attr in _ALL_DTYPES:
+            token = node.attr
+        elif isinstance(node, ast.Call):
+            # dtype="float32" kwarg / .astype("float32") string form.
+            cands = [k.value for k in node.keywords if k.arg == "dtype"]
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("astype", "view")):
+                cands.extend(node.args[:1])
+            for c in cands:
+                if isinstance(c, ast.Constant) and c.value in _ALL_DTYPES:
+                    token = c.value
+        if token is not None and token not in KERNEL_DTYPES:
+            yield ctx.finding(
+                CHECK, node,
+                f"dtype '{token}' in kernel module — the packed/dense "
+                f"kernel contract is exactly {sorted(KERNEL_DTYPES - {'bool'})}",
+            )

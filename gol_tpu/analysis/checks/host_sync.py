@@ -1,0 +1,102 @@
+"""host-sync — host-device synchronization inside traced hot paths.
+
+A `.item()`, `float()`/`int()`/`bool()` of a traced value, or an
+`np.asarray`/`np.array` call inside a jitted function either fails at
+trace time or (worse) silently forces a device round trip per call —
+the exact tax the fused-chunk and diff-stack paths exist to avoid
+(docs/PERF.md). `block_until_ready` is flagged anywhere outside bench
+code: in the engine plane it serializes the dispatch pipeline, which is
+only ever intentional (and then allowlisted with the reason).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from gol_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    dynamic_names,
+    traced_params,
+)
+
+CHECK = "host-sync"
+
+#: numpy-namespace calls that materialize a host array from their arg.
+_HOST_MATERIALIZERS = {"asarray", "array", "ascontiguousarray"}
+#: Python builtins that force a scalar read-back of a traced value.
+_SCALARIZERS = {"float", "int", "bool"}
+#: Paths where blocking on the device is the point, not a hazard.
+_BENCH_PATH_TOKENS = ("bench", "scripts/", "tests/", "__graft_entry__")
+
+
+def _numpy_roots(ctx: ModuleContext) -> Set[str]:
+    """Names the module binds to the real numpy ('np', 'numpy', ...) —
+    jnp.asarray under trace is fine; np.asarray is the sync."""
+    roots = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    roots.add(a.asname or "numpy")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "numpy":
+                continue  # from numpy import x — rare, skip
+    return roots or {"np", "numpy", "_np"}
+
+
+def run(ctx: ModuleContext) -> Iterator[Finding]:
+    numpy_roots = _numpy_roots(ctx)
+    bench_path = any(tok in ctx.rel for tok in _BENCH_PATH_TOKENS)
+    for node in ast.walk(ctx.tree):
+        # block_until_ready outside bench code — module-wide, traced
+        # or not (on the host side it stalls the dispatch pipeline).
+        if (not bench_path and isinstance(node, ast.Attribute)
+                and node.attr == "block_until_ready"):
+            yield ctx.finding(
+                CHECK, node,
+                "block_until_ready outside bench code serializes the "
+                "dispatch pipeline (allowlist only with the reason it "
+                "is intentional)",
+            )
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        info = ctx.jit_context(node)
+        if info is None:
+            continue
+        traced = traced_params(info)
+        callee = node.func
+        # x.item() under trace: concretization error / forced sync.
+        if isinstance(callee, ast.Attribute) and callee.attr == "item" \
+                and not node.args:
+            yield ctx.finding(
+                CHECK, node,
+                f".item() inside traced '{info.qualname}' forces a "
+                "host read-back of a device value",
+            )
+        # np.asarray(...) & friends under trace.
+        elif isinstance(callee, ast.Attribute) \
+                and callee.attr in _HOST_MATERIALIZERS \
+                and isinstance(callee.value, ast.Name) \
+                and callee.value.id in numpy_roots:
+            yield ctx.finding(
+                CHECK, node,
+                f"np.{callee.attr}() inside traced '{info.qualname}' "
+                "materializes a host array from a traced value",
+            )
+        # float(x)/int(x)/bool(x) where x mentions a traced param as a
+        # VALUE — int(w.shape[0]) reads static metadata and is free,
+        # which dynamic_names exempts (same vocabulary as the
+        # tracer-branch check).
+        elif isinstance(callee, ast.Name) and callee.id in _SCALARIZERS \
+                and node.args:
+            hit = dynamic_names(node.args[0]) & traced
+            if hit:
+                yield ctx.finding(
+                    CHECK, node,
+                    f"{callee.id}() of traced value "
+                    f"'{sorted(hit)[0]}' inside '{info.qualname}' "
+                    "forces a host scalar read-back",
+                )

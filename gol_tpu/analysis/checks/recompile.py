@@ -1,0 +1,115 @@
+"""recompile — silent-recompilation and static-argument hazards.
+
+Three concrete shapes:
+
+1. static_argnames drift: a jit decorator naming a static argument the
+   wrapped function does not declare. jax only validates the names that
+   ARE present at call time, so a renamed parameter silently demotes
+   the stale name to a traced (or rejected) argument — every call site
+   keyed on it then recompiles or breaks.
+2. jit() invoked inside a loop body: each iteration builds a fresh
+   wrapper with its own cache, so every call compiles — the classic
+   accidental O(n) compile bill.
+3. bad static payloads at module-local jitted call sites: a dict
+   literal bound to a STATIC parameter fails fast (unhashable); an
+   f-string bound to one hashes fine but differs per expansion, so
+   every distinct value is a new compile-cache entry. Dicts bound to
+   TRACED parameters are legal pytree inputs and are left alone; set
+   literals are flagged on any parameter (sets are neither hashable
+   statics nor pytree containers).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from gol_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    _JIT_NAMES,
+    _tail_name,
+)
+
+CHECK = "recompile"
+
+
+def _function_params(node) -> set:
+    args = node.args
+    return {a.arg for a in [*args.posonlyargs, *args.args,
+                            *args.kwonlyargs,
+                            *([args.vararg] if args.vararg else []),
+                            *([args.kwarg] if args.kwarg else [])]}
+
+
+def _in_loop(ctx: ModuleContext, node: ast.AST) -> bool:
+    cur = ctx.parents.get(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        if isinstance(cur, (ast.For, ast.While)):
+            return True
+        cur = ctx.parents.get(cur)
+    return False
+
+
+def run(ctx: ModuleContext) -> Iterator[Finding]:
+    # 1. static_argnames drift on decorated defs.
+    for node, info in ctx.jitted.items():
+        if isinstance(node, ast.Lambda) or not info.static_names:
+            continue
+        missing = sorted(info.static_names - _function_params(node))
+        if missing:
+            yield ctx.finding(
+                CHECK, node,
+                f"static_argnames {missing} not in the signature of "
+                f"'{info.qualname}' — stale names silently stop being "
+                "static",
+            )
+    # Module-local jitted defs for shape 3: name -> (ordered params,
+    # static names), so call arguments can be bound to parameters.
+    jitted_sigs = {}
+    for n, info in ctx.jitted.items():
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = [a.arg for a in [*n.args.posonlyargs, *n.args.args]]
+            jitted_sigs[n.name] = (params, info.static_names)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        # 2. jit(...) in a loop body.
+        if _tail_name(callee) in _JIT_NAMES and _in_loop(ctx, node):
+            yield ctx.finding(
+                CHECK, node,
+                "jax.jit() called inside a loop builds a fresh compile "
+                "cache every iteration — hoist the jitted wrapper out",
+            )
+        # 3. bad payloads at jitted call sites, bound to parameters.
+        name = callee.id if isinstance(callee, ast.Name) else None
+        if name in jitted_sigs:
+            params, static = jitted_sigs[name]
+            bound = [(params[i] if i < len(params) else None, a)
+                     for i, a in enumerate(node.args)]
+            bound += [(k.arg, k.value) for k in node.keywords]
+            for param, arg in bound:
+                if isinstance(arg, ast.Set):
+                    yield ctx.finding(
+                        CHECK, arg,
+                        f"set literal passed to jitted '{name}' — "
+                        "unhashable as a static argument and not a "
+                        "pytree container as a traced one",
+                    )
+                elif param not in static:
+                    continue  # dicts/f-strings are fine as pytree args
+                elif isinstance(arg, ast.Dict):
+                    yield ctx.finding(
+                        CHECK, arg,
+                        f"dict literal bound to static '{param}' of "
+                        f"jitted '{name}' — unhashable static argument",
+                    )
+                elif isinstance(arg, ast.JoinedStr):
+                    yield ctx.finding(
+                        CHECK, arg,
+                        f"f-string bound to static '{param}' of jitted "
+                        f"'{name}' — every distinct expansion is a new "
+                        "compile-cache entry",
+                    )

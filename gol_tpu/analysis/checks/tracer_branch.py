@@ -1,0 +1,42 @@
+"""tracer-branch — Python control flow on traced values.
+
+An `if`/`while` whose condition mentions a traced (non-static) argument
+of a jitted function raises ConcretizationTypeError at trace time — or,
+when the value happens to be weakly-typed, silently bakes one branch
+into the compiled program. Data-dependent branching belongs in
+`lax.cond`/`lax.select`/`jnp.where`; Python branching is only legal on
+static arguments, which the check exempts via static_argnames.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from gol_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    dynamic_names,
+    traced_params,
+)
+
+CHECK = "tracer-branch"
+
+
+def run(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        info = ctx.jit_context(node)
+        if info is None:
+            continue
+        traced = traced_params(info)
+        hit = sorted(dynamic_names(node.test) & traced)
+        if hit:
+            kind = "if" if isinstance(node, ast.If) else "while"
+            yield ctx.finding(
+                CHECK, node,
+                f"Python '{kind}' on traced value '{hit[0]}' inside "
+                f"'{info.qualname}' — use lax.cond/jnp.where, or mark "
+                "the argument static",
+            )

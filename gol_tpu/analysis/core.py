@@ -1,0 +1,332 @@
+"""Linter core — findings, jit-context discovery, allowlist, file walk.
+
+Everything here is pure `ast` + stdlib on purpose: the linter must run
+(and fail usefully) on a machine where jax, the native board, or the
+package under analysis cannot even import. Checks live in
+`gol_tpu/analysis/checks/`; each module exposes
+
+    CHECK = "kebab-name"        # finding category
+    def run(ctx: ModuleContext) -> Iterator[Finding]
+
+and registers itself in `checks.ALL_CHECKS`.
+
+Allowlist keys are (check, path, scope) — scope is the enclosing
+function's dotted qualname (or "<module>") — NOT line numbers, so an
+unrelated edit above a grandfathered finding cannot silently retire or
+orphan its entry. The flip side: one entry covers every same-check
+finding in that function, which is the granularity reasons are written
+at anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+#: Decorator/callable spellings that put a function body under trace.
+_JIT_NAMES = {"jit", "pjit"}
+#: Callables whose function-argument runs traced even without a jit
+#: decorator (scan bodies, shard_map inner fns, vmapped fns).
+_TRACING_CALLERS = {"scan", "shard_map", "vmap", "pmap", "fori_loop",
+                    "while_loop", "checkpoint", "remat"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One hazard the linter found."""
+
+    check: str    #: category, e.g. "host-sync"
+    path: str     #: repo-relative posix path
+    line: int
+    scope: str    #: enclosing function qualname, or "<module>"
+    message: str
+
+    @property
+    def key(self) -> tuple:
+        """Allowlist identity — line-number free (see module docstring)."""
+        return (self.check, self.path, self.scope)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.check}] {self.message}"
+                f"  (scope: {self.scope})")
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """One function whose body runs under trace."""
+
+    node: ast.AST                 # FunctionDef / Lambda
+    qualname: str
+    static_names: Set[str]        # params excluded from tracing
+    reason: str                   # "jax.jit decorator", "lax.scan body", ...
+
+
+class ModuleContext:
+    """Parsed module + the derived maps every check needs."""
+
+    def __init__(self, path: pathlib.Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel  # repo-relative posix path used in findings
+        self.source = source
+        self.tree = ast.parse(source, filename=str(path))
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self._qualnames = self._build_qualnames()
+        self.jitted: Dict[ast.AST, JitInfo] = {}
+        self._find_jitted()
+
+    # -- structure helpers -------------------------------------------------
+
+    def _build_qualnames(self) -> Dict[ast.AST, str]:
+        names: Dict[ast.AST, str] = {}
+
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    q = f"{prefix}.{child.name}" if prefix else child.name
+                    names[child] = q
+                    visit(child, q)
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+        return names
+
+    def qualname(self, node: ast.AST) -> str:
+        return self._qualnames.get(node, "<module>")
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Dotted qualname of the innermost enclosing function/class."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if cur in self._qualnames:
+                return self._qualnames[cur]
+            cur = self.parents.get(cur)
+        return "<module>"
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def finding(self, check: str, node: ast.AST, message: str) -> Finding:
+        return Finding(check, self.rel, getattr(node, "lineno", 0),
+                       self.scope_of(node), message)
+
+    # -- jit-context discovery --------------------------------------------
+
+    def jit_context(self, node: ast.AST) -> Optional[JitInfo]:
+        """The JitInfo whose body `node` sits in, walking out through
+        nested defs — an inner helper of a jitted function is traced
+        too, UNLESS an inner def is itself the jit boundary."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if cur in self.jitted:
+                return self.jitted[cur]
+            cur = self.parents.get(cur)
+        return None
+
+    def _find_jitted(self) -> None:
+        # Pass 1: decorated defs.
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    static = self._jit_static_names(dec)
+                    if static is not None:
+                        self.jitted[node] = JitInfo(
+                            node, self.qualname(node), static,
+                            "jit decorator",
+                        )
+                        break
+        # Pass 2: functions handed to tracing callers — jax.jit(f),
+        # lax.scan(body, ...), shard_map(f, ...). Map names defined in
+        # the same module to their defs.
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node, q in self._qualnames.items():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _tail_name(node.func)
+            if callee in _JIT_NAMES:
+                static = _static_names_from_call(node)
+                self._mark_callable_arg(node, defs_by_name, static,
+                                        "jax.jit call")
+            elif callee in _TRACING_CALLERS:
+                self._mark_callable_arg(node, defs_by_name, set(),
+                                        f"{callee} body")
+
+    def _mark_callable_arg(self, call: ast.Call, defs_by_name, static,
+                           reason: str) -> None:
+        if not call.args:
+            return
+        fn = call.args[0]
+        target: Optional[ast.AST] = None
+        if isinstance(fn, ast.Lambda):
+            target = fn
+        elif isinstance(fn, ast.Name):
+            cands = defs_by_name.get(fn.id, [])
+            if len(cands) == 1:
+                target = cands[0]
+        if target is not None and target not in self.jitted:
+            self.jitted[target] = JitInfo(
+                target, self.qualname(target)
+                if not isinstance(target, ast.Lambda) else
+                f"{self.scope_of(target)}.<lambda>",
+                static, reason,
+            )
+
+    def _jit_static_names(self, dec: ast.AST) -> Optional[Set[str]]:
+        """Static param names if `dec` is a jit-ish decorator, else None.
+
+        Recognized: `jax.jit`, `jit`, `pjit`, and
+        `functools.partial(jax.jit, static_argnames=(...))`."""
+        if _tail_name(dec) in _JIT_NAMES:
+            return set()
+        if isinstance(dec, ast.Call):
+            head = _tail_name(dec.func)
+            if head in _JIT_NAMES:
+                return _static_names_from_call(dec)
+            if head == "partial" and dec.args \
+                    and _tail_name(dec.args[0]) in _JIT_NAMES:
+                return _static_names_from_call(dec)
+        return None
+
+
+def _tail_name(node: ast.AST) -> Optional[str]:
+    """'jax.jit' -> 'jit', 'jit' -> 'jit', anything else -> None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+#: Array attributes that are STATIC under trace — reading (or branching
+#: on) them is how kernels legally specialize, never a host sync.
+STATIC_ATTRS = {"dtype", "shape", "ndim", "size", "sharding", "weak_type"}
+
+
+def traced_params(info: JitInfo) -> Set[str]:
+    """Parameter names of a jit-context function that are traced values
+    (everything not named static) — FunctionDef and Lambda alike."""
+    args = info.node.args
+    names = {a.arg for a in [*args.posonlyargs, *args.args,
+                             *args.kwonlyargs]}
+    return names - info.static_names
+
+
+def dynamic_names(expr: ast.AST) -> Set[str]:
+    """Names mentioned in `expr` other than as the base of a static
+    metadata attribute: `w.shape[0]` mentions no dynamic name, `w + 1`
+    mentions `w`. The shared vocabulary of the host-sync and
+    tracer-branch checks — both must agree that static metadata reads
+    are free."""
+    exempt = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name):
+                    exempt.add(sub)
+    return {
+        n.id for n in ast.walk(expr)
+        if isinstance(n, ast.Name) and n not in exempt
+    }
+
+
+def _static_names_from_call(call: ast.Call) -> Set[str]:
+    """static_argnames constants of a jit/partial(jit) call."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.add(el.value)
+    return out
+
+
+# -- allowlist ------------------------------------------------------------
+
+
+class AllowlistError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class AllowEntry:
+    check: str
+    path: str
+    scope: str
+    reason: str
+    lineno: int  # in the allowlist file, for diagnostics
+
+    @property
+    def key(self) -> tuple:
+        return (self.check, self.path, self.scope)
+
+
+class Allowlist:
+    """Grandfathered findings, one `check | path | scope | reason` line
+    each. Every entry MUST carry a non-empty reason — an allowlist
+    entry is a documented engineering decision, not a mute button."""
+
+    def __init__(self, entries: Sequence[AllowEntry] = ()):
+        self.entries = list(entries)
+        self._by_key = {e.key: e for e in self.entries}
+
+    @classmethod
+    def load(cls, path: pathlib.Path) -> "Allowlist":
+        entries = []
+        for i, raw in enumerate(path.read_text().splitlines(), 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = [p.strip() for p in line.split("|")]
+            if len(parts) != 4 or not all(parts):
+                raise AllowlistError(
+                    f"{path}:{i}: expected 'check | path | scope | reason'"
+                    f" with all four fields non-empty, got {raw!r}"
+                )
+            entries.append(AllowEntry(*parts, lineno=i))
+        return cls(entries)
+
+    def allows(self, finding: Finding) -> bool:
+        return finding.key in self._by_key
+
+    def stale(self, findings: Iterable[Finding],
+              scanned: Optional[Set[str]] = None) -> List[AllowEntry]:
+        """Entries matching no current finding — fixed hazards whose
+        entry must now be deleted (the shrink-only contract). With
+        `scanned` (the rel paths this run actually linted), entries for
+        files OUTSIDE the scan are exempt: a partial-tree run can only
+        prove staleness for files it looked at."""
+        live = {f.key for f in findings}
+        return [e for e in self.entries
+                if e.key not in live
+                and (scanned is None or e.path in scanned)]
+
+
+# -- file walk (the run loop itself lives in jaxlint.py) ------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".venv"}
+
+
+def iter_py_files(paths: Sequence[pathlib.Path],
+                  root: pathlib.Path) -> Iterator[pathlib.Path]:
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in f.parts):
+                    yield f

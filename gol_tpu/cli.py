@@ -84,6 +84,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "makes the 10^10-turn default run finish). "
                          "Only active on headless fused runs: pass "
                          "-noVis, and detach any live controller")
+    ap.add_argument("--check-invariants", action="store_true",
+                    dest="check_invariants",
+                    help="assert distributed-protocol invariants at "
+                         "runtime (event-stream ordering, dispatch "
+                         "linearity — gol_tpu.analysis.invariants); "
+                         "cheap host-side identity checks, also "
+                         "switchable via GOL_TPU_CHECK_INVARIANTS=1")
     ap.add_argument("--platform", default=None, metavar="NAME",
                     help="force a jax platform (e.g. cpu, tpu); some "
                          "site configs pin the platform so the "
@@ -137,6 +144,13 @@ def _stdin_keys(keypresses: queue.Queue, stop: threading.Event) -> None:
 
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.check_invariants:
+        # Env-var form on purpose: multi-host worker processes and
+        # spawned tools inherit the opt-in with the environment.
+        from gol_tpu.analysis.invariants import enable
+
+        enable()
 
     if args.platform:
         import jax

@@ -37,6 +37,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -105,6 +106,14 @@ class _Conn:
         # a controller's first message is always the board state, never a
         # TurnComplete it has no context for.
         self.synced = False
+        #: Turn of the BoardSync this peer last received. Buffered flips
+        #: for any turn <= this are ALREADY IN the synced board — the
+        #: broadcaster must not flush them to this peer, or an XOR
+        #: consumer double-applies them (ADVICE r5 #1: the multi-peer
+        #: rewrite dropped the old 'flips = []' reset, and a global
+        #: reset would be wrong now anyway — OTHER synced peers are
+        #: still owed those flips).
+        self.synced_turn = -1
         self._lock = threading.Lock()
         # Outbound frames ride a bounded per-connection queue drained
         # by this connection's OWN writer thread (started at attach):
@@ -163,17 +172,29 @@ class _Conn:
     def send_raw(self, payload: bytes) -> None:
         self._enqueue(payload)
 
+    def request_finish(self) -> None:
+        """Enqueue the writer's exit sentinel without waiting — the
+        writer drains everything already queued (including a farewell)
+        and then exits. Pair with `join_writer`; `_drain_conns` fans
+        the sentinels out to every peer FIRST so wedged writers drain
+        concurrently instead of serializing shutdown."""
+        if self._writer is None:
+            return
+        with contextlib.suppress(queue.Full):
+            self._out.put_nowait(None)
+
+    def join_writer(self, timeout: float) -> None:
+        if self._writer is not None:
+            self._writer.join(timeout)
+
     def finish(self, timeout: float = 30.0) -> None:
         """Flush the outbound queue (writer drains everything already
         enqueued — including a farewell — then exits on the sentinel)
         before the caller closes the socket. A direct farewell would
         OVERTAKE queued stream events (the client stops at bye/detached,
         losing its FinalTurnComplete)."""
-        if self._writer is None:
-            return
-        with contextlib.suppress(queue.Full):
-            self._out.put_nowait(None)
-        self._writer.join(timeout)
+        self.request_finish()
+        self.join_writer(timeout)
 
     def close(self) -> None:
         self._dead.set()
@@ -252,10 +273,19 @@ class EngineServer:
         self.engine.join(timeout=60)
         self.done.set()
 
+    #: Per-peer writer-drain budget at teardown. Writers drain
+    #: CONCURRENTLY (every sentinel is enqueued before any join), so
+    #: run-end with a driver plus several wedged observers costs at
+    #: most ~this once, not 30s per stuck peer (ADVICE r5 #3).
+    DRAIN_TIMEOUT = 5.0
+
     def _drain_conns(self) -> None:
         """Collect-and-clear every attached connection under the lock,
         then farewell + close each — the one teardown used by
-        shutdown() and the broadcast epilogue."""
+        shutdown() and the broadcast epilogue. Phase 1 enqueues every
+        peer's farewell and exit sentinel (non-blocking); phase 2 joins
+        the writers, which have all been draining in parallel since
+        phase 1, with a short per-peer timeout."""
         with self._conn_lock:
             conns = list(self._observers)
             if self._conn is not None:
@@ -265,7 +295,10 @@ class EngineServer:
         for conn in conns:
             with contextlib.suppress(Exception):
                 conn.send({"t": "bye"})
-            conn.finish()
+            conn.request_finish()
+        deadline = time.monotonic() + self.DRAIN_TIMEOUT
+        for conn in conns:
+            conn.join_writer(max(0.1, deadline - time.monotonic()))
             conn.close()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -485,10 +518,37 @@ class EngineServer:
         — from a FlipBatch array directly (the engine's vectorized
         form) or by batching a CellFlipped burst (engines injected with
         the per-cell contract)."""
+        # Opt-in stream monitor (gol_tpu.analysis.invariants): asserts
+        # the orderings this loop RELIES on — FlipBatch/TurnComplete
+        # adjacency, no flips straddling a BoardSync, monotone turns —
+        # so an engine emission change breaks a test instead of
+        # XOR-corrupting an attached peer.
+        from gol_tpu.analysis.invariants import (
+            EventStreamChecker,
+            invariants_enabled,
+        )
+
+        checker = (EventStreamChecker("server-broadcast")
+                   if invariants_enabled() else None)
+        try:
+            self._broadcast_events(checker)
+        except Exception:
+            # A violated invariant (or any broadcaster bug) must not
+            # leave a zombie server: full teardown, then let the
+            # exception surface in the thread log.
+            self.shutdown()
+            raise
+        # Engine stream closed: the run is over (final turn, 'k', or stop).
+        self._drain_conns()
+        self.shutdown(stop_engine=False)
+
+    def _broadcast_events(self, checker) -> None:
         flips: "list | object" = []
         flips_levels = None  # (N,) gray levels of a multi-state batch
         flips_turn = 0
         for ev in self.engine.events:
+            if checker is not None:
+                checker.observe(ev)
             conns = self._all_conns()
             if isinstance(ev, FlipBatch):
                 if len(ev.cells) and any(c.want_flips for c in conns):
@@ -538,6 +598,14 @@ class EngineServer:
                             ev.completed_turns, ev.world, ev.token
                         ))
                     target.synced = True
+                    # The synced board already contains every flip up
+                    # to its turn: record it so a flush of flips
+                    # buffered BEFORE this sync skips this peer (other
+                    # peers are still owed them). Today the engine
+                    # never emits a BoardSync between a FlipBatch and
+                    # its TurnComplete — the checker above asserts that
+                    # — but the broadcaster no longer depends on it.
+                    target.synced_turn = ev.completed_turns
                 except (wire.WireError, OSError):
                     self._detach(target)
                 continue
@@ -546,7 +614,8 @@ class EngineServer:
                 if not conn.synced:
                     continue  # pre-sync events are not this peer's
                 try:
-                    if flush and conn.want_flips:
+                    if flush and conn.want_flips \
+                            and flips_turn > conn.synced_turn:
                         self._send_flips(conn, flips_turn, flips,
                                          flips_levels)
                     self._send_stream_event(conn, ev)
@@ -555,6 +624,3 @@ class EngineServer:
             if flush:
                 flips = []
                 flips_levels = None
-        # Engine stream closed: the run is over (final turn, 'k', or stop).
-        self._drain_conns()
-        self.shutdown(stop_engine=False)

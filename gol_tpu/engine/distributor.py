@@ -742,9 +742,14 @@ class Engine:
             rows = self._decode_sparse(pending)
             if rows is None:  # truncated: the board burst past the cap
                 self._sparse_cap = None
-                new_world, diffs, count = self.stepper.step_n_with_diffs(
-                    pending["world_before"], k
-                )
+                # The EXPLICIT redo entry when the stepper has one
+                # (mirrored steppers broadcast a dedicated opcode so
+                # workers re-step from their saved pre-sparse state —
+                # never inferred from object identity); plain steppers
+                # redo through the ordinary dense scan.
+                redo = (self.stepper.step_n_with_diffs_redo
+                        or self.stepper.step_n_with_diffs)
+                new_world, diffs, count = redo(pending["world_before"], k)
                 # (bit-identical to the discarded sparse result)
         if rows is None:
             if pending["sparse_cap"] is None:

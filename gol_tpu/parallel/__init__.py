@@ -1,3 +1,36 @@
-from gol_tpu.parallel.stepper import Stepper, make_stepper
+import jax
+
+# Version shim: the ring steppers call `jax.shard_map`, which only
+# exists as a top-level alias in newer jax releases; on older ones the
+# same callable (kwarg-compatible for the mesh/in_specs/out_specs form
+# every call site here uses) lives in jax.experimental.shard_map.
+# Installing the alias once at package import keeps every call site on
+# the forward spelling. Every parallel submodule import routes through
+# this package, so the alias is in place before any stepper builds.
+if not hasattr(jax, "shard_map"):  # pragma: no cover - version-dependent
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def _shard_map_compat(*args, **kwargs):
+        # The replica-consistency check was renamed check_rep ->
+        # check_vma when shard_map was promoted out of experimental.
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+    jax.shard_map = _shard_map_compat
+
+if not hasattr(jax.lax, "axis_size"):  # pragma: no cover - version-dependent
+    def _axis_size(axis_name):
+        # psum of a Python scalar over a named axis is evaluated at
+        # trace time to a concrete int — the documented pre-axis_size
+        # spelling of "how many shards on this axis".
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size
+
+from gol_tpu.parallel.stepper import Stepper, make_stepper  # noqa: E402
 
 __all__ = ["Stepper", "make_stepper"]

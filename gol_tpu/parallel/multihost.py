@@ -212,14 +212,21 @@ def spmd_stepper(inner):
     def put(world):
         _bcast_cmd(_OP_PUT)
         host = _bcast(np.asarray(world, np.uint8))
+        _sparse_consumed()  # a fresh world abandons any outstanding redo
         return inner.put(host)
 
     def step(world):
         _bcast_cmd(_OP_STEP)
+        # A fused dispatch consumes the current world, sparse-produced
+        # or not: the outstanding record is spent (a detach switches
+        # the engine to this path mid-run; keeping the token would
+        # false-flag the first diffs dispatch after reattach).
+        _sparse_consumed()
         return inner.step(world)
 
     def step_n(world, k):
         _bcast_cmd(_OP_STEP_N, int(k))
+        _sparse_consumed()
         return inner.step_n(world, int(k))
 
     def step_with_diff(world):
@@ -238,35 +245,82 @@ def spmd_stepper(inner):
         return inner.fetch(arr)
 
     # The one legal NON-linear dispatch: after a sparse-overflow, the
-    # engine redoes the chunk densely FROM THE SPARSE CALL'S INPUT
-    # (distributor._diff_consume). Workers replay against their own
-    # state refs, so that redo must be its own opcode telling them to
-    # step from the state they saved before the sparse dispatch —
+    # engine redoes the chunk densely FROM THE SPARSE CALL'S INPUT —
+    # through the EXPLICIT `step_n_with_diffs_redo` entry (the engine
+    # prefers it whenever a stepper offers one). Workers replay against
+    # their own state refs, so the redo is its own opcode telling them
+    # to step from the state they saved before the sparse dispatch —
     # replaying it as a plain _OP_STEP_N_DIFFS would mix coordinator
-    # pre-chunk state with worker post-chunk state and silently
-    # diverge the ring. Detected by handle identity: the engine hands
-    # the redo exactly the array object it gave the sparse call.
-    _sparse_in = {"world": None}
+    # pre-chunk state with worker post-chunk state and silently diverge
+    # the ring. `_sparse_in` tracks the outstanding sparse dispatch's
+    # (input, output) pair: the redo asserts it re-steps the exact
+    # input, a dense call asserts it continues from the exact output,
+    # and anything else raises BEFORE a divergent opcode is broadcast
+    # (ADVICE r5 #2 — identity inference replaced by a checked token).
+    # Entries are cleared as soon as the sparse dispatch is consumed,
+    # which also stops the dict pinning the pre-sparse device buffer.
+    _sparse_in = {"in": None, "out": None}
+
+    def _sparse_consumed():
+        _sparse_in["in"] = _sparse_in["out"] = None
 
     step_n_with_diffs = None
     if inner.step_n_with_diffs is not None:
         def step_n_with_diffs(world, k):
-            if world is not None and world is _sparse_in["world"]:
-                _bcast_cmd(_OP_STEP_N_DIFFS_REDO, int(k))
-            else:
-                _bcast_cmd(_OP_STEP_N_DIFFS, int(k))
-            _sparse_in["world"] = None
+            if _sparse_in["in"] is not None:
+                if world is _sparse_in["in"]:
+                    raise RuntimeError(
+                        "sparse-overflow redo routed through the plain "
+                        "dense entry — the engine must call "
+                        "step_n_with_diffs_redo so workers replay from "
+                        "their saved pre-sparse state"
+                    )
+                if world is not _sparse_in["out"]:
+                    raise RuntimeError(
+                        "dense diffs dispatch on an unrecognized world "
+                        "while a sparse dispatch is outstanding — "
+                        "broadcasting it would silently diverge the "
+                        "ring (workers would step from post-sparse "
+                        "state, the coordinator from something else)"
+                    )
+                _sparse_consumed()
+            _bcast_cmd(_OP_STEP_N_DIFFS, int(k))
             return inner.step_n_with_diffs(world, int(k))
+
+    step_n_with_diffs_redo = None
+    if inner.step_n_with_diffs is not None:
+        def step_n_with_diffs_redo(world, k):
+            if _sparse_in["in"] is None:
+                raise RuntimeError(
+                    "sparse-overflow redo with no sparse dispatch "
+                    "outstanding"
+                )
+            if world is not _sparse_in["in"]:
+                raise RuntimeError(
+                    "sparse-overflow redo must re-step the sparse "
+                    "dispatch's exact input world"
+                )
+            _sparse_consumed()
+            _bcast_cmd(_OP_STEP_N_DIFFS_REDO, int(k))
+            inner_redo = inner.step_n_with_diffs_redo or inner.step_n_with_diffs
+            return inner_redo(world, int(k))
 
     step_n_with_diffs_sparse = None
     if inner.step_n_with_diffs_sparse is not None:
         def step_n_with_diffs_sparse(world, k, cap):
+            if _sparse_in["in"] is not None \
+                    and world is not _sparse_in["out"]:
+                raise RuntimeError(
+                    "sparse diffs dispatch on an unrecognized world "
+                    "while another sparse dispatch is outstanding"
+                )
             # Both static arguments ride the opcode so every process
             # compiles the identical sparse scan (a cap mismatch would
             # be a divergent program and a silent deadlock).
-            _sparse_in["world"] = world
             _bcast_cmd(_OP_STEP_N_DIFFS_SPARSE, int(k), int(cap))
-            return inner.step_n_with_diffs_sparse(world, int(k), int(cap))
+            out = inner.step_n_with_diffs_sparse(world, int(k), int(cap))
+            _sparse_in["in"], _sparse_in["out"] = world, out[0]
+            return out
 
     fetch_diffs = None
     if inner.step_n_with_diffs is not None:
@@ -290,6 +344,7 @@ def spmd_stepper(inner):
         # unmirrored (the generations family's alive-vs-dying split).
         alive_mask=inner.alive_mask,
         step_n_with_diffs=step_n_with_diffs,
+        step_n_with_diffs_redo=step_n_with_diffs_redo,
         fetch_diffs=fetch_diffs,
         packed_diffs=inner.packed_diffs,
         step_n_with_diffs_sparse=step_n_with_diffs_sparse,
@@ -309,14 +364,21 @@ def spmd_worker_loop(inner, height: int, width: int) -> None:
         if op == _OP_PUT:
             host = _bcast(np.zeros((height, width), np.uint8))
             state = inner.put(host)
+            pre_sparse = None
         elif op == _OP_STEP:
             state = inner.step(state)
+            pre_sparse = None  # mirror the coordinator: token spent
         elif op == _OP_STEP_N:
             state, _ = inner.step_n(state, arg)
+            pre_sparse = None
         elif op == _OP_DIFF:
             state, mask, _ = inner.step_with_diff(state)
         elif op == _OP_STEP_N_DIFFS:
             state, diffs, _ = inner.step_n_with_diffs(state, arg)
+            # A dense dispatch means the outstanding sparse chunk (if
+            # any) was consumed fine — drop the saved pre-sparse state
+            # so it stops pinning a whole board on device.
+            pre_sparse = None
         elif op == _OP_STEP_N_DIFFS_SPARSE:
             # The sparse rows are replicated; the coordinator reads its
             # local copy, workers just co-execute the scan. The rows go
@@ -328,9 +390,18 @@ def spmd_worker_loop(inner, height: int, width: int) -> None:
                 state, arg, arg2
             )
         elif op == _OP_STEP_N_DIFFS_REDO:
-            # Sparse-overflow redo: the coordinator re-steps the chunk
-            # densely from the sparse call's input (see spmd_stepper).
+            # Sparse-overflow redo: the coordinator broadcast the
+            # DEDICATED redo opcode (never inferred from identity), so
+            # step from the state saved before the sparse dispatch —
+            # then drop the save (one redo per sparse, by contract).
+            if pre_sparse is None:
+                raise RuntimeError(
+                    "sparse-overflow redo opcode with no sparse "
+                    "dispatch outstanding — coordinator/worker "
+                    "dispatch streams have diverged"
+                )
             state, diffs, _ = inner.step_n_with_diffs(pre_sparse, arg)
+            pre_sparse = None
         elif op == _OP_COUNT:
             inner.alive_count_async(state)
         elif op == _OP_FETCH_WORLD:

@@ -91,6 +91,16 @@ class Stepper:
     #: process can materialize them without a collective), and the
     #: SPMD mirror (r5; VERDICT r4 Missing #2).
     step_n_with_diffs_sparse: Optional[Callable] = None
+    #: (world, k) -> (world, diffs, count): the EXPLICIT sparse-overflow
+    #: redo — same signature and result as `step_n_with_diffs`, but the
+    #: contract is different: `world` must be the exact input of the
+    #: immediately preceding sparse call whose rows came back truncated.
+    #: The engine prefers this entry for redos so mirrored steppers can
+    #: broadcast a dedicated redo opcode instead of guessing from object
+    #: identity (a guess that would silently diverge the ring if the
+    #: dispatch pattern ever changed — ADVICE r5 #2). None = redo rides
+    #: plain `step_n_with_diffs` (single-process steppers don't care).
+    step_n_with_diffs_redo: Optional[Callable] = None
 
     def alive_count(self, world) -> int:
         return int(self.alive_count_async(world))
@@ -545,6 +555,26 @@ def _gens_stepper_packed(rule: GenRule, device, height: int,
 
 
 def make_stepper(
+    threads: int = 1,
+    height: int = 512,
+    width: int = 512,
+    rule: Rule | str = LIFE,
+    devices: Optional[list] = None,
+    backend: str = "auto",
+) -> Stepper:
+    """Build the best stepper for the request, wrapped with the runtime
+    dispatch-linearity checker when GOL_TPU_CHECK_INVARIANTS=1 (cli
+    --check-invariants; gol_tpu.analysis.invariants) — host-side
+    identity checks only, so the opt-in costs nothing on device."""
+    s = _make_stepper(threads, height, width, rule, devices, backend)
+    from gol_tpu.analysis.invariants import checked_stepper, invariants_enabled
+
+    if invariants_enabled():
+        s = checked_stepper(s)
+    return s
+
+
+def _make_stepper(
     threads: int = 1,
     height: int = 512,
     width: int = 512,

@@ -360,6 +360,13 @@ class NumpyLevelBoard:
     def set_level(self, x: int, y: int, level: int) -> None:
         if not (0 <= x < self.width and 0 <= y < self.height):
             raise IndexError("pixel out of range")
+        if not (0 <= int(level) <= 255):
+            # Same error contract as NativeLevelBoard, whose C core
+            # returns -1 for an out-of-range level exactly as for an
+            # out-of-range pixel — without this the variants diverge
+            # (numpy raises OverflowError, or silently wraps on older
+            # releases).
+            raise IndexError(f"level {level} out of range 0..255")
         self._px[y, x] = level
 
     def get_level(self, x: int, y: int) -> int:

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Analysis gate: the JAX-hazard linter in allowlist mode, shrink-only.
+#
+# Passes only when (a) every finding in the repo is either fixed or
+# covered by gol_tpu/analysis/allowlist.txt (each entry carries a
+# reason), AND (b) no allowlist entry is stale — a fixed hazard must
+# take its entry with it. Net effect: the finding count can only go
+# down. Run locally before pushing; tests/test_analysis.py runs the
+# same gate in tier-1.
+#
+# Usage: scripts/check_analysis.sh [extra paths...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if python -m gol_tpu.analysis --strict "$@"; then
+    echo "analysis gate: clean (all findings fixed or allowlisted)"
+else
+    rc=$?
+    echo >&2
+    echo "analysis gate: FAILED." >&2
+    echo "  - new findings: fix them (preferred), or add an" >&2
+    echo "    'check | path | scope | reason' line to" >&2
+    echo "    gol_tpu/analysis/allowlist.txt with a real reason." >&2
+    echo "  - stale entries: the finding is gone — delete its line." >&2
+    exit "$rc"
+fi

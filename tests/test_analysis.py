@@ -1,0 +1,473 @@
+"""gol_tpu.analysis tests — the linter (5+ hazard classes on synthetic
+bad code, repo-clean-under-allowlist as the tier-1 CI gate) and the
+runtime invariant checker (misordered/stale event streams rejected,
+dispatch-linearity + explicit sparse-redo token enforced, clean runs
+pass untouched)."""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from gol_tpu.analysis import (
+    Allowlist,
+    EventStreamChecker,
+    InvariantViolation,
+    lint_paths,
+)
+from gol_tpu.analysis.core import AllowlistError
+from gol_tpu.analysis.invariants import (
+    DispatchLinearityChecker,
+    checked_stepper,
+)
+from gol_tpu.events import BoardSync, CellFlipped, FlipBatch, TurnComplete
+from gol_tpu.utils.cell import Cell
+
+
+def _lint_snippet(tmp_path, code, name="mod.py", subdir=""):
+    d = tmp_path if not subdir else tmp_path / subdir
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / name
+    f.write_text(textwrap.dedent(code))
+    return lint_paths([f], tmp_path)
+
+
+def _checks(findings):
+    return {f.check for f in findings}
+
+
+# --- static linter: one synthetic detection per hazard class ---
+
+
+def test_detects_host_sync_item_and_asarray(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return x.item()
+
+        @jax.jit
+        def g(x):
+            return np.asarray(x) + 1
+    """)
+    assert [f.check for f in findings] == ["host-sync", "host-sync"]
+    assert "f" in findings[0].scope and "g" in findings[1].scope
+
+
+def test_detects_host_sync_scalarization_of_traced_value(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def f(x, k):
+            h = int(x.shape[0])       # static metadata read: fine
+            return float(x) + int(k) + h  # int(k) is static: fine
+    """)
+    assert len(findings) == 1 and findings[0].check == "host-sync"
+    assert "float" in findings[0].message
+
+
+def test_detects_tracer_branch_not_static_or_dtype(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            if n > 2:                      # static: fine
+                x = x + 1
+            if x.dtype == jnp.uint32:      # static metadata: fine
+                x = x + 1
+            while x > 0:                   # tracer: flagged
+                x = x - 1
+            return x
+    """)
+    assert [f.check for f in findings] == ["tracer-branch"]
+    assert "'while'" in findings[0].message
+
+
+def test_detects_recompile_hazards(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("k", "mode"))
+        def step(x, k):
+            return x
+
+        def hot(xs, tree):
+            for x in xs:
+                f = jax.jit(lambda v: v + 1)
+                f(x)
+            step(xs, {"cap": 1})        # dict on STATIC k: flagged
+            return step({"w": xs}, 2)   # dict on traced x: pytree, fine
+    """)
+    msgs = [f.message for f in findings if f.check == "recompile"]
+    assert len(msgs) == 3
+    assert any("'mode'" in m for m in msgs)          # static name drift
+    assert any("inside a loop" in m for m in msgs)   # jit per iteration
+    assert any("dict literal bound to static 'k'" in m for m in msgs)
+
+
+def test_detects_dtype_drift_in_kernel_module(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def kernel(x):
+            y = jnp.zeros((4, 4), jnp.float32)
+            return x.astype("int16") + y.astype(jnp.uint32)
+    """, name="bitkernels.py")
+    assert [f.check for f in findings] == ["dtype-drift", "dtype-drift"]
+    # The same code outside a kernel-named module is not kernel code.
+    assert _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def chart(x):
+            return jnp.zeros((4, 4), jnp.float32)
+    """, name="plotting.py") == []
+
+
+def test_detects_missing_donation_on_ring_stepper(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def step_n(world, k):
+            return world, 0
+    """, name="ring.py", subdir="parallel")
+    assert [f.check for f in findings] == ["donation"]
+    # donate_argnums present -> explicit decision made, no finding.
+    assert _lint_snippet(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("k",),
+                           donate_argnums=(0,))
+        def step_n(world, k):
+            return world, 0
+    """, name="ring2.py", subdir="parallel") == []
+
+
+def test_lint_reports_unparseable_file(tmp_path):
+    findings = _lint_snippet(tmp_path, "def broken(:\n", name="bad.py")
+    assert [f.check for f in findings] == ["parse-error"]
+
+
+# --- allowlist machinery + the tier-1 repo gate ---
+
+
+def test_allowlist_requires_reason(tmp_path):
+    f = tmp_path / "allow.txt"
+    f.write_text("host-sync | a.py | fn |\n")
+    with pytest.raises(AllowlistError):
+        Allowlist.load(f)
+
+
+def test_allowlist_match_and_stale(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """)
+    al = tmp_path / "allow.txt"
+    al.write_text(
+        "host-sync | mod.py | f | known, measured, fine\n"
+        "donation | gone.py | g.step_n | fixed long ago\n"
+    )
+    allow = Allowlist.load(al)
+    assert all(allow.allows(f) for f in findings)
+    stale = allow.stale(findings)
+    assert [e.path for e in stale] == ["gone.py"]
+
+
+def test_repo_is_clean_under_allowlist():
+    """THE CI gate: `python -m gol_tpu.analysis --strict` on the repo —
+    every finding fixed or allowlisted with a reason, no stale
+    entries. A new JAX hazard anywhere in gol_tpu/ fails this test."""
+    from gol_tpu.analysis.__main__ import main
+
+    assert main(["--strict"]) == 0
+
+
+def test_strict_on_path_subset_spares_unscanned_entries():
+    """A partial-tree strict run (scripts/check_analysis.sh 'extra
+    paths' form) can only prove staleness for files it scanned — the
+    repo's own allowlist entries for OTHER files must not fail it."""
+    import pathlib
+
+    import gol_tpu
+    from gol_tpu.analysis.__main__ import main
+
+    pkg = pathlib.Path(gol_tpu.__file__).resolve().parent
+    assert main(["--strict", str(pkg / "cli.py")]) == 0
+
+
+def test_strict_flags_stale_allowlist_entries(tmp_path):
+    from gol_tpu.analysis.__main__ import main
+
+    src = tmp_path / "clean.py"
+    src.write_text("x = 1\n")
+    al = tmp_path / "allow.txt"
+    al.write_text("host-sync | clean.py | f | no longer true\n")
+    args = [str(src), "--allowlist", str(al), "--root", str(tmp_path)]
+    assert main(args) == 0            # lenient: stale tolerated
+    assert main(args + ["--strict"]) == 1  # CI: shrink-only enforced
+
+
+# --- runtime invariant checker: event streams ---
+
+
+def _batch(turn, n=1):
+    return FlipBatch(turn, np.zeros((n, 2), np.int32))
+
+
+def test_stream_checker_accepts_reference_stream():
+    c = EventStreamChecker()
+    c.observe(_batch(0, 5))            # initial alive burst, no TC owed
+    for t in range(1, 6):
+        c.observe(_batch(t))
+        c.observe(TurnComplete(t))
+    c.observe(BoardSync(5, None, 1))   # attach sync at the boundary
+    c.observe(_batch(6))
+    c.observe(TurnComplete(6))
+    assert c.observed == 14
+
+
+def test_stream_checker_rejects_flipbatch_after_boardsync():
+    """ADVICE #1's corruption mode, injected: flips for a turn the sync
+    already contains would be XOR double-applied by the synced peer."""
+    c = EventStreamChecker()
+    c.observe(BoardSync(5, None, 1))
+    with pytest.raises(InvariantViolation, match="already in the synced"):
+        c.observe(_batch(5))
+
+
+def test_stream_checker_rejects_flips_straddling_a_sync():
+    c = EventStreamChecker()
+    c.observe(TurnComplete(2))
+    c.observe(_batch(3))
+    with pytest.raises(InvariantViolation, match="straddle"):
+        c.observe(BoardSync(3, None, 1))
+
+
+def test_stream_checker_rejects_broken_adjacency():
+    c = EventStreamChecker()
+    c.observe(TurnComplete(2))
+    c.observe(_batch(3))
+    with pytest.raises(InvariantViolation, match="adjacency"):
+        c.observe(TurnComplete(4))
+
+
+def test_stream_checker_rejects_stale_turn():
+    c = EventStreamChecker()
+    c.observe(TurnComplete(5))
+    c.observe(TurnComplete(7))
+    with pytest.raises(InvariantViolation, match="non-monotone"):
+        c.observe(TurnComplete(6))
+
+
+def test_stream_checker_rejects_stale_per_cell_flip():
+    c = EventStreamChecker()
+    c.observe(CellFlipped(1, Cell(0, 0)))
+    c.observe(TurnComplete(1))
+    with pytest.raises(InvariantViolation, match="stale"):
+        c.observe(CellFlipped(1, Cell(1, 1)))
+
+
+# --- runtime invariant checker: dispatch linearity + sparse redo ---
+
+
+def test_dispatch_checker_accepts_linear_and_pipelined_chains():
+    c = DispatchLinearityChecker()
+    w0, w1, w2, w3 = (object() for _ in range(4))
+    c.put(w0)
+    c.dispatch(w0, w1, "step_n")
+    c.sparse(w1, w2)
+    c.sparse(w2, w3)       # pipelined: second chunk before first's redo
+    c.redo(w1)             # older chunk truncated: redo from ITS input
+    c.redo(w2)
+
+
+def test_dispatch_checker_rejects_foreign_world():
+    c = DispatchLinearityChecker()
+    w0, w1 = object(), object()
+    c.put(w0)
+    c.dispatch(w0, w1, "step_n")
+    with pytest.raises(InvariantViolation, match="divergent ring"):
+        c.dispatch(object(), None, "step_n")
+
+
+def test_dispatch_checker_allows_stale_cap_double_redo():
+    """The pipelined burst pattern distributor._diff_dispatch documents:
+    chunk N+1 was dispatched with the stale cap before chunk N's
+    truncation was discovered, so BOTH redo — with chunk N+2's forward
+    dispatch interleaved between the two redos. Redos must not age the
+    second chunk's window."""
+    c = DispatchLinearityChecker()
+    w0, o0, o1, o2 = (object() for _ in range(4))
+    c.put(w0)
+    c.sparse(w0, o0)       # chunk N
+    c.sparse(o0, o1)       # chunk N+1 (stale cap)
+    c.redo(w0)             # consume N: truncated
+    c.dispatch(o1, o2, "step_n_with_diffs")  # forward dispatch N+2
+    c.redo(o0)             # consume N+1: truncated too — still legal
+
+
+def test_dispatch_checker_retires_consumed_sparse_pairs():
+    """A redo window closes two dispatches after the sparse call: by
+    then the engine has provably consumed the chunk, so a late 'redo'
+    would double-step committed turns — rejected, not certified."""
+    c = DispatchLinearityChecker()
+    w0, o0, o1, o2 = (object() for _ in range(4))
+    c.put(w0)
+    c.sparse(w0, o0)
+    c.dispatch(o0, o1, "step_n_with_diffs")   # chunk consumed fine
+    c.dispatch(o1, o2, "step_n_with_diffs")
+    with pytest.raises(InvariantViolation, match="no sparse"):
+        c.redo(w0)
+
+
+def test_dispatch_checker_does_not_pin_worlds():
+    """The checker observes the dispatch chain through weakrefs: it
+    must never keep board-sized buffers alive that the engine has
+    already released (the opt-in is advertised as device-cost-free)."""
+    import gc
+    import weakref
+
+    class World:  # np arrays aren't weakref-able; device arrays are
+        pass
+
+    c = DispatchLinearityChecker()
+    w0, w1 = World(), World()
+    c.put(w0)
+    c.dispatch(w0, w1, "step_n")
+    ref0, ref1 = weakref.ref(w0), weakref.ref(w1)
+    del w0, w1
+    gc.collect()
+    assert ref0() is None and ref1() is None
+
+
+def test_dispatch_checker_rejects_bad_redo():
+    c = DispatchLinearityChecker()
+    w0, w1 = object(), object()
+    c.put(w0)
+    with pytest.raises(InvariantViolation, match="no sparse"):
+        c.redo(w0)
+    c.sparse(w0, w1)
+    with pytest.raises(InvariantViolation, match="exact"):
+        c.redo(w1)
+
+
+def _dummy_stepper():
+    """Host-only Stepper whose dispatches return fresh arrays — enough
+    to exercise wrapper plumbing without a device."""
+    from gol_tpu.parallel.stepper import Stepper
+
+    return Stepper(
+        name="dummy", shards=1,
+        put=lambda w: np.asarray(w, np.uint8),
+        fetch=np.asarray,
+        step=lambda w: w + 0,
+        step_n=lambda w, k: (w + 0, 0),
+        step_with_diff=lambda w: (w + 0, w != w, 0),
+        alive_count_async=lambda w: 0,
+        step_n_with_diffs=lambda w, k: (w + 0, "dense", 0),
+        step_n_with_diffs_sparse=lambda w, k, cap: (w + 0, "sparse", 0),
+    )
+
+
+def test_checked_stepper_enforces_redo_contract():
+    s = checked_stepper(_dummy_stepper())
+    w0 = s.put(np.zeros((4, 4)))
+    w1, _, _ = s.step_n_with_diffs_sparse(w0, 4, 16)
+    with pytest.raises(InvariantViolation):
+        s.step_n_with_diffs_redo(w1, 4)  # redo must consume w0, not w1
+    s2 = checked_stepper(_dummy_stepper())
+    w0 = s2.put(np.zeros((4, 4)))
+    w1, _, _ = s2.step_n_with_diffs_sparse(w0, 4, 16)
+    out, _, _ = s2.step_n_with_diffs_redo(w0, 4)
+    s2.step_n_with_diffs(out, 4)  # chain continues from the redo result
+
+
+def test_spmd_stepper_redo_token(monkeypatch):
+    """The ADVICE #2 fix: the SPMD mirror's sparse-overflow redo is an
+    explicit, validated entry point — a dense diffs dispatch on an
+    unrecognized world while a sparse input is outstanding raises
+    instead of silently broadcasting a divergent opcode, and the
+    outstanding record is cleared on consume."""
+    from gol_tpu.parallel import multihost
+
+    sent = []
+    monkeypatch.setattr(multihost, "_bcast_cmd",
+                        lambda op, arg=0, arg2=0: sent.append(op)
+                        or (op, arg, arg2))
+    s = multihost.spmd_stepper(_dummy_stepper())
+    w0 = np.zeros((4, 4), np.uint8)
+    w1, _, _ = s.step_n_with_diffs_sparse(w0, 4, 16)
+
+    # Routing a redo through the plain dense entry is the exact
+    # identity-guessing this fix removes.
+    with pytest.raises(RuntimeError, match="redo routed"):
+        s.step_n_with_diffs(w0, 4)
+    # A world that is neither the sparse input nor its output would
+    # silently diverge the ring.
+    with pytest.raises(RuntimeError, match="unrecognized world"):
+        s.step_n_with_diffs(np.zeros((4, 4), np.uint8), 4)
+    # Redo from anything but the sparse call's exact input is invalid.
+    with pytest.raises(RuntimeError, match="exact input"):
+        s.step_n_with_diffs_redo(w1, 4)
+
+    out, _, _ = s.step_n_with_diffs_redo(w0, 4)  # the legal redo
+    assert sent[-1] == multihost._OP_STEP_N_DIFFS_REDO
+    with pytest.raises(RuntimeError, match="no sparse"):
+        s.step_n_with_diffs_redo(w0, 4)  # cleared after consume
+
+    # Success path: dense continuation from the sparse OUTPUT clears
+    # the outstanding record too.
+    w2, _, _ = s.step_n_with_diffs_sparse(out, 4, 16)
+    s.step_n_with_diffs(w2, 4)
+    assert sent[-1] == multihost._OP_STEP_N_DIFFS
+
+    # A fused interlude (controller detach -> step_n path -> reattach)
+    # spends the token: the first diffs dispatch on the fused result
+    # must NOT be flagged as an unrecognized world.
+    w3, _, _ = s.step_n_with_diffs_sparse(w2 + 0, 4, 16)
+    w4, _ = s.step_n(w3, 8)
+    s.step_n_with_diffs(w4 + 0, 4)  # fresh object: token must be spent
+    with pytest.raises(RuntimeError, match="no sparse"):
+        s.step_n_with_diffs_redo(w3, 4)  # and the redo window closed
+
+
+# --- end-to-end: a real engine run under the checker stays clean ---
+
+
+def test_engine_run_passes_invariant_checks(golden_root, tmp_path,
+                                            monkeypatch):
+    """A watched engine run with GOL_TPU_CHECK_INVARIANTS=1 builds a
+    checked stepper (dispatch linearity incl. the diff path) and an
+    event stream a strict EventStreamChecker accepts end to end."""
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.engine.distributor import Engine, EventQueue
+    from gol_tpu.params import Params
+
+    p = Params(turns=12, threads=2, image_width=64, image_height=64,
+               chunk=3, tick_seconds=60.0,
+               image_dir=str(golden_root / "images"),
+               out_dir=str(tmp_path / "out"))
+    engine = Engine(p, events=EventQueue(), emit_flips=True,
+                    emit_flip_batches=True)
+    assert engine.stepper.name.startswith("checked-")
+    checker = EventStreamChecker("test-consumer")
+    engine.start()
+    for ev in engine.events:
+        checker.observe(ev)
+    engine.join(120)
+    assert engine.error is None
+    assert checker.observed > 12

@@ -193,6 +193,27 @@ def test_level_board_ops(cls):
         b.destroy()
 
 
+@pytest.mark.parametrize("cls", _level_boards())
+def test_level_board_set_level_range_parity(cls):
+    """Both level-board variants reject an out-of-range level the same
+    way (the native C core returns -1 exactly as for a bad pixel, so
+    IndexError is the shared contract) — and reject it WITHOUT
+    mutating the cell (ADVICE r5 #4: the numpy variant used to raise
+    OverflowError or silently wrap, depending on numpy version)."""
+    b = cls(4, 4)
+    try:
+        b.set_level(1, 1, 255)
+        for bad in (-1, 256, 1000):
+            with pytest.raises(IndexError):
+                b.set_level(1, 1, bad)
+        assert b.get_level(1, 1) == 255
+        b.set_level(1, 1, 0)   # boundary values stay legal
+        b.set_level(1, 1, 170)
+        assert b.get_level(1, 1) == 170
+    finally:
+        b.destroy()
+
+
 def test_gens_gray_level_loop(golden_root):
     """The r5 gray-level visual contract (the VERDICT r4 Missing #3
     carve-out, closed): a Brian's Brain engine run drives a level-mode
