@@ -19,12 +19,17 @@ to <4% — and the correctness gate checks the alive count of the first
 10,000-turn dispatch against the reference's `check/alive/512x512.csv`
 (its full extent).
 
-Prints exactly ONE JSON line:
+Prints exactly ONE JSON line to STDOUT:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 and writes every secondary measurement (device rates per board size,
 the 4096² tiled-kernel rate, the measured link latency, backend names)
 to BENCH_DETAIL.json so README perf claims are machine-captured
-(VERDICT r1, Weak #5).
+(VERDICT r1, Weak #5). The gol_tpu.obs registry accumulated across the
+whole run — per-entry stepper dispatch counts/latency, halo traffic,
+engine cadence, wire/client series from the watched-path measurements —
+lands in BENCH_DETAIL.json under "metrics" (full snapshot + a per-phase
+dispatch/halo/host breakdown), and the per-phase line goes to STDERR as
+`BENCH_METRICS {...}` so the stdout contract stays one line.
 """
 
 from __future__ import annotations
@@ -543,6 +548,46 @@ def measure_wire_watched(binary: bool = True) -> dict:
             "link_bytes_per_turn": round(nbytes / turns, 1)}
 
 
+def metrics_capture() -> dict:
+    """The gol_tpu.obs registry as a BENCH_DETAIL payload: the full
+    snapshot plus a compact per-phase breakdown — device dispatch vs
+    ring-halo traffic vs host decode/fan-out — so the perf trajectory
+    records WHERE the time went, not just one throughput scalar."""
+    from gol_tpu import obs
+
+    snap = obs.registry().snapshot()
+    phases = {
+        "stepper_dispatches": 0, "stepper_dispatch_s": 0.0,
+        "engine_dispatches": 0, "engine_turns": 0,
+        "engine_dispatch_s": 0.0, "engine_host_s": 0.0,
+        "halo_exchanges": 0, "halo_bytes": 0, "halo_dispatch_s": 0.0,
+    }
+    for key, m in snap.items():
+        v = m["value"]
+        if key.startswith("gol_tpu_stepper_dispatches_total"):
+            phases["stepper_dispatches"] += int(v)
+        elif key.startswith("gol_tpu_stepper_dispatch_seconds"):
+            phases["stepper_dispatch_s"] += v["sum"]
+        elif key.startswith("gol_tpu_engine_dispatches_total"):
+            phases["engine_dispatches"] += int(v)
+        elif key.startswith("gol_tpu_engine_turns_total"):
+            phases["engine_turns"] += int(v)
+        elif key.startswith("gol_tpu_engine_dispatch_seconds"):
+            phases["engine_dispatch_s"] += v["sum"]
+        elif key.startswith("gol_tpu_engine_host_seconds"):
+            phases["engine_host_s"] += v["sum"]
+        elif key.startswith("gol_tpu_halo_exchanges_total"):
+            phases["halo_exchanges"] += int(v)
+        elif key.startswith("gol_tpu_halo_bytes_total"):
+            phases["halo_bytes"] += int(v)
+        elif key.startswith("gol_tpu_halo_dispatch_seconds"):
+            phases["halo_dispatch_s"] += v["sum"]
+    for k in list(phases):
+        if isinstance(phases[k], float):
+            phases[k] = round(phases[k], 4)
+    return {"phases": phases, "snapshot": snap}
+
+
 def expected_alive() -> int | None:
     csv = _golden(f"check/alive/{W}x{H}.csv")
     if csv is None:
@@ -709,6 +754,15 @@ def main() -> None:
     # --json) merge their results into BENCH_DETAIL under their own
     # keys; carry them forward across this rewrite so one file holds
     # the whole capture the docs cite.
+    # Observability capture (gol_tpu.obs): everything the instrumented
+    # layers accumulated across this whole run, with the per-phase
+    # dispatch/halo/host breakdown on stderr (stdout stays one line).
+    try:
+        detail["metrics"] = metrics_capture()
+        print("BENCH_METRICS " + json.dumps(detail["metrics"]["phases"]),
+              file=sys.stderr)
+    except Exception as e:
+        detail["metrics"] = {"error": repr(e)}
     bd_path = REPO / "BENCH_DETAIL.json"
     if bd_path.exists():
         with contextlib.suppress(Exception):

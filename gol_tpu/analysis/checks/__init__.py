@@ -4,12 +4,14 @@ from gol_tpu.analysis.checks import (
     donation,
     dtype_drift,
     host_sync,
+    obs_in_jit,
     recompile,
     tracer_branch,
 )
 
 #: Every check the CLI and the tier-1 test run, in report order.
-ALL_CHECKS = [host_sync, tracer_branch, recompile, dtype_drift, donation]
+ALL_CHECKS = [host_sync, tracer_branch, recompile, dtype_drift, donation,
+              obs_in_jit]
 
 __all__ = ["ALL_CHECKS", "donation", "dtype_drift", "host_sync",
-           "recompile", "tracer_branch"]
+           "obs_in_jit", "recompile", "tracer_branch"]

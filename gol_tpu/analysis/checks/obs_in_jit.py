@@ -1,0 +1,128 @@
+"""obs-in-jit — metrics calls inside traced functions.
+
+The gol_tpu.obs contract is explicit: instrumentation is HOST-SIDE, at
+dispatch/event granularity, never inside a jit/pallas trace. A metric
+call under trace would either be baked in as a once-per-compile no-op
+(silently recording nothing per step — the worst kind of broken
+observability) or force a host callback per traced op. This check makes
+the contract machine-enforced: any call that reaches the registry —
+through the `obs` module object, a name imported from `gol_tpu.obs`, or
+a module-level metric handle assigned from one — is flagged when it
+sits in a jit context (decorated defs, scan/shard_map/fori_loop bodies,
+jitted lambdas — the same discovery every other check uses).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from gol_tpu.analysis.core import Finding, ModuleContext
+
+CHECK = "obs-in-jit"
+
+#: Metric mutation/construction method names — used only to flag calls
+#: on HANDLES we traced back to an obs binding, so plain `.inc()` on an
+#: unrelated object never fires.
+_OBS_MODULES = ("gol_tpu.obs", "gol_tpu.obs.registry", "gol_tpu.obs.http")
+
+
+def _target_roots(tgt: ast.AST) -> Iterator[str]:
+    """Root names an assignment target binds/mutates: `x` -> x,
+    `x[k] = ...` / `x.attr = ...` -> x, tuple targets recurse. `self`/
+    `cls` attribute targets are EXCLUDED — an instance holding a metric
+    handle is handled at class granularity (see _obs_bound_names), and
+    tainting the literal name 'self' would flag every `self.anything()`
+    call in the module's traced methods (a verified false positive)."""
+    if isinstance(tgt, ast.Name):
+        yield tgt.id
+    elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+        root = _root_name(tgt)
+        if root is not None and root not in ("self", "cls"):
+            yield root
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _target_roots(elt)
+
+
+def _obs_bound_names(ctx: ModuleContext) -> Set[str]:
+    """Names this module binds to gol_tpu.obs or to things derived from
+    it: the module alias itself, `from gol_tpu.obs import X` names,
+    classes whose bodies touch an obs root (handle containers like the
+    `_EngineMetrics` pattern — their constructors and instances carry
+    metric handles), and assignment targets whose value expression is
+    rooted at any of those (`_M = obs.counter(...)`,
+    `_METRICS = _EngineMetrics()`, dict-fills of handles)."""
+    roots: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in _OBS_MODULES:
+                    # `import gol_tpu.obs` binds `gol_tpu`;
+                    # `import gol_tpu.obs as obs` binds the alias.
+                    roots.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod in _OBS_MODULES:
+                for a in node.names:
+                    roots.add(a.asname or a.name)
+            elif mod == "gol_tpu":
+                for a in node.names:
+                    if a.name == "obs":
+                        roots.add(a.asname or "obs")
+    if not roots:
+        return roots
+    # Propagate until fixed point: classes whose body touches an obs
+    # root become roots themselves (instances are handle containers),
+    # and assignment targets inherit rootness from their value.
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                if node.name not in roots and _mentions(node, roots):
+                    roots.add(node.name)
+                    changed = True
+            elif isinstance(node, ast.Assign):
+                if not _mentions(node.value, roots):
+                    continue
+                for tgt in node.targets:
+                    for name in _target_roots(tgt):
+                        if name not in roots:
+                            roots.add(name)
+                            changed = True
+    return roots
+
+
+def _mentions(expr: ast.AST, names: Set[str]) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id in names for n in ast.walk(expr)
+    )
+
+
+def _root_name(node: ast.AST):
+    """Leftmost Name of a dotted/subscripted access chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def run(ctx: ModuleContext) -> Iterator[Finding]:
+    roots = _obs_bound_names(ctx)
+    if not roots:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        info = ctx.jit_context(node)
+        if info is None:
+            continue
+        root = _root_name(node.func)
+        if root in roots:
+            yield ctx.finding(
+                CHECK, node,
+                f"metrics call rooted at obs-bound name '{root}' inside "
+                f"traced '{info.qualname}' — instrumentation must stay "
+                "host-side (a traced metric records once per COMPILE, "
+                "not per step)",
+            )

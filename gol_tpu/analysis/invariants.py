@@ -26,8 +26,15 @@ Violations raise `InvariantViolation` (an AssertionError subclass, so
 plain `pytest.raises(AssertionError)` and `assert`-oriented tooling see
 them) with a message naming the event/dispatch and both turns involved.
 
-This module imports neither jax nor the engine: it must be importable
-from the linter CLI and from worker processes at zero cost.
+Every violation ALSO increments `gol_tpu_invariant_violations_total`
+(labelled by checker) in the process-global metrics registry
+(gol_tpu.obs) before raising — so a live `/metrics` endpoint shows a
+violation even when the raising thread's traceback only lands in a log,
+and `tests/test_distributed.py` fails loudly on any nonzero delta.
+
+This module imports neither jax nor the engine (gol_tpu.obs is pure
+stdlib): it must be importable from the linter CLI and from worker
+processes at zero cost.
 """
 
 from __future__ import annotations
@@ -37,6 +44,8 @@ import weakref
 from collections import deque
 from typing import Optional
 
+from gol_tpu import obs
+
 __all__ = [
     "DispatchLinearityChecker",
     "EventStreamChecker",
@@ -44,7 +53,23 @@ __all__ = [
     "checked_stepper",
     "enable",
     "invariants_enabled",
+    "violations_total",
 ]
+
+_VIOLATIONS = {
+    kind: obs.counter(
+        "gol_tpu_invariant_violations_total",
+        "Distributed-protocol invariant violations observed at runtime",
+        {"checker": kind},
+    ) for kind in ("event-stream", "dispatch-linearity")
+}
+
+
+def violations_total() -> int:
+    """Total runtime invariant violations this process has observed —
+    the number that must stay 0 across any healthy run (tests assert
+    the per-test delta)."""
+    return int(sum(c.value for c in _VIOLATIONS.values()))
 
 
 class InvariantViolation(AssertionError):
@@ -81,6 +106,7 @@ class EventStreamChecker:
         self.observed = 0
 
     def _fail(self, msg: str) -> None:
+        _VIOLATIONS["event-stream"].inc()
         raise InvariantViolation(f"[{self.source}] {msg}")
 
     def observe(self, ev) -> None:
@@ -216,6 +242,7 @@ class DispatchLinearityChecker:
         self._seq = 0
 
     def _fail(self, msg: str) -> None:
+        _VIOLATIONS["dispatch-linearity"].inc()
         raise InvariantViolation(f"[{self.name}] {msg}")
 
     def put(self, world) -> None:

@@ -84,6 +84,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "makes the 10^10-turn default run finish). "
                          "Only active on headless fused runs: pass "
                          "-noVis, and detach any live controller")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    dest="metrics_port", metavar="PORT",
+                    help="serve live observability on "
+                         "127.0.0.1:PORT — /metrics (Prometheus text), "
+                         "/vars (JSON snapshot), /healthz (liveness); "
+                         "0 picks an ephemeral port (printed). Works "
+                         "for local engines, --serve and --connect; "
+                         "see docs/OBSERVABILITY.md")
+    ap.add_argument("--metrics-host", default="127.0.0.1", metavar="HOST",
+                    help="bind address for --metrics-port (default "
+                         "loopback; non-loopback exposure should sit "
+                         "behind the same controls as --serve)")
     ap.add_argument("--check-invariants", action="store_true",
                     dest="check_invariants",
                     help="assert distributed-protocol invariants at "
@@ -128,6 +140,21 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--mh-id", type=int, default=None, metavar="I",
                     help="this process's id (0 = coordinator)")
     return ap
+
+
+def _start_metrics(args, health=None):
+    """Opt-in observability sidecar (gol_tpu.obs.http): serve the
+    process registry + a health probe whenever --metrics-port is given.
+    Returns the MetricsServer (caller closes it) or None."""
+    if args.metrics_port is None:
+        return None
+    from gol_tpu.obs.http import MetricsServer
+
+    srv = MetricsServer(args.metrics_host, args.metrics_port,
+                        health=health).start()
+    print(f"metrics serving on http://{srv.address[0]}:{srv.address[1]}"
+          "/metrics")
+    return srv
 
 
 def _stdin_keys(keypresses: queue.Queue, stop: threading.Event) -> None:
@@ -293,6 +320,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         engine = Engine(params, keypresses=keypresses,
                         emit_flips=not args.novis,
                         emit_flip_batches=not args.novis, **engine_kwargs)
+        # Sidecar BEFORE the engine thread: a failed port bind aborts a
+        # run that hasn't started anything needing cleanup yet.
+        metrics = _start_metrics(args, health=engine.health)
         engine.start()
         try:
             if args.novis:
@@ -309,6 +339,8 @@ def main(argv: Optional[list[str]] = None) -> int:
             keypresses.put("q")
         finally:
             engine.join(timeout=60)
+            if metrics is not None:
+                metrics.close()
 
         if engine.error is not None:
             print(f"engine error: {engine.error!r}", file=sys.stderr)
@@ -357,6 +389,11 @@ def _serve(args, params: Params, resume_path: Optional[str] = None) -> int:
     server = EngineServer(params, host, port, resume_from=resume_path,
                           secret=args.secret)
     print(f"engine serving on {server.address[0]}:{server.address[1]}")
+    # Sidecar BEFORE the engine/broadcast threads: a failed port bind
+    # aborts while nothing needing teardown is running (a bind failure
+    # after start would skip the shutdown path and strand multi-host
+    # workers waiting for their next opcode).
+    metrics = _start_metrics(args, health=server.health)
     server.start()
     try:
         while not server.wait(timeout=1.0):
@@ -367,6 +404,8 @@ def _serve(args, params: Params, resume_path: Optional[str] = None) -> int:
         from gol_tpu.parallel import multihost
 
         multihost.notify_stop()
+        if metrics is not None:
+            metrics.close()
     if server.engine.error is not None:
         print(f"engine error: {server.engine.error!r}", file=sys.stderr)
         return 1
@@ -391,6 +430,16 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
                      levels=vis_levels and not args.novis,
                      observe=args.observe)
 
+    def _ctl_health() -> dict:
+        return {
+            "status": "ok" if not ctl.events.closed else "detached",
+            "synced": ctl.synced.is_set(),
+            "sync_turn": ctl.sync_turn,
+            "detached": ctl.detached.is_set(),
+        }
+
+    metrics = None
+
     class _WireKeys:
         """queue.Queue-shaped sink that forwards verbs over the wire —
         lets the visualiser loop and the stdin pump share one path."""
@@ -413,6 +462,9 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
 
     threading.Thread(target=pump, name="gol-ctl-keys", daemon=True).start()
     try:
+        # Inside the try: a failed sidecar bind must still detach the
+        # controller (ctl.close() in the finally frees the driver slot).
+        metrics = _start_metrics(args, health=_ctl_health)
         if args.novis:
             for ev in ctl.events:
                 s = str(ev)
@@ -440,6 +492,8 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
         return 0
     finally:
         ctl.close()
+        if metrics is not None:
+            metrics.close()
 
 
 if __name__ == "__main__":

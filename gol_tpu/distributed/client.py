@@ -22,10 +22,39 @@ from typing import Optional
 
 import numpy as np
 
+from gol_tpu import obs
 from gol_tpu.distributed import wire
 from gol_tpu.engine.distributor import EventQueue
 from gol_tpu.events import CellFlipped, FlipBatch, TurnComplete
 from gol_tpu.utils.cell import cells_from_mask, xy_from_mask
+
+
+class _ClientMetrics:
+    """Registry handles for the controller plane (gol_tpu.obs): one
+    observation per wire message, host-side only. `turn_latency` is the
+    END-TO-END signal — broadcaster-enqueue (the server's `ts` stamp on
+    TurnComplete) to applied-on-this-client — the first cross-process
+    latency the system can see. Same-host pairs share a clock; across
+    hosts the number includes NTP skew (docs/OBSERVABILITY.md)."""
+
+    def __init__(self):
+        self.turn_latency = obs.histogram(
+            "gol_tpu_client_turn_latency_seconds",
+            "Server TurnComplete emit -> applied on this client",
+        )
+        self.apply_seconds = obs.histogram(
+            "gol_tpu_client_apply_seconds",
+            "Decode-and-apply seconds per server message",
+        )
+        self.messages = {
+            t: obs.counter(
+                "gol_tpu_client_messages_total",
+                "Server messages handled by kind", {"kind": t},
+            ) for t in ("board", "flips", "ev", "other")
+        }
+
+
+_METRICS = _ClientMetrics()
 
 
 class ServerBusyError(ConnectionError):
@@ -147,7 +176,24 @@ class Controller:
     # --- reader ---
 
     def _handle(self, msg: dict) -> bool:
-        """Apply one server message; False ends the stream."""
+        """Apply one server message; False ends the stream (metrics:
+        one counter + one apply-seconds observation per message, and
+        the emit→apply lag for stamped TurnCompletes)."""
+        t0 = time.perf_counter()
+        try:
+            return self._handle_inner(msg)
+        finally:
+            t = msg.get("t")
+            _METRICS.messages.get(t, _METRICS.messages["other"]).inc()
+            _METRICS.apply_seconds.observe(time.perf_counter() - t0)
+            if t == "ev" and msg.get("k") == "turn" and "ts" in msg:
+                # Clamped at 0: a sub-millisecond negative reading is
+                # clock granularity, not time travel.
+                _METRICS.turn_latency.observe(
+                    max(0.0, time.time() - float(msg["ts"]))
+                )
+
+    def _handle_inner(self, msg: dict) -> bool:
         t = msg.get("t")
         if t == "board":
             self.sync_turn, board = wire.msg_to_board(msg)

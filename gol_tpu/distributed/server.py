@@ -42,6 +42,7 @@ from typing import Optional
 
 import numpy as np
 
+from gol_tpu import obs
 from gol_tpu.checkpoint import snapshot_turn
 from gol_tpu.distributed import wire
 from gol_tpu.engine.distributor import Engine
@@ -58,6 +59,57 @@ from gol_tpu.params import Params
 __all__ = ["EngineServer", "snapshot_turn"]
 
 log = logging.getLogger(__name__)
+
+
+class _ServerMetrics:
+    """Registry handles for the serving plane (gol_tpu.obs) — resolved
+    once; all increments are host-side, per connection event or per
+    wire frame (never per cell). Catalog: docs/OBSERVABILITY.md."""
+
+    def __init__(self):
+        self.accepts = obs.counter(
+            "gol_tpu_server_accepts_total", "TCP connections accepted"
+        )
+        self.rejects = {
+            r: obs.counter(
+                "gol_tpu_server_rejects_total",
+                "Attaches rejected by reason", {"reason": r},
+            ) for r in ("bad-hello", "unauthorized", "busy")
+        }
+        self.attaches = {
+            r: obs.counter(
+                "gol_tpu_server_attaches_total",
+                "Peers attached by role", {"role": r},
+            ) for r in ("drive", "observe")
+        }
+        self.detaches = obs.counter(
+            "gol_tpu_server_detaches_total", "Peers detached (any cause)"
+        )
+        self.events = obs.counter(
+            "gol_tpu_server_broadcast_events_total",
+            "Engine events consumed by the broadcaster",
+        )
+        self.frames = obs.counter(
+            "gol_tpu_server_frames_total", "Wire frames enqueued to peers"
+        )
+        self.frame_bytes = obs.counter(
+            "gol_tpu_server_frame_bytes_total",
+            "Wire payload bytes enqueued to peers (pre-framing)",
+        )
+        self.queue_depth = obs.gauge(
+            "gol_tpu_server_writer_queue_depth",
+            "Deepest per-peer writer queue at the last flush",
+        )
+        self.overflows = obs.counter(
+            "gol_tpu_server_queue_overflows_total",
+            "Peers declared dead on writer-queue overflow",
+        )
+        self.peers = obs.gauge(
+            "gol_tpu_server_peers", "Currently attached peers"
+        )
+
+
+_METRICS = _ServerMetrics()
 
 
 class _Conn:
@@ -153,6 +205,8 @@ class _Conn:
     def _enqueue(self, payload: bytes) -> None:
         if self._dead.is_set():
             raise wire.WireError("peer is gone")
+        _METRICS.frames.inc()
+        _METRICS.frame_bytes.inc(len(payload))
         if self._writer is None:
             # Pre-attach (handshake replies): direct, no queue yet.
             with self._lock:
@@ -164,6 +218,7 @@ class _Conn:
             # The peer is QUEUE_DEPTH frames behind: declare it dead
             # without ever blocking the broadcaster.
             self._dead.set()
+            _METRICS.overflows.inc()
             raise wire.WireError("peer send queue overflow") from None
 
     def send(self, msg: dict) -> None:
@@ -304,6 +359,20 @@ class EngineServer:
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done.wait(timeout)
 
+    def health(self) -> dict:
+        """Liveness snapshot for /healthz: the engine's health plus the
+        serving plane (host-side state only — probe-hammer safe)."""
+        info = self.engine.health()
+        with self._conn_lock:
+            info["peers"] = len(self._observers) + (
+                1 if self._conn is not None else 0
+            )
+            info["driver_attached"] = self._conn is not None
+        info["address"] = list(self.address)
+        if self._shutdown.is_set() and info["status"] == "ok":
+            info["status"] = "shutting-down"
+        return info
+
     # --- accept path ---
 
     def _accept_loop(self) -> None:
@@ -312,6 +381,7 @@ class EngineServer:
                 sock, addr = self._listener.accept()
             except OSError:
                 return  # listener closed
+            _METRICS.accepts.inc()
             try:
                 # Control-only receive: an unauthenticated peer must
                 # never make the server inflate a bulk zlib payload.
@@ -320,6 +390,7 @@ class EngineServer:
                     raise wire.WireError(f"bad hello: {hello!r}")
             except (wire.WireError, OSError, ValueError) as e:
                 log.warning("rejecting connection from %s: %s", addr, e)
+                _METRICS.rejects["bad-hello"].inc()
                 sock.close()
                 continue
 
@@ -334,6 +405,7 @@ class EngineServer:
                 log.warning(
                     "rejecting unauthenticated attach from %s", addr
                 )
+                _METRICS.rejects["unauthorized"].inc()
                 with contextlib.suppress(Exception):
                     wire.send_msg(
                         sock, {"t": "error", "reason": "unauthorized"}
@@ -363,10 +435,13 @@ class EngineServer:
             if busy:
                 # One DRIVER at a time (the reference's controller is
                 # singular too, ref: README.md:201-207).
+                _METRICS.rejects["busy"].inc()
                 with contextlib.suppress(Exception):
                     wire.send_msg(sock, {"t": "error", "reason": "busy"})
                 sock.close()
                 continue
+            _METRICS.attaches[role].inc()
+            _METRICS.peers.set(self._peer_count())
 
             # Immediate ack: the controller's handshake timeout covers
             # the first reply, and the BoardSync only arrives once the
@@ -401,16 +476,29 @@ class EngineServer:
             enable_flips=conn.want_flips, token=conn.token
         )
 
+    def _peer_count(self) -> int:
+        with self._conn_lock:
+            return len(self._observers) + (1 if self._conn is not None else 0)
+
     def _release(self, conn: _Conn) -> None:
         """Free the connection's slot (driver or observer) without
         closing the socket, re-deriving the engine flags from whoever
         remains attached."""
+        removed = False
         with self._conn_lock:
             if self._conn is conn:
                 self._conn = None
+                removed = True
             elif conn in self._observers:
                 self._observers.remove(conn)
+                removed = True
             self._set_flags_locked()
+            remaining = len(self._observers) + (
+                1 if self._conn is not None else 0
+            )
+        if removed:  # idempotent under the detach/close double-call
+            _METRICS.detaches.inc()
+        _METRICS.peers.set(remaining)
 
     def _detach(self, conn: _Conn) -> None:
         self._release(conn)
@@ -505,11 +593,22 @@ class EngineServer:
                        "cells": np.asarray(flips).tolist()})
 
     def _send_stream_event(self, conn: _Conn, ev) -> None:
-        """One post-sync event in this connection's encoding."""
+        """One post-sync event in this connection's encoding.
+
+        TurnComplete messages carry a `ts` wall-clock stamp taken at
+        enqueue: the client measures emit→apply lag against it — the
+        first END-TO-END (cross-process) latency signal the system has
+        (gol_tpu_client_turn_latency_seconds). Peers that predate the
+        field ignore it (unknown JSON keys pass through); clocks are
+        shared on a same-host pair and NTP-close across hosts — skew
+        bounds are documented in docs/OBSERVABILITY.md."""
         if conn.binary and isinstance(ev, FinalTurnComplete):
             conn.send_raw(wire.final_to_frame(ev.completed_turns, ev.alive))
         else:
-            conn.send(wire.event_to_msg(ev))
+            msg = wire.event_to_msg(ev)
+            if isinstance(ev, TurnComplete):
+                msg["ts"] = time.time()
+            conn.send(msg)
 
     def _broadcast_loop(self) -> None:
         """Single consumer of the engine's event stream, fanning out to
@@ -549,6 +648,7 @@ class EngineServer:
         for ev in self.engine.events:
             if checker is not None:
                 checker.observe(ev)
+            _METRICS.events.inc()
             conns = self._all_conns()
             if isinstance(ev, FlipBatch):
                 if len(ev.cells) and any(c.want_flips for c in conns):
@@ -610,6 +710,14 @@ class EngineServer:
                     self._detach(target)
                 continue
             flush = len(flips) and isinstance(ev, TurnComplete)
+            if isinstance(ev, TurnComplete):
+                # Backpressure visibility: the deepest per-peer writer
+                # queue right now (one qsize sweep per turn, not per
+                # frame — a lagging peer shows up here long before its
+                # overflow detach).
+                _METRICS.queue_depth.set(
+                    max((c._out.qsize() for c in conns), default=0)
+                )
             for conn in conns:
                 if not conn.synced:
                     continue  # pre-sync events are not this peer's

@@ -40,6 +40,7 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from gol_tpu import obs
 from gol_tpu.engine.cycles import CycleDetector
 from gol_tpu.events import (
     AliveCellsCount,
@@ -105,6 +106,78 @@ try:
     threading._register_atexit(_stop_live_engines)
 except AttributeError:  # private API; fall back for exotic interpreters
     atexit.register(_stop_live_engines)
+
+
+class _EngineMetrics:
+    """Handles into the process-global registry, resolved once at
+    import (metric lookups are dict + lock; the hot loop must only pay
+    the `inc`). All instrumentation is per DISPATCH — never per turn,
+    never per cell, never inside a jitted program (the `obs-in-jit`
+    linter check pins that). Engines share these series: the registry
+    is process-global, like the reference's single event stream."""
+
+    def __init__(self):
+        kinds = ("chunk", "diff", "diffs")
+        self.dispatches = {
+            k: obs.counter(
+                "gol_tpu_engine_dispatches_total",
+                "Engine device dispatches by path kind",
+                {"kind": k},
+            ) for k in kinds
+        }
+        self.turns = {
+            k: obs.counter(
+                "gol_tpu_engine_turns_total",
+                "Turns committed by path kind",
+                {"kind": k},
+            ) for k in kinds
+        }
+        self.dispatch_seconds = {
+            k: obs.histogram(
+                "gol_tpu_engine_dispatch_seconds",
+                "Wall seconds per dispatch (diff paths: measured; "
+                "fused chunks: only when a Timeline realizes them)",
+                {"kind": k},
+            ) for k in kinds
+        }
+        self.host_seconds = obs.histogram(
+            "gol_tpu_engine_host_seconds",
+            "Host-side decode + event fan-out seconds per diff chunk",
+        )
+        self.committed_turn = obs.gauge(
+            "gol_tpu_engine_committed_turn", "Last committed turn"
+        )
+        self.alive_cells = obs.gauge(
+            "gol_tpu_engine_alive_cells",
+            "Alive cells at the last realised (turn, count) pair",
+        )
+        self.effective_chunk = obs.gauge(
+            "gol_tpu_engine_effective_chunk",
+            "Turns per fused dispatch actually in use",
+        )
+        self.queue_depth = obs.gauge(
+            "gol_tpu_engine_event_queue_depth",
+            "Approximate unconsumed events in the engine's queue",
+        )
+        self.sparse_chunks = obs.counter(
+            "gol_tpu_engine_sparse_chunks_total",
+            "Diff chunks shipped with the sparse encoding",
+        )
+        self.sparse_redos = obs.counter(
+            "gol_tpu_engine_sparse_redos_total",
+            "Sparse chunks redone densely after a cap overflow",
+        )
+        self.throttle_stalls = obs.counter(
+            "gol_tpu_engine_throttle_stalls_total",
+            "Times the engine entered the event-backpressure wait",
+        )
+        self.skipped_turns = obs.counter(
+            "gol_tpu_engine_skipped_turns_total",
+            "Turns collapsed by the exact cycle fast-forward",
+        )
+
+
+_METRICS = _EngineMetrics()
 
 
 class EventQueue:
@@ -328,6 +401,23 @@ class Engine:
     def completed_turns(self) -> int:
         return self._committed[0]
 
+    def health(self) -> dict:
+        """Liveness snapshot for /healthz (gol_tpu.obs.http): host-side
+        committed state only — safe from any thread, never touches the
+        device, cheap enough for a probe to hammer."""
+        turn, count = self._last_pair
+        return {
+            "status": "error" if self.error is not None else "ok",
+            "completed_turns": self.completed_turns,
+            "target_turns": self.p.turns,
+            "alive_cells": count,
+            "alive_cells_turn": turn,
+            "paused": self._paused,
+            "finished": self._finished.is_set(),
+            "effective_chunk": self.effective_chunk,
+            "error": repr(self.error) if self.error is not None else None,
+        }
+
     def alive_count_now(self, timeout: float = 5.0) -> tuple[int, int]:
         """(completed_turns, alive_count) of the last committed world —
         safe from any thread: posts a request the engine thread services
@@ -392,6 +482,7 @@ class Engine:
         # 5s watchdog (ref: count_test.go:30-38) — served from this pair
         # until the first dispatch commits.
         self._last_pair = (self.start_turn, int(np.count_nonzero(host_world)))
+        _METRICS.alive_cells.set(self._last_pair[1])
         ticker = threading.Thread(target=self._ticker, name="gol-ticker", daemon=True)
         ticker.start()
 
@@ -462,16 +553,18 @@ class Engine:
                         turn = self._run_diff_chunk(turn)
                     world = self._committed[1]
                     continue
-                tick = time.perf_counter() if self.timeline else 0.0
+                tick = time.perf_counter()
                 new_world, mask, count = self.stepper.step_with_diff(world)
                 turn += 1
                 host_mask = self.stepper.fetch(mask)
+                # fetch(mask) synced the dispatch: the span measures
+                # device time, not the host event fan-out below.
+                elapsed = time.perf_counter() - tick
+                _METRICS.dispatches["diff"].inc()
+                _METRICS.turns["diff"].inc()
+                _METRICS.dispatch_seconds["diff"].observe(elapsed)
                 if self.timeline:
-                    # fetch(mask) synced the dispatch: the span measures
-                    # device time, not the host event fan-out below.
-                    self.timeline.record(
-                        turn, 1, time.perf_counter() - tick, "diff"
-                    )
+                    self.timeline.record(turn, 1, elapsed, "diff")
                 self._emit_turn_flips(turn, host_mask)
                 world = new_world
                 self._commit(turn, world, count)
@@ -541,11 +634,18 @@ class Engine:
                     ))
                 tick = time.perf_counter() if self.timeline else 0.0
                 world, count = self.stepper.step_n(world, k)
+                _METRICS.dispatches["chunk"].inc()
+                _METRICS.turns["chunk"].inc(k)
+                _METRICS.effective_chunk.set(self.effective_chunk)
                 if self.timeline:
                     int(count)  # realize: spans measure true device time
-                    self.timeline.record(
-                        turn + k, k, time.perf_counter() - tick, "chunk"
-                    )
+                    elapsed = time.perf_counter() - tick
+                    # The fused path's histogram is fed only under a
+                    # Timeline: without the realization above, a wall
+                    # timing would measure the async enqueue, not the
+                    # dispatch (the observer tax stays opt-in).
+                    _METRICS.dispatch_seconds["chunk"].observe(elapsed)
+                    self.timeline.record(turn + k, k, elapsed, "chunk")
                 first = turn + 1
                 turn += k
                 self._commit(turn, world, count)
@@ -568,6 +668,7 @@ class Engine:
                         if skip:
                             turn += skip
                             self.skipped_turns = skip
+                            _METRICS.skipped_turns.inc(skip)
                             self._commit(turn, world, count)
                             self._autosave_turn = turn
                             # One jump per run: done observing.
@@ -585,6 +686,7 @@ class Engine:
 
         self._ticker_stop.set()
         self._last_pair = (turn, int(self._committed[2]))
+        _METRICS.alive_cells.set(self._last_pair[1])
         # Serve any sync request that arrived during the last dispatch
         # BEFORE the tail events are queued, so a just-attached
         # subscriber gets its BoardSync and then the final events instead
@@ -697,9 +799,10 @@ class Engine:
             # world of the in-flight chunk.
             world = self._pending_diffs["new_world"]
         pending = {"k": k, "world_before": world, "sparse_cap": None,
-                   "tick": time.perf_counter() if self.timeline else 0.0}
+                   "tick": time.perf_counter()}
         if self._sparse_cap is not None:
             pending["sparse_cap"] = self._sparse_cap
+            _METRICS.sparse_chunks.inc()
             new_world, buf, count = self.stepper.step_n_with_diffs_sparse(
                 world, k, self._sparse_cap
             )
@@ -742,6 +845,7 @@ class Engine:
             rows = self._decode_sparse(pending)
             if rows is None:  # truncated: the board burst past the cap
                 self._sparse_cap = None
+                _METRICS.sparse_redos.inc()
                 # The EXPLICIT redo entry when the stepper has one
                 # (mirrored steppers broadcast a dedicated opcode so
                 # workers re-step from their saved pre-sparse state —
@@ -757,14 +861,17 @@ class Engine:
             host_diffs = (self.stepper.fetch_diffs or np.asarray)(diffs)
             rows = [host_diffs[i] for i in range(k)]
             self._observe_diff_activity(rows)
+        # Pipelined spans overlap at dispatch time; clamping each
+        # span's start to the previous span's end keeps them
+        # disjoint so Timeline's busy_seconds <= wall invariant
+        # (and the spans-sum semantics) survive the overlap.
+        now = time.perf_counter()
+        start = max(pending["tick"], self._last_diff_span_end)
+        self._last_diff_span_end = now
+        _METRICS.dispatches["diffs"].inc()
+        _METRICS.turns["diffs"].inc(k)
+        _METRICS.dispatch_seconds["diffs"].observe(now - start)
         if self.timeline:
-            # Pipelined spans overlap at dispatch time; clamping each
-            # span's start to the previous span's end keeps them
-            # disjoint so Timeline's busy_seconds <= wall invariant
-            # (and the spans-sum semantics) survive the overlap.
-            now = time.perf_counter()
-            start = max(pending["tick"], self._last_diff_span_end)
-            self._last_diff_span_end = now
             self.timeline.record(turn + k, k, now - start, "diffs")
         self._commit(turn + k, new_world, count)
         # Sync requests must NOT be serviced while this chunk's rows
@@ -775,6 +882,7 @@ class Engine:
         # grid would be reseeded to a state the remaining rows then
         # wrongly re-age. _service_requests defers syncs while set.
         self._emitting = True
+        emit_tick = time.perf_counter()
         try:
             for i, row in enumerate(rows):
                 t = turn + 1 + i
@@ -788,6 +896,7 @@ class Engine:
                     self._throttle_events(t)
         finally:
             self._emitting = False
+            _METRICS.host_seconds.observe(time.perf_counter() - emit_tick)
         turn += k
         self._throttle_events()
         self._maybe_autosave(turn, new_world)
@@ -903,6 +1012,7 @@ class Engine:
 
     def _commit(self, turn: int, world, count) -> None:
         self._committed = (turn, world, count)
+        _METRICS.committed_turn.set(turn)
 
     def _service_requests(self) -> None:
         """Engine thread: answer all pending cross-thread requests by
@@ -923,6 +1033,7 @@ class Engine:
         turn, world, count = self._committed
         if count is not None:
             self._last_pair = (turn, int(count))
+            _METRICS.alive_cells.set(self._last_pair[1])
         for kind, ev, box in reqs:
             if kind == "sync":
                 if world is not None and not self._finished.is_set():
@@ -1034,13 +1145,18 @@ class Engine:
         if self._throttle_disabled:
             return
         at = self._committed[0] if turn is None else turn
+        _METRICS.queue_depth.set(self.events.qsize())
         stalled_since = None
+        throttled = False
         last_consumed = self.events.consumed
         while (
             self.events.qsize() > 10_000
             and self._stop_reason is None
             and not self.events.closed
         ):
+            if not throttled:
+                throttled = True
+                _METRICS.throttle_stalls.inc()
             self._service_requests()
             self._poll_keys(at)
             time.sleep(0.005)

@@ -1,0 +1,67 @@
+"""gol_tpu.obs — unified metrics: registry, exposition, HTTP sidecar.
+
+The metrics plane of the observability story (utils/trace.py is the
+trace plane): Counter / Gauge / Histogram in a process-global Registry
+(`gol_tpu.obs.registry`), exposed as Prometheus text and JSON, served
+live by `MetricsServer` (`gol_tpu.obs.http`, CLI `--metrics-port`).
+
+Instrumented layers and their series (catalog: docs/OBSERVABILITY.md):
+
+- engine dispatch cadence/chunking   engine/distributor.py  gol_tpu_engine_*
+- stepper dispatch + halo traffic    parallel/stepper.py    gol_tpu_stepper_*, gol_tpu_halo_*
+- server accept/broadcast/queues     distributed/server.py  gol_tpu_server_*
+- client decode/apply + turn latency distributed/client.py  gol_tpu_client_*
+- invariant violations               analysis/invariants.py gol_tpu_invariant_violations_total
+
+Ground rules (enforced by the `obs-in-jit` linter check): metrics are
+host-side and dispatch/event-granular — never inside a jit/pallas
+trace, never per cell. `GOL_TPU_METRICS=0` (or `set_enabled(False)`)
+turns the plane off behind a single flag check.
+
+Stdlib-only on purpose: `analysis.invariants` must stay importable from
+worker processes and the linter CLI with zero dependency cost, and it
+counts its violations here.
+"""
+
+from gol_tpu.obs.registry import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    atomic_write_text,
+    counter,
+    enabled,
+    exponential_buckets,
+    gauge,
+    histogram,
+    registry,
+    set_enabled,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsServer",
+    "REGISTRY",
+    "Registry",
+    "atomic_write_text",
+    "counter",
+    "enabled",
+    "exponential_buckets",
+    "gauge",
+    "histogram",
+    "registry",
+    "set_enabled",
+]
+
+
+def __getattr__(name):
+    # MetricsServer lazily, so importing gol_tpu.obs from invariants /
+    # worker processes never pulls http.server machinery it won't use.
+    if name == "MetricsServer":
+        from gol_tpu.obs.http import MetricsServer
+
+        return MetricsServer
+    raise AttributeError(name)

@@ -136,8 +136,30 @@ def halo_step_bits_uneven(
     return jnp.where(row_ids < real, new, jnp.zeros_like(new))
 
 
+def dense_ring_halo_cost(n: int, deep: int):
+    """Host-side ring-traffic accounting for a dense ring of `n`
+    shards with deep-halo depth `deep` — the `Stepper.halo_cost` hook
+    (pure arithmetic over the SAME block plan step_n compiles; bytes
+    are uint8 bit-rows, both directions, summed over all shards).
+    `per_turn=True` prices the scanned diff paths, which ppermute one
+    edge row per turn."""
+
+    def halo_cost(world, k, per_turn: bool = False) -> dict:
+        k = max(int(k), 0)
+        w = int(world.shape[-1])
+        if per_turn or deep < 2:
+            sends, rows = 2 * k, 2 * k
+        else:
+            blocks, rem = divmod(k, deep)
+            sends = 2 * (blocks + rem)
+            rows = 2 * (blocks * deep + rem)
+        return {"exchanges": sends * n, "bytes": rows * w * n}
+
+    return halo_cost
+
+
 def _ring_stepper(name: str, devices: list, step_n, put, fetch,
-                  fetch_diffs=None):
+                  fetch_diffs=None, halo_cost=None):
     """Common wiring of both dense ring builders: single-turn wrappers
     derived from `step_n`, the async count, CPU-mesh serialization, and
     the Stepper assembly — one definition, so the even (deep-halo) and
@@ -176,6 +198,7 @@ def _ring_stepper(name: str, devices: list, step_n, put, fetch,
         alive_count_async=lambda w: _sync(count(w)),
         step_n_with_diffs=lambda w, k: _sync(_snd(w, int(k))),
         fetch_diffs=fetch_diffs,
+        halo_cost=halo_cost,
     )
 
 
@@ -235,6 +258,7 @@ def sharded_stepper(rule: Rule, devices: list, height: int):
         put=lambda w: spmd_put(sharding, np.asarray(w, np.uint8)),
         fetch=spmd_fetch,
         fetch_diffs=spmd_fetch,
+        halo_cost=dense_ring_halo_cost(n, deep),
     )
 
 
@@ -363,4 +387,5 @@ def _sharded_stepper_uneven(rule: Rule, devices: list, height: int):
         )
 
     return _ring_stepper(f"halo-ring-uneven-{n}", devices, step_n, put,
-                         fetch, fetch_diffs)
+                         fetch, fetch_diffs,
+                         halo_cost=dense_ring_halo_cost(n, deep))

@@ -348,6 +348,9 @@ def spmd_stepper(inner):
         fetch_diffs=fetch_diffs,
         packed_diffs=inner.packed_diffs,
         step_n_with_diffs_sparse=step_n_with_diffs_sparse,
+        # Host-side traffic arithmetic, no dispatch — the mirrored ring
+        # runs the same block plan, so the inner accounting holds.
+        halo_cost=inner.halo_cost,
     )
 
 

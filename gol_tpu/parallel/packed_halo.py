@@ -214,6 +214,43 @@ def local_block_mode(strip_words: int, width: int, on_tpu: bool,
     return 1, "xla"
 
 
+def packed_ring_halo_cost(n: int, strip_words: int, on_tpu: bool,
+                          force_local_pallas: "bool | None",
+                          max_h: "int | None" = None):
+    """Host-side ring-traffic accounting for a packed ring — the
+    `Stepper.halo_cost` hook (gol_tpu.obs). Pure arithmetic over the
+    SAME (ghost depth, mode) block plan step_n compiles via
+    `local_block_mode`, so the priced collectives are the dispatched
+    ones; bytes are uint32 word-rows (4W per word-row per direction),
+    both directions, summed over all shards. `per_turn=True` prices
+    the scanned diff paths, which ppermute one edge word-row per
+    turn. Never touches the device and never runs under trace."""
+
+    def halo_cost(world, k, per_turn: bool = False) -> dict:
+        k = max(int(k), 0)
+        w = int(world.shape[-1])
+        if per_turn:
+            sends, word_rows = 2 * k, 2 * k
+        else:
+            h, mode = local_block_mode(
+                strip_words, w, on_tpu, force_local_pallas, max_h=max_h
+            )
+            big, k2 = divmod(k, WORD * h)
+            if mode == "xla":
+                mid, rem = divmod(k2, WORD)
+                part = 0
+            else:
+                # Pallas local blocks absorb the whole tail as ONE
+                # partial block at the full ghost depth.
+                mid, rem = 0, 0
+                part = 1 if k2 else 0
+            sends = 2 * (big + part + mid + rem)
+            word_rows = 2 * ((big + part) * h + mid + rem)
+        return {"exchanges": sends * n, "bytes": word_rows * w * 4 * n}
+
+    return halo_cost
+
+
 def packed_sharded_stepper(rule: Rule, devices: list, height: int,
                            force_local_pallas: bool | None = None):
     """Stepper whose world lives packed AND row-sharded: (H/32, W) uint32
@@ -374,6 +411,9 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
         packed_diffs=True,
         step_n_with_diffs_sparse=lambda p, k, cap: _sync(
             _snd_sparse(p, int(k), int(cap))
+        ),
+        halo_cost=packed_ring_halo_cost(
+            n, strip_words, on_tpu, force_local_pallas
         ),
     )
 
@@ -651,5 +691,8 @@ def packed_sharded_stepper_uneven(rule: Rule, devices: list, height: int,
         packed_diffs=True,
         step_n_with_diffs_sparse=lambda p, k, cap: _sync(
             _snd_sparse(p, int(k), int(cap))
+        ),
+        halo_cost=packed_ring_halo_cost(
+            n, Sw, on_tpu, force_local_pallas, max_h=floor_words
         ),
     )

@@ -101,6 +101,16 @@ class Stepper:
     #: dispatch pattern ever changed — ADVICE r5 #2). None = redo rides
     #: plain `step_n_with_diffs` (single-process steppers don't care).
     step_n_with_diffs_redo: Optional[Callable] = None
+    #: (world, k, per_turn) -> {"exchanges": int, "bytes": int}: HOST-
+    #: SIDE accounting of the ring traffic one k-turn dispatch of this
+    #: stepper generates — pure arithmetic over the same block plan the
+    #: jitted step_n compiles (deep blocks vs per-turn halos), never a
+    #: device call. `per_turn=True` prices the scanned diff paths,
+    #: which exchange every turn. None = no collectives (single-device
+    #: backends). Feeds gol_tpu_halo_* (gol_tpu.obs); the jitted
+    #: programs themselves stay untouched — the obs-in-jit linter check
+    #: enforces that metrics never enter a trace.
+    halo_cost: Optional[Callable] = None
 
     def alive_count(self, world) -> int:
         return int(self.alive_count_async(world))
@@ -554,6 +564,135 @@ def _gens_stepper_packed(rule: GenRule, device, height: int,
     )
 
 
+def instrument_stepper(s: Stepper) -> Stepper:
+    """Wrap a Stepper's dispatch entries with gol_tpu.obs counters and
+    wall-time histograms (dataclasses.replace, the checked_stepper
+    pattern). Everything here is host-side, per-DISPATCH bookkeeping:
+    the wrapped callables still receive and return the exact same
+    objects, so dispatch-identity invariants and the pipelined diff
+    path see nothing new, and no jitted program changes (the obs-in-jit
+    linter check pins that).
+
+    Timing semantics: the histograms record the host-blocking time of
+    the dispatch call — true device time on synchronous backends (the
+    CPU test mesh serializes; fetch-backed entries sync anyway) and
+    enqueue time on async TPU streams; the engine's Timeline remains
+    the realizing profiler.
+
+    Halo traffic: when the stepper publishes `halo_cost`, each
+    dispatch also bumps gol_tpu_halo_exchanges_total /
+    gol_tpu_halo_bytes_total from the block plan the dispatch actually
+    compiles — the per-dispatch collective budget docs/PERF.md reasons
+    about, now machine-captured."""
+    import dataclasses
+    import time
+
+    from gol_tpu import obs
+
+    backend = {"backend": s.name}
+    dispatches = {}
+    seconds = {}
+    for entry in ("put", "fetch", "step", "step_n", "step_with_diff",
+                  "step_n_with_diffs", "step_n_with_diffs_sparse",
+                  "step_n_with_diffs_redo"):
+        dispatches[entry] = obs.counter(
+            "gol_tpu_stepper_dispatches_total",
+            "Stepper entry invocations", {**backend, "entry": entry},
+        )
+        seconds[entry] = obs.histogram(
+            "gol_tpu_stepper_dispatch_seconds",
+            "Host-blocking seconds per stepper entry call",
+            {**backend, "entry": entry},
+        )
+    halo_exchanges = obs.counter(
+        "gol_tpu_halo_exchanges_total",
+        "Ring ppermute slab sends dispatched", backend,
+    )
+    halo_bytes = obs.counter(
+        "gol_tpu_halo_bytes_total",
+        "Ring halo bytes moved (both directions, all shards)", backend,
+    )
+    halo_seconds = obs.histogram(
+        "gol_tpu_halo_dispatch_seconds",
+        "Host-blocking seconds per ring-stepper multi-turn dispatch",
+        backend,
+    )
+
+    def _charge_halo(world, k, per_turn: bool) -> None:
+        if s.halo_cost is None:
+            return
+        cost = s.halo_cost(world, k, per_turn)
+        halo_exchanges.inc(cost["exchanges"])
+        halo_bytes.inc(cost["bytes"])
+
+    def timed(entry, fn):
+        disp, hist = dispatches[entry], seconds[entry]
+
+        def wrapper(*args):
+            disp.inc()
+            t0 = time.perf_counter()
+            out = fn(*args)
+            hist.observe(time.perf_counter() - t0)
+            return out
+
+        return wrapper
+
+    def step_n(world, k):
+        dispatches["step_n"].inc()
+        _charge_halo(world, int(k), False)
+        t0 = time.perf_counter()
+        out = s.step_n(world, k)
+        dt = time.perf_counter() - t0
+        seconds["step_n"].observe(dt)
+        if s.halo_cost is not None:
+            halo_seconds.observe(dt)
+        return out
+
+    def _diffy(entry, fn):
+        def wrapper(world, k, *rest):
+            dispatches[entry].inc()
+            _charge_halo(world, int(k), True)
+            t0 = time.perf_counter()
+            out = fn(world, k, *rest)
+            seconds[entry].observe(time.perf_counter() - t0)
+            return out
+
+        return wrapper
+
+    def _one_turn(entry, fn):
+        def wrapper(world):
+            dispatches[entry].inc()
+            _charge_halo(world, 1, True)
+            t0 = time.perf_counter()
+            out = fn(world)
+            seconds[entry].observe(time.perf_counter() - t0)
+            return out
+
+        return wrapper
+
+    return dataclasses.replace(
+        s,
+        put=timed("put", s.put),
+        fetch=timed("fetch", s.fetch),
+        step=_one_turn("step", s.step),
+        step_n=step_n,
+        step_with_diff=_one_turn("step_with_diff", s.step_with_diff),
+        step_n_with_diffs=(
+            None if s.step_n_with_diffs is None
+            else _diffy("step_n_with_diffs", s.step_n_with_diffs)
+        ),
+        step_n_with_diffs_sparse=(
+            None if s.step_n_with_diffs_sparse is None
+            else _diffy("step_n_with_diffs_sparse",
+                        s.step_n_with_diffs_sparse)
+        ),
+        step_n_with_diffs_redo=(
+            None if s.step_n_with_diffs_redo is None
+            else _diffy("step_n_with_diffs_redo", s.step_n_with_diffs_redo)
+        ),
+    )
+
+
 def make_stepper(
     threads: int = 1,
     height: int = 512,
@@ -562,11 +701,18 @@ def make_stepper(
     devices: Optional[list] = None,
     backend: str = "auto",
 ) -> Stepper:
-    """Build the best stepper for the request, wrapped with the runtime
-    dispatch-linearity checker when GOL_TPU_CHECK_INVARIANTS=1 (cli
-    --check-invariants; gol_tpu.analysis.invariants) — host-side
-    identity checks only, so the opt-in costs nothing on device."""
+    """Build the best stepper for the request, wrapped with per-dispatch
+    obs instrumentation (unless GOL_TPU_METRICS=0 — the disabled path
+    builds the bare stepper, so metrics-off costs literally nothing)
+    and with the runtime dispatch-linearity checker when
+    GOL_TPU_CHECK_INVARIANTS=1 (cli --check-invariants;
+    gol_tpu.analysis.invariants) — host-side identity checks only, so
+    the opt-in costs nothing on device."""
+    from gol_tpu import obs
+
     s = _make_stepper(threads, height, width, rule, devices, backend)
+    if obs.enabled():
+        s = instrument_stepper(s)
     from gol_tpu.analysis.invariants import checked_stepper, invariants_enabled
 
     if invariants_enabled():

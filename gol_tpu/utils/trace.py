@@ -18,6 +18,7 @@ Two complementary layers:
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import json
@@ -25,6 +26,8 @@ import time
 from typing import Iterator, Optional
 
 import jax
+
+from gol_tpu.obs import atomic_write_text
 
 
 @contextlib.contextmanager
@@ -49,21 +52,37 @@ class Span:
 
 
 class Timeline:
-    """Per-dispatch span log. Appends are single-writer (engine thread);
+    """Per-dispatch span RING. Appends are single-writer (engine thread);
     reads take a snapshot copy, so no lock is needed (the reference's
     ticker read its turn counter unlocked and raced, SURVEY.md §2; here
-    the list append is atomic under the GIL and readers never mutate)."""
+    the deque append is atomic under the GIL and readers never mutate).
+
+    Past `capacity` the OLDEST spans are evicted — a long run keeps the
+    recent window instead of silently freezing at the run's first
+    `capacity` dispatches (the old drop-at-capacity behavior meant an
+    infinite-run profile showed only its warm-up). `summary()` reports
+    `dropped` so a truncated window is always visible, and the totals
+    keep counting every recorded span, evicted or not."""
 
     def __init__(self, capacity: int = 100_000):
         self.capacity = capacity
-        self._spans: list[Span] = []
+        self._spans: "collections.deque[Span]" = collections.deque(
+            maxlen=capacity
+        )
         self._t0 = time.perf_counter()
+        # Running totals over EVERY recorded span (eviction is a memory
+        # bound, not an accounting one).
+        self._recorded = 0
+        self._total_turns = 0
+        self._total_seconds = 0.0
 
     # -- engine side --
 
     def record(self, turn: int, turns: int, seconds: float, kind: str) -> None:
-        if len(self._spans) < self.capacity:
-            self._spans.append(Span(turn, turns, seconds, kind))
+        self._recorded += 1
+        self._total_turns += turns
+        self._total_seconds += seconds
+        self._spans.append(Span(turn, turns, seconds, kind))
 
     # -- reader side --
 
@@ -71,12 +90,18 @@ class Timeline:
     def spans(self) -> list[Span]:
         return list(self._spans)
 
+    @property
+    def dropped(self) -> int:
+        """Spans evicted from the ring (recorded minus retained)."""
+        return max(0, self._recorded - len(self._spans))
+
     def summary(self) -> dict:
-        spans = self.spans
-        total_turns = sum(s.turns for s in spans)
-        total_s = sum(s.seconds for s in spans)
+        total_turns = self._total_turns
+        total_s = self._total_seconds
         return {
-            "dispatches": len(spans),
+            "dispatches": self._recorded,
+            "retained": len(self._spans),
+            "dropped": self.dropped,
             "turns": total_turns,
             "busy_seconds": round(total_s, 6),
             "wall_seconds": round(time.perf_counter() - self._t0, 6),
@@ -84,12 +109,15 @@ class Timeline:
         }
 
     def dump(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump(
+        # Crash-safe (temp file + rename): a killed engine never leaves
+        # a truncated timeline artifact behind.
+        atomic_write_text(
+            path,
+            json.dumps(
                 {"summary": self.summary(),
                  "spans": [dataclasses.asdict(s) for s in self.spans]},
-                f,
-            )
+            ),
+        )
 
 
 def profile_run(params, trace_dir: Optional[str] = None, **engine_kwargs):

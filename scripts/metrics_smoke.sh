@@ -1,0 +1,87 @@
+#!/usr/bin/env bash
+# Metrics smoke test: boot a real headless engine with --metrics-port,
+# hit /metrics + /healthz + /vars on the live sidecar, and assert the
+# core series are present and moving. Exercises the full opt-in path
+# (cli flag -> gol_tpu.obs.http -> process registry) the way an
+# operator's probe would — no pytest, no mocks.
+#
+# Usage: scripts/metrics_smoke.sh   (CPU-safe; ~15s)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+LOG=$(mktemp)
+OUT=$(mktemp -d)
+cleanup() {
+    kill "$PID" 2>/dev/null || true
+    wait "$PID" 2>/dev/null || true
+    rm -rf "$LOG" "$OUT"
+}
+
+python -m gol_tpu -noVis -t 2 -w 64 -h 64 -turns 1000000000 \
+    --images fixtures/images --out "$OUT" --platform cpu \
+    --metrics-port 0 >"$LOG" 2>&1 &
+PID=$!
+trap cleanup EXIT
+
+# The CLI prints the bound ephemeral address once the sidecar is up.
+BASE=""
+for _ in $(seq 1 240); do
+    BASE=$(sed -n 's#^metrics serving on \(http://[^/]*\)/metrics$#\1#p' "$LOG" | head -1)
+    [ -n "$BASE" ] && break
+    if ! kill -0 "$PID" 2>/dev/null; then
+        echo "metrics smoke: FAILED — engine died during startup:" >&2
+        cat "$LOG" >&2
+        exit 1
+    fi
+    sleep 0.5
+done
+if [ -z "$BASE" ]; then
+    echo "metrics smoke: FAILED — no metrics address printed:" >&2
+    cat "$LOG" >&2
+    exit 1
+fi
+
+fetch() {
+    python -c 'import sys, urllib.request
+sys.stdout.write(urllib.request.urlopen(sys.argv[1], timeout=15).read().decode())' "$1"
+}
+
+# Give the engine a moment to commit its first dispatches, then scrape.
+sleep 3
+METRICS=$(fetch "$BASE/metrics")
+for series in \
+    gol_tpu_engine_dispatches_total \
+    gol_tpu_engine_turns_total \
+    gol_tpu_engine_committed_turn \
+    gol_tpu_stepper_dispatches_total \
+    gol_tpu_halo_bytes_total
+do
+    if ! grep -q "^$series" <<<"$METRICS"; then
+        echo "metrics smoke: FAILED — series $series missing from /metrics" >&2
+        exit 1
+    fi
+done
+if ! grep -q '^# TYPE gol_tpu_engine_dispatches_total counter' <<<"$METRICS"; then
+    echo "metrics smoke: FAILED — exposition lost its TYPE headers" >&2
+    exit 1
+fi
+
+HEALTH=$(fetch "$BASE/healthz")
+grep -q '"status": "ok"' <<<"$HEALTH" || {
+    echo "metrics smoke: FAILED — /healthz not ok: $HEALTH" >&2
+    exit 1
+}
+
+VARS=$(fetch "$BASE/vars")
+python -c '
+import json, sys
+snap = json.loads(sys.argv[1])
+turns = [v["value"] for k, v in snap.items()
+         if k.startswith("gol_tpu_engine_turns_total")]
+assert sum(turns) > 0, f"engine committed no turns yet: {turns}"
+' "$VARS" || {
+    echo "metrics smoke: FAILED — /vars snapshot shows no committed turns" >&2
+    exit 1
+}
+
+echo "metrics smoke: OK ($BASE — /metrics, /healthz, /vars all live)"

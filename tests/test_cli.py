@@ -18,6 +18,36 @@ def test_flag_parsing_single_dash_style():
     assert (a.t, a.w, a.h, a.turns, a.novis) == (4, 64, 32, 7, True)
 
 
+def test_metrics_flags_default_off():
+    a = build_parser().parse_args([])
+    assert a.metrics_port is None  # observability is opt-in
+    assert a.metrics_host == "127.0.0.1"
+    a = build_parser().parse_args(["--metrics-port", "0"])
+    assert a.metrics_port == 0
+
+
+def test_headless_run_with_metrics_port_serves_and_finishes(
+    golden_root, tmp_path, capsys
+):
+    """End-to-end: a --metrics-port engine run prints the sidecar
+    address, serves during the run, and the registry shows the run's
+    committed turns afterwards."""
+    from gol_tpu import obs
+
+    turns = obs.registry().counter("gol_tpu_engine_turns_total",
+                                   labels={"kind": "chunk"})
+    t0 = turns.value
+    rc = main([
+        "-w", "64", "-h", "64", "-turns", "20", "-t", "2", "-noVis",
+        "--images", str(golden_root / "images"), "--out", str(tmp_path),
+        "--metrics-port", "0",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "metrics serving on http://127.0.0.1:" in out
+    assert turns.value - t0 == 20
+
+
 def test_headless_run_writes_golden_pgm(golden_root, tmp_path, capsys):
     rc = main([
         "-w", "64", "-h", "64", "-turns", "100", "-t", "4", "-noVis",

@@ -35,6 +35,29 @@ from gol_tpu.params import Params
 from gol_tpu.visual.board import NumpyBoard
 
 
+@pytest.fixture(autouse=True)
+def _invariant_violation_guard(monkeypatch):
+    """Runtime invariants ON for every distributed test (the server
+    broadcaster wraps its stream with EventStreamChecker, steppers get
+    the dispatch-linearity wrap), and any violation — even one whose
+    raise was swallowed by a daemon thread — fails the test through the
+    gol_tpu_invariant_violations_total registry counter."""
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.analysis.invariants import violations_total
+
+    before = violations_total()
+    yield
+    grew = violations_total() - before
+    assert grew == 0, (
+        f"gol_tpu_invariant_violations_total grew by {grew} during this "
+        "test: a distributed-protocol invariant (event-stream ordering "
+        "or dispatch linearity) was broken at runtime. The violation "
+        "message was raised in the offending thread's log; see "
+        "gol_tpu/analysis/invariants.py and the registry snapshot "
+        "(gol_tpu.obs.registry().snapshot()) for the checker label."
+    )
+
+
 def make_server(golden_root, tmp_path, resume_from=None, secret=None, **kw):
     defaults = dict(
         turns=100, threads=2, image_width=64, image_height=64,

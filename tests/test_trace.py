@@ -92,3 +92,56 @@ def test_timeline_capacity_cap():
     for i in range(5):
         tl.record(i + 1, 1, 0.001, "chunk")
     assert len(tl.spans) == 3  # bounded memory on infinite runs
+
+
+def test_timeline_ring_keeps_latest_spans_and_counts_dropped():
+    """Past capacity the OLDEST spans are evicted (ring buffer), never
+    the newest — an infinite run's profile shows its recent window, not
+    its warm-up — and the truncation is visible as `dropped`."""
+    tl = Timeline(capacity=3)
+    for i in range(5):
+        tl.record(i + 1, 2, 0.001, "chunk")
+    assert [s.turn for s in tl.spans] == [3, 4, 5]
+    assert tl.dropped == 2
+    s = tl.summary()
+    assert s["dispatches"] == 5
+    assert s["retained"] == 3
+    assert s["dropped"] == 2
+    # Totals keep accounting for EVERY recorded span, evicted or not.
+    assert s["turns"] == 10
+    assert s["busy_seconds"] == pytest.approx(0.005)
+
+
+def test_timeline_summary_no_drop_is_zero():
+    tl = Timeline(capacity=10)
+    tl.record(1, 1, 0.001, "chunk")
+    assert tl.dropped == 0
+    assert tl.summary()["dropped"] == 0
+
+
+def test_timeline_dump_is_crash_safe(tmp_path, monkeypatch):
+    """dump() writes temp-then-rename: a failure mid-dump leaves the
+    previous artifact byte-intact and no temp litter."""
+    import importlib
+
+    # import_module, not `import ... as`: the obs package re-exports a
+    # registry() FUNCTION that shadows the submodule attribute.
+    obs_registry = importlib.import_module("gol_tpu.obs.registry")
+
+    tl = Timeline()
+    tl.record(1, 1, 0.001, "chunk")
+    out = tmp_path / "timeline.json"
+    tl.dump(str(out))
+    first = out.read_text()
+    assert json.loads(first)["summary"]["dispatches"] == 1
+
+    def boom(src, dst):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(obs_registry.os, "replace", boom)
+    tl.record(2, 1, 0.001, "chunk")
+    with pytest.raises(OSError):
+        tl.dump(str(out))
+    monkeypatch.undo()
+    assert out.read_text() == first  # old artifact untouched
+    assert [p for p in tmp_path.iterdir() if p.suffix == ".tmp"] == []
