@@ -1,6 +1,7 @@
 """Check registry. Each module: CHECK name + run(ctx) -> findings."""
 
 from gol_tpu.analysis.checks import (
+    blocking_io,
     donation,
     dtype_drift,
     host_sync,
@@ -11,7 +12,7 @@ from gol_tpu.analysis.checks import (
 
 #: Every check the CLI and the tier-1 test run, in report order.
 ALL_CHECKS = [host_sync, tracer_branch, recompile, dtype_drift, donation,
-              obs_in_jit]
+              obs_in_jit, blocking_io]
 
-__all__ = ["ALL_CHECKS", "donation", "dtype_drift", "host_sync",
-           "obs_in_jit", "recompile", "tracer_branch"]
+__all__ = ["ALL_CHECKS", "blocking_io", "donation", "dtype_drift",
+           "host_sync", "obs_in_jit", "recompile", "tracer_branch"]

@@ -19,6 +19,20 @@ from typing import Optional
 _SNAP = re.compile(r"^(\d+)x(\d+)x(\d+)\.pgm$")
 
 
+def record_resume_turn(turn: int) -> None:
+    """Publish the turn this process resumed from as the
+    `gol_tpu_resume_turn` gauge (0 = fresh start) — the one
+    registration point every resume path (local CLI, EngineServer)
+    shares, so the smoke harness and operators read a single series.
+    Imported lazily: checkpoint discovery itself stays stdlib-only."""
+    from gol_tpu import obs
+
+    obs.gauge(
+        "gol_tpu_resume_turn",
+        "Turn this process resumed from (0 = fresh start)",
+    ).set(turn)
+
+
 def snapshot_turn(path: str | os.PathLike) -> int:
     """Turn number encoded in a snapshot filename `<W>x<H>x<T>.pgm`."""
     m = _SNAP.match(os.path.basename(os.fspath(path)))
@@ -34,11 +48,18 @@ def latest_snapshot(
 
     Only complete snapshots are visible: in-flight writes live under a
     dotted `.tmp` name until their atomic rename, so a run killed
-    mid-write never offers a truncated board here.
+    mid-write never offers a truncated board here. An unreadable (or
+    missing) directory is "no checkpoint", never an exception — resume
+    discovery runs on freshly crashed trees.
+
+    Ties (two filenames encoding the same turn, e.g. a zero-padded
+    `64x64x0100.pgm` next to `64x64x100.pgm`) resolve to the
+    lexicographically first name: discovery must be deterministic
+    across runs, and os.listdir order is not.
     """
     best_turn, best = -1, None
     try:
-        names = os.listdir(out_dir)
+        names = sorted(os.listdir(out_dir))
     except OSError:
         return None
     for name in names:

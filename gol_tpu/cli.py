@@ -128,6 +128,29 @@ def build_parser() -> argparse.ArgumentParser:
                     help="resume from an out/ snapshot, continuing at "
                          "the turn encoded in its filename; 'latest' "
                          "picks the newest matching snapshot in --out")
+    # Resilience knobs (docs/RESILIENCE.md).
+    ap.add_argument("--hb-secs", type=float, default=2.0, metavar="SEC",
+                    dest="hb_secs",
+                    help="with --serve: heartbeat cadence into idle "
+                         "peer streams; silent heartbeat-capable peers "
+                         "are evicted after --evict-secs (0 disables "
+                         "the liveness plane; default 2)")
+    ap.add_argument("--evict-secs", type=float, default=None,
+                    metavar="SEC", dest="evict_secs",
+                    help="with --serve: idle-eviction deadline for "
+                         "peers that stop answering heartbeats "
+                         "(default 3x --hb-secs)")
+    ap.add_argument("--no-reconnect", action="store_true",
+                    dest="no_reconnect",
+                    help="with --connect: die on the first link "
+                         "failure instead of re-dialing with backoff "
+                         "and resuming via board sync")
+    ap.add_argument("--reconnect-secs", type=float, default=60.0,
+                    metavar="SEC", dest="reconnect_secs",
+                    help="with --connect: total re-dial window after a "
+                         "link failure — long enough to ride out a "
+                         "server crash-restart with --resume "
+                         "(default 60)")
     # Multi-host SPMD job membership (parallel/multihost.py). All three
     # default to the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
     # JAX_PROCESS_ID env vars; unset means single-process.
@@ -303,12 +326,14 @@ def main(argv: Optional[list[str]] = None) -> int:
 
         engine_kwargs = {}
         if resume_path is not None:
+            from gol_tpu.checkpoint import record_resume_turn
             from gol_tpu.io.pgm import read_pgm
 
             engine_kwargs = {
                 "initial_world": read_pgm(resume_path),
                 "start_turn": resume_turn,
             }
+            record_resume_turn(resume_turn)
         # Per-turn CellFlipped diffs only matter when something consumes them.
         if params.cycle_detect and not args.novis:
             print("warning: --cycle-detect only engages on headless "
@@ -387,7 +412,9 @@ def _serve(args, params: Params, resume_path: Optional[str] = None) -> int:
 
     host, port = _addr(args.serve, default_host="127.0.0.1")
     server = EngineServer(params, host, port, resume_from=resume_path,
-                          secret=args.secret)
+                          secret=args.secret,
+                          heartbeat_secs=args.hb_secs,
+                          evict_secs=args.evict_secs)
     print(f"engine serving on {server.address[0]}:{server.address[1]}")
     # Sidecar BEFORE the engine/broadcast threads: a failed port bind
     # aborts while nothing needing teardown is running (a bind failure
@@ -428,13 +455,17 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
     ctl = Controller(host, port, want_flips=not args.novis,
                      secret=args.secret, batch=not args.novis,
                      levels=vis_levels and not args.novis,
-                     observe=args.observe)
+                     observe=args.observe,
+                     reconnect=not args.no_reconnect,
+                     reconnect_window=args.reconnect_secs)
 
     def _ctl_health() -> dict:
         return {
             "status": "ok" if not ctl.events.closed else "detached",
+            "state": ctl.state,
             "synced": ctl.synced.is_set(),
             "sync_turn": ctl.sync_turn,
+            "reconnects": ctl.reconnects,
             "detached": ctl.detached.is_set(),
         }
 
@@ -457,8 +488,8 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
             try:
                 wire_keys.put(keypresses.get(timeout=0.2))
             except queue.Empty:
-                if ctl.detached.is_set():
-                    return
+                if ctl.detached.is_set() or ctl.events.closed:
+                    return  # detached, lost, or run over
 
     threading.Thread(target=pump, name="gol-ctl-keys", daemon=True).start()
     try:
@@ -470,6 +501,10 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
                 s = str(ev)
                 if s:
                     print(f"Completed Turns {ev.completed_turns:<8}{s}")
+            if ctl.lost.is_set():
+                print("error: connection to the engine lost "
+                      "(reconnect budget exhausted)", file=sys.stderr)
+                return 1
             if ctl.board is None and not ctl.detached.is_set():
                 print("engine run ended before the attach completed",
                       file=sys.stderr)
@@ -489,6 +524,12 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
                 params, image_width=w, image_height=h
             )
             run_loop(params, ctl.events, wire_keys, levels=vis_levels)
+            if ctl.lost.is_set():
+                # Same contract as the headless path: a permanently
+                # lost link is a failure exit, not a silent 0.
+                print("error: connection to the engine lost "
+                      "(reconnect budget exhausted)", file=sys.stderr)
+                return 1
         return 0
     finally:
         ctl.close()

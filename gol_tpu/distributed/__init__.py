@@ -2,14 +2,18 @@
 (the working version of the reference's RPC scaffolding, SURVEY.md §2 C11)."""
 
 from gol_tpu.distributed.client import (
+    ConnectionLost,
     Controller,
+    EngineClient,
     ServerBusyError,
     UnauthorizedError,
 )
 from gol_tpu.distributed.server import EngineServer, snapshot_turn
 
 __all__ = [
+    "ConnectionLost",
     "Controller",
+    "EngineClient",
     "EngineServer",
     "ServerBusyError",
     "UnauthorizedError",

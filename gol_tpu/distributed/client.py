@@ -10,11 +10,26 @@ verbs go the other way with `send_key` (ref: sdl/loop.go:18-27).
 Detach/reattach (ref: README.md:182): `send_key('q')` — the server acks
 with "detached", the local stream closes, the remote engine keeps
 evolving; a new Controller can attach later and board-sync.
+
+Resilience (docs/RESILIENCE.md): the reader is SUPERVISED. On a socket
+failure — reset, EOF without a goodbye, or a missed heartbeat deadline
+— it re-dials with exponential backoff + deterministic jitter, repeats
+the handshake, and resumes through the ordinary BoardSync catch-up: the
+client tracks the board it has handed downstream (applying each flip
+batch to its shadow raster), so the reattach sync's XOR diff is exactly
+the correction between what consumers have and where the engine is —
+missed flips are never replayed, present ones never doubled, and
+`synced_turn` gating drops any flip the synced board already contains.
+When reconnection is disabled or exhausted the client parts with an
+explicit `ConnectionLost` state (`lost` event, `state == "lost"`)
+rather than an indistinguishable closed stream.
 """
 
 from __future__ import annotations
 
 import contextlib
+import logging
+import random
 import socket
 import threading
 import time
@@ -26,7 +41,9 @@ from gol_tpu import obs
 from gol_tpu.distributed import wire
 from gol_tpu.engine.distributor import EventQueue
 from gol_tpu.events import CellFlipped, FlipBatch, TurnComplete
-from gol_tpu.utils.cell import cells_from_mask, xy_from_mask
+from gol_tpu.utils.cell import Cell, cells_from_mask, xy_from_mask
+
+log = logging.getLogger(__name__)
 
 
 class _ClientMetrics:
@@ -52,6 +69,18 @@ class _ClientMetrics:
                 "Server messages handled by kind", {"kind": t},
             ) for t in ("board", "flips", "ev", "other")
         }
+        self.reconnects = obs.counter(
+            "gol_tpu_client_reconnects_total",
+            "Successful re-dial + re-handshake + resync cycles",
+        )
+        self.hb_miss = obs.counter(
+            "gol_tpu_client_heartbeat_miss_total",
+            "Read deadlines expired without a frame (liveness misses)",
+        )
+        self.lost = obs.counter(
+            "gol_tpu_client_connection_lost_total",
+            "Links declared permanently lost (reconnect off/exhausted)",
+        )
 
 
 _METRICS = _ClientMetrics()
@@ -63,6 +92,10 @@ class ServerBusyError(ConnectionError):
 
 class UnauthorizedError(ConnectionError):
     """The engine requires a shared secret this controller lacks."""
+
+
+class ConnectionLost(ConnectionError):
+    """The link died and reconnection was disabled or exhausted."""
 
 
 class Controller:
@@ -78,6 +111,12 @@ class Controller:
         binary: bool = True,
         levels: bool = False,
         observe: bool = False,
+        reconnect: bool = True,
+        max_reconnects: Optional[int] = None,
+        reconnect_window: float = 30.0,
+        backoff_base: float = 0.05,
+        backoff_cap: float = 2.0,
+        reconnect_seed: Optional[int] = None,
     ):
         #: batch=True delivers each turn's flips as ONE events.FlipBatch
         #: ndarray instead of per-cell CellFlipped objects — the form
@@ -91,82 +130,174 @@ class Controller:
         #: surface them on the FlipBatch — pair with a level-mode board.
         self._levels = levels
         self.events = EventQueue()
-        #: Board state from the attach sync (None until it arrives).
+        #: Board state as of the last flip handed downstream — starts
+        #: as the attach sync's raster and tracks every applied batch,
+        #: so a reattach sync can diff against what consumers actually
+        #: have (None until the first sync arrives).
         self.board: Optional[np.ndarray] = None
-        #: Completed turns as of the attach sync.
+        #: Completed turns as of the last board sync.
         self.sync_turn: int = 0
+        #: Gate against double-apply: flips for turns <= this are
+        #: already inside the synced board and are dropped (the client
+        #: twin of the server's per-peer synced_turn gate).
+        self.synced_turn: int = -1
         #: Set once the attach-time BoardSync has been applied.
         self.synced = threading.Event()
         self.detached = threading.Event()
+        #: Set when the link is PERMANENTLY gone (reconnect disabled,
+        #: window/attempts exhausted, or a policy rejection on
+        #: re-handshake) — the explicit state `wait_sync`/`detach`
+        #: return against instead of silently timing out.
+        self.lost = threading.Event()
+        #: Successful reconnect cycles this controller has survived.
+        self.reconnects = 0
         self._send_lock = threading.Lock()
-        # The timeout covers the whole handshake (connect + hello + first
-        # reply), not just the TCP connect — a wedged server must not
-        # hang the constructor. Streaming afterwards is untimed. Any
-        # handshake failure closes the socket and the event stream.
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        try:
-            # "compact" advertises the zlib'd-int32 flips encoding and
-            # "binary" the raw tag+header+zlib frames; a server that
-            # predates either just ignores the field and sends what it
-            # knows (decodable on every path — recv_msg dispatches on
-            # the first payload byte). `binary=False` pins the JSON
-            # encodings (tests exercise the negotiation both ways).
-            hello = {"t": "hello", "want_flips": want_flips,
-                     "compact": True, "binary": bool(binary),
-                     "levels": bool(levels)}
-            if observe:
-                # Read-only attach (r5 multi-observer serving): the
-                # driver slot stays free, steering verbs are rejected
-                # by the server; 'q' still detaches this observer.
-                hello["role"] = "observe"
-            if secret is not None:
-                hello["secret"] = secret
-            wire.send_msg(self._sock, hello)
-            first = wire.recv_msg(self._sock)
-        except (TimeoutError, wire.WireError, OSError) as e:
-            self.close()
-            raise ConnectionError(
-                f"handshake with {host}:{port} failed: {e}"
-            ) from None
-        self._sock.settimeout(None)
-        if first is not None and first.get("t") == "error":
-            self.close()
-            reason = first.get("reason", "rejected")
-            if reason == "unauthorized":
-                raise UnauthorizedError(reason)
-            raise ServerBusyError(reason)
+        self._closing = threading.Event()
+        self._reconnecting = threading.Event()
+        self._host, self._port = host, port
+        self._timeout = timeout
+        self._reconnect_enabled = reconnect
+        self._max_reconnects = max_reconnects
+        self._window = reconnect_window
+        self._backoff_base = backoff_base
+        self._backoff_cap = backoff_cap
+        #: Deterministic jitter: a seeded PRNG makes a reconnect
+        #: schedule replayable in tests (and across a fleet, seeds
+        #: should differ so backed-off clients do not re-dial in
+        #: lockstep).
+        self._rng = random.Random(reconnect_seed)
+        #: Heartbeat cadence the server confirmed in its attach-ack
+        #: (0 = none negotiated; the read deadline stays unarmed).
+        self._hb_secs = 0.0
+        hello = {"t": "hello", "want_flips": want_flips,
+                 "compact": True, "binary": bool(binary),
+                 "levels": bool(levels), "hb": True}
+        if observe:
+            # Read-only attach (r5 multi-observer serving): the
+            # driver slot stays free, steering verbs are rejected
+            # by the server; 'q' still detaches this observer.
+            hello["role"] = "observe"
+        if secret is not None:
+            hello["secret"] = secret
+        self._hello = hello
+        self._sock, first = self._dial()
+        self._arm_read_deadline()
         self._reader = threading.Thread(
             target=self._reader_loop, args=(first,), name="gol-ctl-reader",
             daemon=True,
         )
         self._reader.start()
 
+    # --- link lifecycle ---
+
+    def _dial(self) -> "tuple[socket.socket, Optional[dict]]":
+        """One connect + handshake: returns the live socket and the
+        server's first reply (normally the attach-ack, whose hb_secs
+        arms the liveness deadline). Raises Unauthorized/ServerBusy on
+        policy rejections, ConnectionError on everything else. The
+        `timeout` covers the whole handshake — a wedged server must
+        not hang the caller; streaming afterwards runs under the
+        heartbeat deadline instead (see _arm_read_deadline)."""
+        from gol_tpu.testing import faults
+
+        sock = faults.wrap("client", socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        ))
+        # The handshake deadline (already set by create_connection;
+        # re-applied on the wrapper so the discipline is explicit) —
+        # replaced by the heartbeat deadline once the caller installs
+        # the socket and calls _arm_read_deadline.
+        sock.settimeout(self._timeout)
+        try:
+            wire.send_msg(sock, self._hello)
+            first = wire.recv_msg(sock)
+        except (TimeoutError, wire.WireError, OSError) as e:
+            with contextlib.suppress(OSError):
+                sock.close()
+            raise ConnectionError(
+                f"handshake with {self._host}:{self._port} failed: {e}"
+            ) from None
+        if first is not None and first.get("t") == "error":
+            with contextlib.suppress(OSError):
+                sock.close()
+            reason = first.get("reason", "rejected")
+            if reason == "unauthorized":
+                raise UnauthorizedError(reason)
+            raise ServerBusyError(reason)
+        sock.settimeout(None)
+        if first is not None and first.get("t") == "attach-ack":
+            self._hb_secs = float(first.get("hb_secs", 0) or 0)
+        return sock, first
+
+    def _arm_read_deadline(self) -> None:
+        """Three missed heartbeat intervals with zero frames = the
+        server is gone (docs/RESILIENCE.md). Servers that negotiated
+        no heartbeats keep the legacy unbounded read — evicting a
+        healthy-but-quiet legacy server would be worse than blocking."""
+        deadline = 3.0 * self._hb_secs if self._hb_secs > 0 else None
+        self._sock.settimeout(deadline)
+
+    @property
+    def state(self) -> str:
+        """One-word link state: connected / reconnecting / detached /
+        lost / closed — `lost` is the ConnectionLost outcome callers
+        used to have to infer from a timed-out False."""
+        if self.lost.is_set():
+            return "lost"
+        if self.detached.is_set():
+            return "detached"
+        if self.events.closed or self._closing.is_set():
+            return "closed"
+        if self._reconnecting.is_set():
+            return "reconnecting"
+        return "connected"
+
     def send_key(self, key: str) -> None:
         """Forward a keyboard verb (p/s/q/k) to the engine. Callable from
-        any thread (stdin pump + visualiser share one controller)."""
+        any thread (stdin pump + visualiser share one controller).
+        Raises ConnectionLost once the link is permanently gone."""
         if key not in ("p", "s", "q", "k"):
             raise ValueError(f"unknown verb {key!r}")
+        if self.lost.is_set():
+            raise ConnectionLost(
+                f"link to {self._host}:{self._port} is gone"
+            )
         with self._send_lock:
             wire.send_msg(self._sock, {"t": "key", "key": key})
 
     def wait_sync(self, timeout: float = 60.0) -> bool:
-        """Block until the attach-time board sync has been applied (or
-        the stream closed first — returns False then)."""
+        """Block until the attach-time board sync has been applied.
+        Returns False IMMEDIATELY once the stream closed or the link
+        was declared lost — never waits out the timeout against a dead
+        connection (check `state` to tell "lost" from "run over")."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if self.synced.wait(0.05):
                 return True
-            if self.events.closed:
+            if self.lost.is_set() or self.events.closed:
                 return self.synced.is_set()
         return self.synced.is_set()
 
     def detach(self, timeout: float = 30.0) -> bool:
-        """'q': detach from the engine, leaving it running."""
-        with contextlib.suppress(OSError, wire.WireError):
+        """'q': detach from the engine, leaving it running. Returns
+        immediately (False) when the link is already dead instead of
+        sleeping out the timeout waiting for an ack that cannot come."""
+        if self.lost.is_set() or self.events.closed:
+            return self.detached.is_set()
+        try:
             self.send_key("q")
-        return self.detached.wait(timeout)
+        except (OSError, ConnectionError):
+            return self.detached.is_set()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.detached.wait(0.05):
+                return True
+            if self.lost.is_set() or self.events.closed:
+                return self.detached.is_set()
+        return self.detached.is_set()
 
     def close(self) -> None:
+        self._closing.set()
         with contextlib.suppress(OSError):
             self._sock.shutdown(socket.SHUT_RDWR)
         with contextlib.suppress(OSError):
@@ -200,11 +331,14 @@ class Controller:
             # Replay as a flip burst + a render tick so any attached
             # visualiser shows the synced board immediately. Flips are
             # XOR for consumers, so the burst is the *difference* from
-            # the previous known state — idempotent under repeated
-            # syncs. Level mode compares gray grids directly and SETS
-            # the changed cells' levels instead (no rule needed: the
-            # raster IS the level grid).
+            # the board as consumers currently have it (self.board
+            # tracks every batch handed downstream) — which is what
+            # makes a RECONNECT sync converge without replaying missed
+            # flips or doubling delivered ones. Level mode compares
+            # gray grids directly and SETS the changed cells' levels
+            # instead (no rule needed: the raster IS the level grid).
             prev = self.board
+            board = np.array(board, dtype=np.uint8)  # writable tracker
             if self._levels:
                 diff = board != (np.zeros_like(board) if prev is None else prev)
                 self.board = board
@@ -223,18 +357,37 @@ class Controller:
                     for cell in cells_from_mask(diff):
                         self.events.put(CellFlipped(self.sync_turn, cell))
             self.events.put(TurnComplete(self.sync_turn))
+            self.synced_turn = self.sync_turn
             self.synced.set()
             return True
-        if t == "flips" and self._batch:
+        if t == "flips":
             turn, coords = wire.msg_flips_array(msg)
             lv = wire.msg_flips_levels(msg) if self._levels else None
             if lv is not None and len(lv) != len(coords):
                 raise wire.WireError(
                     f"{len(coords)} cells vs {len(lv)} levels"
                 )
-            self.events.put(FlipBatch(turn, coords, levels=lv))
+            if turn <= self.synced_turn:
+                # Already inside the synced raster (the server's gate
+                # makes this unreachable in practice; kept as the
+                # client's own no-double-apply guarantee).
+                return True
+            self._track_flips(coords, lv)
+            if self._batch:
+                self.events.put(FlipBatch(turn, coords, levels=lv))
+            else:
+                for x, y in coords:
+                    self.events.put(CellFlipped(turn, Cell(int(x), int(y))))
             return True
-        if t in ("ev", "flips"):
+        if t == "hb":
+            # Liveness beacon: answer with a pong — the server's
+            # idle-eviction clock runs on these.
+            with contextlib.suppress(OSError, ConnectionError,
+                                     wire.WireError):
+                with self._send_lock:
+                    wire.send_msg(self._sock, {"t": "hb"})
+            return True
+        if t == "ev":
             for ev in wire.msg_to_events(msg):
                 self.events.put(ev)
             return True
@@ -245,12 +398,107 @@ class Controller:
             return False
         return True  # unknown message kinds are ignored (forward compat)
 
+    def _track_flips(self, coords, levels) -> None:
+        """Mirror one delivered flip batch onto the shadow raster, so
+        the NEXT board sync diffs against what consumers actually have
+        (see _handle_inner's board branch)."""
+        if self.board is None or len(coords) == 0:
+            return
+        xy = np.asarray(coords).reshape(-1, 2)
+        if levels is not None:
+            self.board[xy[:, 1], xy[:, 0]] = levels
+        else:
+            self.board[xy[:, 1], xy[:, 0]] ^= np.uint8(255)
+
     def _reader_loop(self, first: Optional[dict]) -> None:
+        msg = first
+        while True:
+            reason = None
+            try:
+                while True:
+                    if msg is not None and not self._handle(msg):
+                        self.close()  # clean stream end: bye/detached
+                        return
+                    msg = wire.recv_msg(self._sock)
+                    if msg is None:
+                        raise wire.WireError(
+                            "server closed the stream without a goodbye"
+                        )
+            except TimeoutError:
+                # Zero frames for 3 heartbeat intervals: the server
+                # (or the path to it) is gone.
+                _METRICS.hb_miss.inc()
+                reason = "heartbeat deadline expired"
+            except (wire.WireError, OSError) as e:
+                reason = str(e) or type(e).__name__
+            msg = None
+            if self._closing.is_set() or self.detached.is_set():
+                self.close()
+                return
+            msg = self._try_reconnect(reason)
+            if msg is None:
+                self._mark_lost(reason)
+                return
+
+    def _try_reconnect(self, reason: str) -> Optional[dict]:
+        """Supervision: re-dial with exponential backoff + jitter until
+        the window/attempt budget runs out. Returns the new link's
+        first message on success (the reader continues with it), None
+        when the caller should declare the link lost."""
+        if (not self._reconnect_enabled or self._closing.is_set()
+                or self.detached.is_set()):
+            return None
+        log.warning("link to %s:%d failed (%s) — reconnecting",
+                    self._host, self._port, reason)
+        with contextlib.suppress(OSError):
+            self._sock.close()
+        self._reconnecting.set()
         try:
-            msg = first
-            while msg is not None and self._handle(msg):
-                msg = wire.recv_msg(self._sock)
-        except (wire.WireError, OSError):
-            pass  # server died — surface as stream close
+            deadline = time.monotonic() + self._window
+            attempt = 0
+            while (self._max_reconnects is None
+                   or attempt < self._max_reconnects):
+                delay = min(self._backoff_cap,
+                            self._backoff_base * (2 ** min(attempt, 20)))
+                delay *= 0.5 + self._rng.random()  # jitter: [0.5x, 1.5x)
+                if time.monotonic() + delay >= deadline:
+                    return None
+                if self._closing.wait(delay):
+                    return None
+                attempt += 1
+                try:
+                    sock, msg = self._dial()
+                except UnauthorizedError:
+                    return None  # policy rejection: retrying cannot help
+                except (ConnectionError, OSError):
+                    # Includes ServerBusy: our dead slot may not be
+                    # released server-side yet — exactly what the
+                    # backoff exists to wait out.
+                    continue
+                if msg is None:
+                    with contextlib.suppress(OSError):
+                        sock.close()
+                    continue
+                self._sock = sock
+                self._arm_read_deadline()
+                self.reconnects += 1
+                _METRICS.reconnects.inc()
+                log.warning(
+                    "reconnected to %s:%d on attempt %d — resyncing "
+                    "via BoardSync", self._host, self._port, attempt,
+                )
+                return msg
+            return None
         finally:
-            self.close()
+            self._reconnecting.clear()
+
+    def _mark_lost(self, reason: str) -> None:
+        log.warning("connection to %s:%d lost permanently (%s)",
+                    self._host, self._port, reason)
+        self.lost.set()
+        _METRICS.lost.inc()
+        self.close()
+
+
+#: The name the coursework spec uses for this half of the split.
+EngineClient = Controller

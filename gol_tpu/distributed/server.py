@@ -23,7 +23,13 @@ capability, working:
   system down after a final snapshot (ref: README.md:183);
 - `resume_from` boots the engine from an out/<W>x<H>x<T>.pgm snapshot,
   continuing at turn T — PGM-out + PGM-in checkpoint/resume
-  (SURVEY.md §5).
+  (SURVEY.md §5);
+- liveness (docs/RESILIENCE.md): a heartbeat thread beacons every
+  attached peer whose stream has idled past `heartbeat_secs` (so a
+  client behind a 40s cold compile still sees a live link), and evicts
+  hb-capable peers that stop answering — the failure detector the
+  30s send timeout alone could never be (a dead-but-open peer that
+  never receives anything would hold its slot forever).
 """
 
 from __future__ import annotations
@@ -35,7 +41,6 @@ import json
 import logging
 import queue
 import socket
-import struct
 import threading
 import time
 from typing import Optional
@@ -107,6 +112,14 @@ class _ServerMetrics:
         self.peers = obs.gauge(
             "gol_tpu_server_peers", "Currently attached peers"
         )
+        self.heartbeats = obs.counter(
+            "gol_tpu_server_heartbeats_total",
+            "Liveness beacons sent into idle peer streams",
+        )
+        self.evicted = obs.counter(
+            "gol_tpu_server_peer_evicted_total",
+            "Peers evicted for missing the heartbeat deadline",
+        )
 
 
 _METRICS = _ServerMetrics()
@@ -117,25 +130,49 @@ class _Conn:
 
     _next_token = itertools.count(1).__next__  # only the accept thread draws
 
+    #: Writer-flush budget for interactive paths that finish ONE peer
+    #: (the 'q' detach ack) rather than draining the whole set — the
+    #: same order as DRAIN_TIMEOUT, not the old 30s that let a single
+    #: wedged writer stall a detach for half a minute.
+    FINISH_TIMEOUT = 5.0
+    #: Per-direction socket deadline. Sends: a stalled-but-open
+    #: controller (SIGSTOP, dead network path) fills its TCP window and
+    #: would otherwise block the writer's sendall forever. Reads: the
+    #: reader wakes at this cadence (an idle expiry at a frame boundary
+    #: is clean — see wire.recv_msg) instead of blocking unboundedly,
+    #: so every blocking read in this package carries a deadline (the
+    #: blocking-io-timeout analysis check). Deliberately NOT the (much
+    #: shorter) eviction deadline: eviction is the heartbeat thread's
+    #: judgement from the last_rx clock — a tight deadline here would
+    #: also bound sends and could kill a slow-but-alive peer mid
+    #: board-sync.
+    IO_TIMEOUT = 30.0
+
     def __init__(self, sock: socket.socket, want_flips: bool,
                  compact: bool = False, binary: bool = False,
-                 levels: bool = False, role: str = "drive"):
+                 levels: bool = False, role: str = "drive",
+                 hb: bool = False, io_timeout: Optional[float] = None):
         #: "drive" (exclusive slot, verbs accepted) or "observe"
         #: (read-only: BoardSync + events, verbs rejected) — r5
         #: multi-observer serving (VERDICT r4 next #7).
         self.role = role
         self.sock = sock
-        # Send-side timeout only (SO_SNDTIMEO, not settimeout: the read
-        # side must keep blocking forever — controllers send verbs
-        # rarely). A stalled-but-open controller (SIGSTOP, dead network
-        # path) fills its TCP window and would otherwise block the
-        # broadcaster's sendall forever, wedging the whole event path;
-        # after 30s of no progress the send raises and the controller
-        # is detached like any dead peer.
-        sock.setsockopt(
-            socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-            struct.pack("ll", 30, 0),
-        )
+        sock.settimeout(io_timeout if io_timeout is not None
+                        else self.IO_TIMEOUT)
+        #: Peer advertised heartbeat support in its hello: it answers
+        #: our beacons with {"t":"hb"} pongs, so silence past the
+        #: eviction deadline means the peer is dead, not just quiet —
+        #: only such peers are ever evicted (a legacy controller that
+        #: sends one verb an hour keeps its slot, as before).
+        self.hb = hb
+        now = time.monotonic()
+        #: Last byte received from / enqueued to this peer, and how
+        #: many beacons went unanswered since last_rx — the liveness
+        #: state the heartbeat thread reads (GIL-atomic scalar writes;
+        #: reader and heartbeat threads never lock against each other).
+        self.last_rx = now
+        self.last_tx = now
+        self.hb_unanswered = 0
         self.want_flips = want_flips
         #: Peer advertised the zlib'd-int32 flips encoding in its hello;
         #: older controllers get legacy JSON pair lists (the skew the
@@ -205,6 +242,7 @@ class _Conn:
     def _enqueue(self, payload: bytes) -> None:
         if self._dead.is_set():
             raise wire.WireError("peer is gone")
+        self.last_tx = time.monotonic()
         _METRICS.frames.inc()
         _METRICS.frame_bytes.inc(len(payload))
         if self._writer is None:
@@ -242,14 +280,17 @@ class _Conn:
         if self._writer is not None:
             self._writer.join(timeout)
 
-    def finish(self, timeout: float = 30.0) -> None:
+    def finish(self, timeout: Optional[float] = None) -> None:
         """Flush the outbound queue (writer drains everything already
         enqueued — including a farewell — then exits on the sentinel)
         before the caller closes the socket. A direct farewell would
         OVERTAKE queued stream events (the client stops at bye/detached,
-        losing its FinalTurnComplete)."""
+        losing its FinalTurnComplete). The default budget is
+        FINISH_TIMEOUT: interactive paths that bypass _drain_conns
+        (the 'q' detach ack) must not stall half a minute behind one
+        wedged writer."""
         self.request_finish()
-        self.join_writer(timeout)
+        self.join_writer(self.FINISH_TIMEOUT if timeout is None else timeout)
 
     def close(self) -> None:
         self._dead.set()
@@ -272,9 +313,21 @@ class EngineServer:
         *,
         resume_from: Optional[str] = None,
         secret: Optional[str] = None,
+        heartbeat_secs: float = 2.0,
+        evict_secs: Optional[float] = None,
         **engine_kwargs,
     ):
         self.params = params
+        #: Liveness cadence (docs/RESILIENCE.md): beacons ride idle
+        #: gaps in each peer's stream every `heartbeat_secs`; an
+        #: hb-capable peer silent past `evict_secs` (default 3 beacon
+        #: intervals) with unanswered beacons outstanding is evicted.
+        #: 0 disables the whole plane (legacy behavior).
+        self.heartbeat_secs = max(0.0, heartbeat_secs)
+        self.evict_secs = (
+            evict_secs if evict_secs is not None
+            else 3.0 * self.heartbeat_secs
+        )
         #: Shared-secret attach token. When set, a hello whose "secret"
         #: does not match is rejected and logged — the board state and
         #: the 'k' kill verb are not for any peer that can reach the
@@ -284,6 +337,12 @@ class EngineServer:
         if resume_from is not None:
             engine_kwargs.setdefault("initial_world", read_pgm(resume_from))
             engine_kwargs.setdefault("start_turn", snapshot_turn(resume_from))
+        # Crash-restart visibility: the turn this process booted from
+        # (0 on a fresh start) — the smoke harness and operators read
+        # it to confirm a --resume actually resumed.
+        from gol_tpu.checkpoint import record_resume_turn
+
+        record_resume_turn(engine_kwargs.get("start_turn", 0))
         self._keys: queue.Queue = queue.Queue()
         # Flips ride as per-turn FlipBatch arrays: the broadcaster and
         # the wire consume them vectorized — per-cell Python event
@@ -309,8 +368,11 @@ class EngineServer:
 
     def start(self) -> "EngineServer":
         self.engine.start()
-        for fn, name in [(self._accept_loop, "gol-accept"),
-                         (self._broadcast_loop, "gol-broadcast")]:
+        loops = [(self._accept_loop, "gol-accept"),
+                 (self._broadcast_loop, "gol-broadcast")]
+        if self.heartbeat_secs > 0:
+            loops.append((self._heartbeat_loop, "gol-heartbeat"))
+        for fn, name in loops:
             t = threading.Thread(target=fn, name=name, daemon=True)
             t.start()
             self._threads.append(t)
@@ -375,14 +437,25 @@ class EngineServer:
 
     # --- accept path ---
 
+    #: A connected peer gets this long to produce its hello. Without a
+    #: deadline, one silent TCP connect wedges the (single) accept
+    #: thread forever — no further peer could ever attach.
+    HELLO_TIMEOUT = 10.0
+
     def _accept_loop(self) -> None:
+        from gol_tpu.testing import faults
+
         while not self._shutdown.is_set():
             try:
                 sock, addr = self._listener.accept()
             except OSError:
                 return  # listener closed
+            # Deterministic fault injection (GOL_TPU_FAULTS) — a
+            # passthrough unless a plan names the server role.
+            sock = faults.wrap("server", sock)
             _METRICS.accepts.inc()
             try:
+                sock.settimeout(self.HELLO_TIMEOUT)
                 # Control-only receive: an unauthenticated peer must
                 # never make the server inflate a bulk zlib payload.
                 hello = wire.recv_msg(sock, allow_binary=False)
@@ -415,11 +488,15 @@ class EngineServer:
 
             role = ("observe" if hello.get("role") == "observe"
                     else "drive")
+            # Heartbeat negotiation: the peer advertises support, we
+            # confirm the cadence in the attach-ack; only hb peers are
+            # ever evicted for silence.
+            hb = bool(hello.get("hb", False)) and self.heartbeat_secs > 0
             conn = _Conn(sock, bool(hello.get("want_flips", False)),
                          compact=bool(hello.get("compact", False)),
                          binary=bool(hello.get("binary", False)),
                          levels=bool(hello.get("levels", False)),
-                         role=role)
+                         role=role, hb=hb)
             if role == "observe":
                 # Observers fan out freely — only the DRIVER slot is
                 # exclusive (its verbs steer the run).
@@ -449,8 +526,14 @@ class EngineServer:
             # TPU that can be a 40s compile away. The ack lands within
             # ms so attaches never time out behind a dispatch (clients
             # ignore unknown message kinds, so old ones are unaffected).
+            ack = {"t": "attach-ack"}
+            if hb:
+                # The client arms its own miss-detector from this: a
+                # server that stays silent past a few multiples of
+                # hb_secs is dead, and reconnecting is correct.
+                ack["hb_secs"] = self.heartbeat_secs
             try:
-                conn.send({"t": "attach-ack"})
+                conn.send(ack)
             except (wire.WireError, OSError):
                 self._detach(conn)
                 continue
@@ -536,11 +619,24 @@ class EngineServer:
             try:
                 # Controllers only ever send JSON control messages.
                 msg = wire.recv_msg(conn.sock, allow_binary=False)
+            except TimeoutError:
+                # Idle expiry at a frame boundary (wire.recv_msg): not
+                # a failure — the heartbeat thread owns the eviction
+                # verdict; this loop just wakes at the deadline cadence
+                # instead of blocking unboundedly.
+                if conn._dead.is_set():
+                    self._detach(conn)
+                    return
+                continue
             except (wire.WireError, OSError):
                 msg = None
             if msg is None:  # controller went away (crash or close)
                 self._detach(conn)
                 return
+            # ANY inbound byte proves the peer alive — heartbeat pongs
+            # exist precisely to generate this refresh on idle links.
+            conn.last_rx = time.monotonic()
+            conn.hb_unanswered = 0
             if msg.get("t") != "key":
                 continue
             key = msg.get("key")
@@ -569,6 +665,57 @@ class EngineServer:
                 # Global shutdown with a final snapshot (ref: README.md:183).
                 self._keys.put("k")
                 return  # broadcaster sends the tail + bye, then shutdown
+
+    # --- liveness (docs/RESILIENCE.md) ---
+
+    #: Beacons that must go unanswered (on top of the evict_secs
+    #: silence) before a peer is evicted — eviction requires PROBED
+    #: silence, so a peer that is merely quiet behind a busy outbound
+    #: stream (no idle gap → no beacons sent) is never judged by a
+    #: clock nothing refreshed.
+    HB_MISS_LIMIT = 3
+
+    def _heartbeat_loop(self) -> None:
+        interval = max(0.05, self.heartbeat_secs / 2.0)
+        while not self._shutdown.wait(interval):
+            now = time.monotonic()
+            turn = self.engine.completed_turns
+            for conn in self._all_conns():
+                if conn._writer is None:
+                    # Mid-handshake: the attach-ack (which carries the
+                    # hb cadence and must be the peer's FIRST message)
+                    # is sent before start_writer — never overtake it.
+                    continue
+                if (conn.hb and conn.hb_unanswered >= self.HB_MISS_LIMIT
+                        and now - conn.last_rx > self.evict_secs):
+                    log.warning(
+                        "evicting unresponsive peer (silent %.1fs, %d "
+                        "beacons unanswered)", now - conn.last_rx,
+                        conn.hb_unanswered,
+                    )
+                    _METRICS.evicted.inc()
+                    self._detach(conn)
+                    # An eviction is instability evidence: nudge an
+                    # immediate checkpoint (engine 's' verb, async +
+                    # crash-atomic) so a restart after whatever killed
+                    # the peer loses at most the heartbeat deadline,
+                    # not a full autosave interval.
+                    if (self.params.autosave_turns > 0
+                            or self.params.autosave_seconds > 0):
+                        self._keys.put("s")
+                    continue
+                if now - conn.last_tx >= self.heartbeat_secs:
+                    try:
+                        if conn.binary:
+                            conn.send_raw(wire.heartbeat_to_frame(turn))
+                        else:
+                            conn.send({"t": "hb", "turn": turn})
+                    except (wire.WireError, OSError):
+                        self._detach(conn)
+                        continue
+                    _METRICS.heartbeats.inc()
+                    if conn.hb:
+                        conn.hb_unanswered += 1
 
     # --- engine → controller ---
 

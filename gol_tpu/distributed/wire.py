@@ -39,6 +39,13 @@ Message catalog:
     {"t":"ev", ...}                   one serialized Event (below)
     {"t":"detached"}                  'q' acknowledged; engine lives on
     {"t":"bye"}                       stream over (final turn or 'k')
+  either direction (liveness — docs/RESILIENCE.md):
+    {"t":"hb","turn":N}               server heartbeat, sent when a
+        peer's stream has been idle past the heartbeat interval (binary
+        peers get the raw-tag form); the client answers with a JSON
+        {"t":"hb"} pong, which is what refreshes the server's
+        idle-eviction clock. Peers that predate the frame ignore it
+        (unknown kinds are ignorable on both sides).
 """
 
 from __future__ import annotations
@@ -79,9 +86,12 @@ class WireError(ConnectionError):
     pass
 
 
-def _decompress(data: bytes, limit: int = MAX_RAW) -> bytes:
+def _decompress(data: bytes, limit: Optional[int] = None) -> bytes:
     """zlib-decompress with a hard output bound (never trusts the
-    peer's sizes — see MAX_RAW)."""
+    peer's sizes — see MAX_RAW, read at call time so the ceiling is
+    one live module attribute, not a def-time snapshot)."""
+    if limit is None:
+        limit = MAX_RAW
     d = zlib.decompressobj()
     out = d.decompress(data, limit)
     if d.unconsumed_tail:
@@ -119,14 +129,26 @@ def recv_msg(sock: socket.socket,
     the engine server's receive side (hellos, key verbs) is
     JSON-only, and refusing early means an unauthenticated peer can
     never make the server inflate a zlib payload (the bulk decoders
-    allocate up to MAX_RAW on legitimate frames)."""
+    allocate up to MAX_RAW on legitimate frames).
+
+    Sockets carrying a read deadline (settimeout — the liveness
+    discipline of docs/RESILIENCE.md) surface an *idle* expiry — zero
+    bytes of the next frame read — as TimeoutError for the caller's
+    heartbeat logic to judge; a deadline that expires MID-frame is a
+    broken peer, not idleness, and raises WireError (resuming a
+    half-read frame is impossible — the stream position is lost)."""
     header = _recv_exact(sock, _LEN.size, allow_eof=True)
     if header is None:
         return None
     (n,) = _LEN.unpack(header)
     if n > MAX_FRAME:
         raise WireError(f"frame too large: {n} bytes")
-    payload = _recv_exact(sock, n, allow_eof=False)
+    try:
+        payload = _recv_exact(sock, n, allow_eof=False)
+    except TimeoutError:
+        raise WireError(
+            "receive deadline expired mid-frame (header without payload)"
+        ) from None
     if payload[:1] == b"{":
         try:
             return json.loads(payload.decode())
@@ -138,9 +160,20 @@ def recv_msg(sock: socket.socket,
 
 
 def _recv_exact(sock: socket.socket, n: int, allow_eof: bool) -> Optional[bytes]:
+    """THE raw-socket read primitive of the wire plane (the
+    blocking-io-timeout analysis check pins that: every other read in
+    gol_tpu/distributed goes through recv_msg, whose sockets carry a
+    deadline). A read deadline expiring with zero bytes buffered is
+    clean idleness and propagates as TimeoutError; expiring mid-frame
+    means the stream position is lost and raises WireError."""
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except TimeoutError:
+            if not buf:
+                raise
+            raise WireError("receive deadline expired mid-frame") from None
         if not chunk:
             if allow_eof and not buf:
                 return None
@@ -153,11 +186,12 @@ def _recv_exact(sock: socket.socket, n: int, allow_eof: bool) -> Optional[bytes]
 
 #: Frame tags (first payload byte). JSON payloads start with '{'
 #: (0x7b), so any tag < 0x20 is unambiguous.
-_TAG_FLIPS, _TAG_BOARD, _TAG_FINAL, _TAG_LFLIPS = 1, 2, 3, 4
+_TAG_FLIPS, _TAG_BOARD, _TAG_FINAL, _TAG_LFLIPS, _TAG_HB = 1, 2, 3, 4, 5
 _FLIPS_HDR = struct.Struct("<BQ")       # tag, turn
 _BOARD_HDR = struct.Struct("<BQIIQ")    # tag, turn, width, height, token
 _FINAL_HDR = struct.Struct("<BQ")       # tag, turn
 _LFLIPS_HDR = struct.Struct("<BQI")     # tag, turn, coords-blob bytes
+_HB_HDR = struct.Struct("<BQ")          # tag, turn (liveness beacon)
 
 
 def _coords_to_frame(hdr: struct.Struct, tag: int, turn: int,
@@ -195,6 +229,13 @@ def level_flips_to_frame(turn: int, cells, levels) -> bytes:
     cz = zlib.compress(coords.tobytes(), 1)
     return (_LFLIPS_HDR.pack(_TAG_LFLIPS, turn, len(cz))
             + cz + zlib.compress(lv.tobytes(), 1))
+
+
+def heartbeat_to_frame(turn: int) -> bytes:
+    """The server's liveness beacon as a raw binary frame (9 bytes on
+    the wire) — carries the committed turn so an idle-attached client
+    can still show progress. JSON peers get `{"t":"hb","turn":N}`."""
+    return _HB_HDR.pack(_TAG_HB, turn)
 
 
 def _coords_from(blob: bytes) -> np.ndarray:
@@ -249,6 +290,9 @@ def _parse_frame_inner(payload: bytes) -> dict:
                 f"{len(coords)} cells vs {len(lv)} levels in frame"
             )
         return {"t": "flips", "turn": turn, "coords": coords, "levels": lv}
+    if tag == _TAG_HB:
+        _, turn = _HB_HDR.unpack_from(payload)
+        return {"t": "hb", "turn": turn}
     # Unknown tags pass through as an ignorable kind (forward compat,
     # like unknown JSON "t" values).
     return {"t": f"bin{tag}"}

@@ -1,0 +1,29 @@
+"""gol_tpu.testing — deterministic fault injection for the wire plane.
+
+Production code imports this lazily and only consults it when
+`GOL_TPU_FAULTS` is set (or a plan was installed programmatically), so
+the package costs nothing on the happy path. See `faults.py` for the
+spec grammar and the FaultySocket wrapper.
+"""
+
+from gol_tpu.testing.faults import (
+    FaultPlan,
+    FaultRule,
+    FaultSpecError,
+    FaultySocket,
+    active_plan,
+    clear,
+    install,
+    wrap,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FaultRule",
+    "FaultSpecError",
+    "FaultySocket",
+    "active_plan",
+    "clear",
+    "install",
+    "wrap",
+]

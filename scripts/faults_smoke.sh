@@ -1,0 +1,135 @@
+#!/usr/bin/env bash
+# Fault-tolerance smoke (ISSUE 3 acceptance): boot a REAL engine server,
+# attach a REAL --connect controller, SIGKILL the server mid-run, restart
+# it with --resume latest on the same port, and assert
+#   (a) the controller auto-reconnects (backoff + re-handshake + resync)
+#       and exits 0 when the resumed run completes, and
+#   (b) the resumed run's final board is bit-identical to a straight,
+#       never-killed run of the same total turn count.
+# Exercises the full production path (cli -> EngineServer heartbeats ->
+# Controller supervision -> checkpoint discovery) — no pytest, no mocks.
+#
+# Usage: scripts/faults_smoke.sh   (CPU-safe; ~60-90s)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=$(mktemp -d)
+OUT="$WORK/out"
+REF="$WORK/ref"
+SRV_LOG="$WORK/server.log"
+CLI_LOG="$WORK/client.log"
+mkdir -p "$OUT" "$REF"
+SRV_PID=""
+CLI_PID=""
+cleanup() {
+    [ -n "$SRV_PID" ] && kill -9 "$SRV_PID" 2>/dev/null || true
+    [ -n "$CLI_PID" ] && kill -9 "$CLI_PID" 2>/dev/null || true
+    wait 2>/dev/null || true
+    rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+PORT=$(python - <<'EOF'
+import socket
+s = socket.create_server(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+EOF
+)
+
+# chunk 1 paces the engine at the wire's speed: the point here is the
+# failover choreography, not throughput (an unpaced 64x64 engine runs
+# orders of magnitude faster than a controller can drain, and the
+# server's overflow policy would detach the peer by design).
+COMMON=(python -m gol_tpu -w 64 -h 64 -t 1 -noVis --platform cpu
+        --chunk 1 --images fixtures/images)
+
+fail() { echo "faults smoke: FAILED — $1" >&2; shift
+         for f in "$@"; do echo "--- $f:" >&2; tail -40 "$f" >&2; done
+         exit 1; }
+
+latest_turn() {
+    python - "$OUT" <<'EOF'
+import sys
+from gol_tpu.checkpoint import latest_snapshot, snapshot_turn
+snap = latest_snapshot(sys.argv[1], 64, 64)
+print(snapshot_turn(snap) if snap else -1)
+EOF
+}
+
+# --- phase 1: an "infinite" served run with a live controller -------------
+"${COMMON[@]}" -turns 1000000000 --autosave-turns 40 --hb-secs 0.5 \
+    --out "$OUT" --serve "127.0.0.1:$PORT" >"$SRV_LOG" 2>&1 &
+SRV_PID=$!
+
+# The listener takes a jax import to come up; only dial once it is.
+for _ in $(seq 1 600); do
+    grep -q "engine serving on" "$SRV_LOG" && break
+    kill -0 "$SRV_PID" 2>/dev/null || fail "server died during startup" "$SRV_LOG"
+    sleep 0.2
+done
+grep -q "engine serving on" "$SRV_LOG" || fail "server never bound" "$SRV_LOG"
+
+"${COMMON[@]}" --connect "127.0.0.1:$PORT" --reconnect-secs 120 \
+    --out "$WORK/cli-out" >"$CLI_LOG" 2>&1 &
+CLI_PID=$!
+
+# The kill is only meaningful with the controller actually attached
+# and streaming (its event prints prove the full path is live).
+for _ in $(seq 1 600); do
+    grep -q "Completed Turns" "$CLI_LOG" && break
+    kill -0 "$CLI_PID" 2>/dev/null || fail "client died during attach" "$CLI_LOG"
+    sleep 0.2
+done
+grep -q "Completed Turns" "$CLI_LOG" || fail "client never streamed" "$CLI_LOG"
+
+# Kill without warning once at least two checkpoints exist.
+for _ in $(seq 1 600); do
+    kill -0 "$SRV_PID" 2>/dev/null || fail "server died early" "$SRV_LOG"
+    T=$(latest_turn)
+    [ "$T" -ge 80 ] && break
+    sleep 0.2
+done
+[ "$T" -ge 80 ] || fail "no second checkpoint within 120s" "$SRV_LOG"
+kill -9 "$SRV_PID"
+wait "$SRV_PID" 2>/dev/null || true
+SRV_PID=""
+echo "faults smoke: server SIGKILLed with latest checkpoint at turn $T"
+
+RESUME_TURN=$(latest_turn)
+# Enough post-restart runway for the controller's backoff loop to ride
+# out the restart (jax import + bind) and stream a while before the end.
+TOTAL=$((RESUME_TURN + 2000))
+
+# --- phase 2: crash-restart from the checkpoint, same port ----------------
+"${COMMON[@]}" -turns "$TOTAL" --autosave-turns 40 --hb-secs 0.5 \
+    --out "$OUT" --resume latest --serve "127.0.0.1:$PORT" \
+    >"$WORK/server2.log" 2>&1 &
+SRV_PID=$!
+
+# The controller must ride the restart: reconnect, resync, and exit 0
+# when the resumed run completes.
+CLI_RC=0
+for _ in $(seq 1 1200); do
+    if ! kill -0 "$CLI_PID" 2>/dev/null; then break; fi
+    sleep 0.2
+done
+kill -0 "$CLI_PID" 2>/dev/null && fail "client never finished" "$CLI_LOG" "$WORK/server2.log"
+wait "$CLI_PID" || CLI_RC=$?
+CLI_PID=""
+[ "$CLI_RC" -eq 0 ] || fail "client exited $CLI_RC" "$CLI_LOG" "$WORK/server2.log"
+grep -q "reconnected" "$CLI_LOG" || fail "client never reconnected" "$CLI_LOG"
+wait "$SRV_PID" || fail "resumed server exited nonzero" "$WORK/server2.log"
+SRV_PID=""
+grep -q "error" "$WORK/server2.log" && fail "resumed server logged an error" "$WORK/server2.log"
+[ -f "$OUT/64x64x$TOTAL.pgm" ] || fail "no final board at turn $TOTAL" "$WORK/server2.log"
+
+# --- reference: the same total turns, never killed ------------------------
+"${COMMON[@]}" -turns "$TOTAL" --out "$REF" >"$WORK/ref.log" 2>&1 \
+    || fail "reference run failed" "$WORK/ref.log"
+cmp -s "$OUT/64x64x$TOTAL.pgm" "$REF/64x64x$TOTAL.pgm" \
+    || fail "resumed final board differs from the never-killed run" \
+            "$WORK/server2.log"
+
+echo "faults smoke: OK (killed at >=$T, resumed from $RESUME_TURN, client" \
+     "reconnected, final board at turn $TOTAL bit-identical to straight run)"

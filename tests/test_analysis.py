@@ -158,6 +158,73 @@ def test_lint_reports_unparseable_file(tmp_path):
     assert [f.check for f in findings] == ["parse-error"]
 
 
+_BLOCKING_IO_SNIPPET = """
+    import socket
+    from gol_tpu.distributed import wire
+
+    def raw_read(sock):
+        return sock.recv(4)
+
+    def undeadlined_dial():
+        return socket.create_connection(("engine", 8030))
+
+    def undeadlined_stream(conn):
+        return wire.recv_msg(conn.sock)
+"""
+
+
+def test_detects_blocking_io_in_distributed(tmp_path):
+    """blocking-io-timeout (ISSUE 3): raw recv outside the wire
+    primitive, deadline-less create_connection, and recv_msg on a
+    socket the module never deadlines are all flagged — but only
+    under gol_tpu/distributed/ (the wire plane's rule, not a global
+    style law)."""
+    findings = _lint_snippet(tmp_path, _BLOCKING_IO_SNIPPET,
+                             name="peer.py",
+                             subdir="gol_tpu/distributed")
+    assert [f.check for f in findings] == ["blocking-io-timeout"] * 3
+    msgs = " ".join(f.message for f in findings)
+    assert "wire read primitive" in msgs
+    assert "create_connection" in msgs
+    assert "read deadline" in msgs
+    # Same code outside the wire plane: no findings.
+    assert _lint_snippet(tmp_path, _BLOCKING_IO_SNIPPET,
+                         name="peer.py", subdir="tools") == []
+
+
+def test_blocking_io_accepts_deadlined_sockets(tmp_path):
+    """The compliant shapes: a timeout'd connect, a settimeout (or
+    SO_RCVTIMEO) applied to the socket's chain tail anywhere in the
+    module, and accept() on the close-driven listener are all clean;
+    settimeout(None) does NOT count as a deadline."""
+    assert _lint_snippet(tmp_path, """
+        import socket
+        import struct
+        from gol_tpu.distributed import wire
+
+        def dial(host):
+            sock = socket.create_connection((host, 8030), timeout=30.0)
+            sock.settimeout(5.0)
+            return wire.recv_msg(sock)
+
+        def reader(conn):
+            conn.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO,
+                                 struct.pack("ll", 30, 0))
+            return wire.recv_msg(conn.sock)
+
+        def accept_loop(listener):
+            return listener.accept()  # close-driven lifecycle: exempt
+    """, name="good.py", subdir="gol_tpu/distributed") == []
+    findings = _lint_snippet(tmp_path, """
+        from gol_tpu.distributed import wire
+
+        def reader(sock):
+            sock.settimeout(None)  # explicit blocking is NOT a deadline
+            return wire.recv_msg(sock)
+    """, name="nodeadline.py", subdir="gol_tpu/distributed")
+    assert [f.check for f in findings] == ["blocking-io-timeout"]
+
+
 # --- allowlist machinery + the tier-1 repo gate ---
 
 

@@ -103,6 +103,64 @@ def test_latest_snapshot_ignores_foreign_and_tmp(tmp_path):
     assert latest_snapshot(tmp_path / "missing", 64, 64) is None
 
 
+def test_latest_snapshot_mixed_geometry_directory(tmp_path):
+    """Resume discovery in a shared out/ dir: only the requested
+    geometry competes, per geometry independently."""
+    for name in ("64x64x100.pgm", "64x64x300.pgm", "128x128x500.pgm",
+                 "128x128x50.pgm", "64x128x900.pgm", "128x64x900.pgm"):
+        (tmp_path / name).write_bytes(b"x")
+    assert latest_snapshot(tmp_path, 64, 64).endswith("64x64x300.pgm")
+    assert latest_snapshot(tmp_path, 128, 128).endswith("128x128x500.pgm")
+    # Width/height are not interchangeable (<W>x<H>x<T>.pgm order).
+    assert latest_snapshot(tmp_path, 64, 128).endswith("64x128x900.pgm")
+    assert latest_snapshot(tmp_path, 128, 64).endswith("128x64x900.pgm")
+    assert latest_snapshot(tmp_path, 256, 256) is None
+
+
+def test_latest_snapshot_turn_tie_is_deterministic(tmp_path):
+    """Two names encoding the same turn (zero padding) must resolve the
+    same way on every run — os.listdir order is arbitrary, so the
+    sorted sweep keeps the lexicographically first name."""
+    (tmp_path / "64x64x100.pgm").write_bytes(b"x")
+    (tmp_path / "64x64x0100.pgm").write_bytes(b"y")
+    for _ in range(5):
+        best = latest_snapshot(tmp_path, 64, 64)
+        assert best.endswith("64x64x0100.pgm")  # '0' < '1'
+        assert snapshot_turn(best) == 100
+
+
+def test_latest_snapshot_in_flight_tmp_names_invisible(tmp_path):
+    """Every shape the atomic writer uses for in-flight bytes stays
+    invisible — a crash mid-write must never offer a truncated board."""
+    (tmp_path / ".64x64x500.pgm.tmp").write_bytes(b"x")
+    (tmp_path / "64x64x500.pgm.tmp").write_bytes(b"x")
+    (tmp_path / ".64x64x500.pgm").write_bytes(b"x")
+    assert latest_snapshot(tmp_path, 64, 64) is None
+    (tmp_path / "64x64x10.pgm").write_bytes(b"x")
+    assert latest_snapshot(tmp_path, 64, 64).endswith("64x64x10.pgm")
+
+
+def test_latest_snapshot_unreadable_dir_is_none(tmp_path):
+    """An unreadable directory is 'no checkpoint', never an exception:
+    resume discovery runs on freshly crashed trees with whatever
+    permissions the crash left behind."""
+    # A file where a directory was expected is survivable everywhere.
+    f = tmp_path / "afile"
+    f.write_bytes(b"x")
+    assert latest_snapshot(f, 64, 64) is None
+    locked = tmp_path / "locked"
+    locked.mkdir()
+    (locked / "64x64x100.pgm").write_bytes(b"x")
+    locked.chmod(0o000)
+    try:
+        if os.access(locked, os.R_OK):
+            pytest.skip("running as a CAP_DAC_OVERRIDE user; chmod "
+                        "cannot make the dir unreadable")
+        assert latest_snapshot(locked, 64, 64) is None
+    finally:
+        locked.chmod(0o755)
+
+
 @pytest.mark.slow
 def test_kill9_server_resumes_exactly(golden_root, tmp_path):
     """The headline fault experiment (ref: README.md:261-265): a live

@@ -1,0 +1,501 @@
+"""Fault-tolerant distributed sessions (docs/RESILIENCE.md): the
+liveness plane (heartbeats, idle eviction), client auto-reconnect with
+BoardSync resume, the ConnectionLost surface, and the deterministic
+fault-injection harness (gol_tpu.testing.faults) that makes every
+failure mode above a reproducible test instead of a hope.
+
+Runtime invariants are forced ON for the whole module and any
+violation fails the test — injected faults must never corrupt the
+event stream the checkers pin.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu.distributed import (
+    ConnectionLost,
+    Controller,
+    EngineClient,
+    EngineServer,
+)
+from gol_tpu.distributed import wire
+from gol_tpu.distributed.server import _Conn
+from gol_tpu.events import CellFlipped, FinalTurnComplete, TurnComplete
+from gol_tpu.io.pgm import read_pgm
+from gol_tpu.params import Params
+from gol_tpu.testing import FaultPlan, FaultSpecError, faults
+from gol_tpu.visual.board import NumpyBoard
+
+
+@pytest.fixture(autouse=True)
+def _invariant_violation_guard(monkeypatch):
+    """Same contract as test_distributed: invariants ON, any violation
+    (even one swallowed by a daemon thread) fails through the registry
+    counter — injected faults must not break the protocol."""
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.analysis.invariants import violations_total
+
+    before = violations_total()
+    yield
+    grew = violations_total() - before
+    assert grew == 0, (
+        f"gol_tpu_invariant_violations_total grew by {grew}: an injected "
+        "fault corrupted the distributed protocol"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def make_server(golden_root, tmp_path, **kw):
+    defaults = dict(
+        turns=100, threads=2, image_width=64, image_height=64,
+        image_dir=str(golden_root / "images"), out_dir=str(tmp_path / "out"),
+        tick_seconds=60.0, chunk=2,
+    )
+    server_kw = {
+        k: kw.pop(k) for k in ("heartbeat_secs", "evict_secs")
+        if k in kw
+    }
+    defaults.update(kw)
+    return EngineServer(Params(**defaults), port=0, **server_kw)
+
+
+def fast_reconnect(seed=7, **kw):
+    """Deterministic, test-speed backoff schedule."""
+    out = dict(reconnect_seed=seed, backoff_base=0.02, backoff_cap=0.25,
+               reconnect_window=30.0)
+    out.update(kw)
+    return out
+
+
+# --- fault harness unit tests ---
+
+
+def test_fault_spec_parses_and_rejects():
+    plan = FaultPlan.parse("client:reset@recv:40;server:delay@send:3:0.25")
+    assert len(plan.rules) == 2
+    r0, r1 = plan.rules
+    assert (r0.role, r0.kind, r0.op, r0.nth) == ("client", "reset", "recv", 40)
+    assert (r1.role, r1.kind, r1.arg) == ("server", "delay", 0.25)
+    for bad in ("nonsense", "client:reset@recv:0", "martian:reset@recv:1",
+                "client:warp@recv:1", "client:dup@recv:1", "client:reset@io:1",
+                ""):
+        with pytest.raises(FaultSpecError):
+            FaultPlan.parse(bad)
+
+
+def test_fault_wrap_is_passthrough_without_plan():
+    a, b = socket.socketpair()
+    try:
+        assert faults.wrap("client", a) is a  # no plan: zero overhead
+        faults.install(FaultPlan.parse("server:reset@recv:1"))
+        assert faults.wrap("client", a) is a  # plan names the OTHER role
+        assert faults.wrap("server", b) is not b
+    finally:
+        a.close()
+        b.close()
+
+
+def test_faulty_socket_reset_fires_at_exact_nth_op():
+    faults.install(FaultPlan.parse("client:reset@send:3"))
+    a, b = socket.socketpair()
+    fa = faults.wrap("client", a)
+    try:
+        wire.send_msg(fa, {"t": "key", "key": "p"})   # op 1
+        wire.send_msg(fa, {"t": "key", "key": "s"})   # op 2
+        assert wire.recv_msg(b)["key"] == "p"
+        assert wire.recv_msg(b)["key"] == "s"
+        with pytest.raises(ConnectionResetError):      # op 3: injected
+            wire.send_msg(fa, {"t": "key", "key": "q"})
+        # The peer sees the link die (RST on TCP; a plain close on the
+        # AF_UNIX pair used here) — never the swallowed frame.
+        try:
+            assert wire.recv_msg(b) is None
+        except (wire.WireError, OSError):
+            pass
+    finally:
+        fa.close()
+        b.close()
+
+
+def test_faulty_socket_dup_and_partial():
+    faults.install(FaultPlan.parse("client:dup@send:1"))
+    a, b = socket.socketpair()
+    fa = faults.wrap("client", a)
+    try:
+        wire.send_msg(fa, {"t": "hb"})
+        assert wire.recv_msg(b) == {"t": "hb"}
+        assert wire.recv_msg(b) == {"t": "hb"}  # duplicated frame
+    finally:
+        fa.close()
+        b.close()
+
+    faults.clear()
+    faults.install(FaultPlan.parse("client:partial@send:1"))
+    a, b = socket.socketpair()
+    fa = faults.wrap("client", a)
+    try:
+        with pytest.raises(ConnectionResetError):
+            wire.send_msg(fa, {"t": "key", "key": "p"})
+        with pytest.raises((wire.WireError, OSError)):
+            # Truncated frame then reset: never a clean message.
+            assert wire.recv_msg(b) is not None
+    finally:
+        fa.close()
+        b.close()
+
+
+def test_fault_env_spec_activates(monkeypatch):
+    faults.clear()
+    monkeypatch.setenv("GOL_TPU_FAULTS", "server:delay@recv:1:0.01")
+    plan = faults.active_plan()
+    assert plan is not None and plan.rules[0].role == "server"
+    # Same spec → same (already-counting) plan; changed spec → fresh.
+    assert faults.active_plan() is plan
+    monkeypatch.setenv("GOL_TPU_FAULTS", "client:delay@recv:1:0.01")
+    assert faults.active_plan() is not plan
+
+
+# --- the headline acceptance scenario ---
+
+
+def test_seeded_reset_reconnect_resync_bit_identical(golden_root, tmp_path):
+    """ISSUE 3 acceptance: a seeded fault plan resets the client socket
+    mid-stream; the client reconnects within its backoff budget,
+    resyncs via BoardSync, and the final reconstructed board is
+    bit-identical to a fault-free run (the golden 64x64x100 fixture) —
+    with invariant checkers ON and zero violations (module fixture)."""
+    faults.install(FaultPlan.parse("client:reset@recv:40"))
+    server = make_server(golden_root, tmp_path, chunk=1,
+                         heartbeat_secs=0.5).start()
+    ctl = Controller(*server.address, want_flips=True, **fast_reconnect())
+    board = NumpyBoard(64, 64)
+    final = None
+    for ev in ctl.events:
+        if isinstance(ev, CellFlipped):
+            board.flip(ev.cell.x, ev.cell.y)
+        elif isinstance(ev, FinalTurnComplete):
+            final = ev
+    assert ctl.reconnects >= 1, "the injected reset never triggered"
+    assert final is not None and final.completed_turns == 100
+    golden = read_pgm(golden_root / "check" / "images" / "64x64x100.pgm")
+    np.testing.assert_array_equal(board._px, np.asarray(golden) != 0)
+    assert {(c.x, c.y) for c in final.alive} == {
+        (x, y) for y, x in zip(*np.nonzero(np.asarray(golden)))
+    }
+    assert server.wait(30)
+    ctl.close()
+
+
+def test_reset_reconnect_batch_mode_converges(golden_root, tmp_path):
+    """Same scenario through the vectorized FlipBatch consumer path
+    (the visualiser's contract): the reattach sync diffs against the
+    client's tracked shadow raster, so the correction burst lands the
+    consumer exactly on the golden board — nothing doubled, nothing
+    missed."""
+    from gol_tpu.events import FlipBatch
+
+    faults.install(FaultPlan.parse("client:reset@recv:60"))
+    server = make_server(golden_root, tmp_path, chunk=1,
+                         heartbeat_secs=0.5).start()
+    ctl = Controller(*server.address, want_flips=True, batch=True,
+                     **fast_reconnect(seed=11))
+    board = NumpyBoard(64, 64)
+    final = None
+    for ev in ctl.events:
+        if isinstance(ev, FlipBatch):
+            board.flip_batch(ev.cells)
+        elif isinstance(ev, FinalTurnComplete):
+            final = ev
+    assert ctl.reconnects >= 1
+    assert final is not None and final.completed_turns == 100
+    golden = read_pgm(golden_root / "check" / "images" / "64x64x100.pgm")
+    np.testing.assert_array_equal(board._px, np.asarray(golden) != 0)
+    assert server.wait(30)
+    ctl.close()
+
+
+def test_reconnect_disabled_surfaces_connection_lost(golden_root, tmp_path):
+    """reconnect=False: the first injected reset is final — the client
+    parts with the explicit ConnectionLost state (lost event, state
+    'lost', send_key raises) instead of a silently closed stream."""
+    faults.install(FaultPlan.parse("client:reset@recv:20"))
+    server = make_server(golden_root, tmp_path, turns=10**9, chunk=1,
+                         heartbeat_secs=0.5).start()
+    ctl = Controller(*server.address, want_flips=True, reconnect=False)
+    for _ in ctl.events:
+        pass  # stream ends at the injected reset
+    assert ctl.lost.wait(10)
+    assert ctl.state == "lost"
+    with pytest.raises(ConnectionLost):
+        ctl.send_key("p")
+    # The engine survives its controller's death, as ever.
+    assert not server.done.is_set()
+    assert server.engine.error is None
+    server.shutdown()
+    ctl.close()
+
+
+# --- heartbeats / liveness ---
+
+
+def test_heartbeats_flow_on_idle_stream(golden_root, tmp_path):
+    """An attached-but-quiet link (no flips, huge chunk → long event
+    gaps) still carries liveness: server beacons arrive, the client
+    pongs, nobody is evicted, and the registry shows the traffic."""
+    from gol_tpu import obs
+
+    hb = obs.registry().counter(
+        "gol_tpu_server_heartbeats_total",
+        "Liveness beacons sent into idle peer streams")
+    before = hb.value
+    server = make_server(golden_root, tmp_path, turns=10**9, chunk=64,
+                         heartbeat_secs=0.1, evict_secs=1.0).start()
+    ctl = Controller(*server.address, want_flips=False, reconnect=False)
+    assert ctl.wait_sync(60)
+    # Pause the engine: the event stream goes silent, which is exactly
+    # when liveness must ride the idle gap.
+    ctl.send_key("p")
+    time.sleep(1.5)  # many beacon intervals of silence
+    assert hb.value > before, "no heartbeat rode the idle gap"
+    assert ctl.state == "connected"  # pongs kept the eviction clock fresh
+    assert ctl.reconnects == 0
+    ctl.send_key("k")  # works while paused
+    assert server.wait(60)
+    ctl.close()
+
+
+def test_client_declares_dead_server_via_heartbeat_deadline():
+    """A server that promises heartbeats (hb_secs in its ack) and then
+    goes silent is declared dead within ~3 intervals — the client's
+    read deadline fires, reconnect is off, and wait_sync/detach return
+    immediately against the lost link instead of sleeping out their
+    timeouts (the old indistinguishable-False behavior)."""
+    lis = socket.create_server(("127.0.0.1", 0))
+    addr = lis.getsockname()
+
+    def fake_server():
+        sock, _ = lis.accept()
+        sock.settimeout(10.0)
+        wire.recv_msg(sock)  # hello
+        wire.send_msg(sock, {"t": "attach-ack", "hb_secs": 0.1})
+        time.sleep(30)  # promised beacons never come
+
+    t = threading.Thread(target=fake_server, daemon=True)
+    t.start()
+    try:
+        ctl = Controller(*addr, want_flips=False, reconnect=False)
+        t0 = time.monotonic()
+        assert ctl.lost.wait(5), "silent hb server was never declared dead"
+        assert time.monotonic() - t0 < 5
+        # Immediate returns against the dead link: each call must take
+        # ~an internal poll tick, nowhere near its timeout.
+        t0 = time.monotonic()
+        assert ctl.wait_sync(timeout=60.0) is False
+        assert ctl.detach(timeout=60.0) is False
+        assert time.monotonic() - t0 < 2.0
+        assert ctl.state == "lost"
+        ctl.close()
+    finally:
+        lis.close()
+
+
+def test_server_evicts_silent_hb_peer(golden_root, tmp_path):
+    """A peer that advertised heartbeat support but never answers a
+    beacon is evicted after the deadline (freeing its driver slot);
+    the engine keeps evolving and a well-behaved controller can then
+    attach and finish the run."""
+    from gol_tpu import obs
+
+    evicted = obs.registry().counter(
+        "gol_tpu_server_peer_evicted_total",
+        "Peers evicted for missing the heartbeat deadline")
+    before = evicted.value
+    server = make_server(golden_root, tmp_path, turns=10**9, chunk=64,
+                         heartbeat_secs=0.1, evict_secs=0.4).start()
+    # Raw hb-advertising peer that reads its stream but never answers
+    # a beacon. It pauses the engine first: beacons only ride IDLE
+    # gaps (a busy-dead peer is detected by the send path instead),
+    # so the silent stream is what arms the probe → no-pong → evict
+    # chain this test pins.
+    sock = socket.create_connection(server.address, timeout=10)
+    wire.send_msg(sock, {"t": "hello", "want_flips": False, "hb": True})
+    wire.send_msg(sock, {"t": "key", "key": "p"})
+    deadline = time.monotonic() + 15
+    try:
+        while time.monotonic() < deadline:
+            sock.settimeout(1.0)
+            try:
+                if wire.recv_msg(sock) is None:
+                    break  # server closed us: evicted
+            except TimeoutError:
+                continue
+            except (wire.WireError, OSError):
+                break  # reset by eviction
+        else:
+            pytest.fail("silent peer was never evicted")
+    finally:
+        sock.close()
+    assert evicted.value > before
+    assert not server.done.is_set()
+    assert server.engine.error is None
+    # The slot is free again: a pong-answering controller attaches
+    # (syncs are serviced even while paused) and kills the run.
+    ctl = Controller(*server.address, want_flips=False, reconnect=False)
+    assert ctl.wait_sync(60)
+    ctl.send_key("k")
+    assert server.wait(60)
+    ctl.close()
+
+
+def test_legacy_peer_without_hb_is_never_evicted(golden_root, tmp_path):
+    """A hello WITHOUT the hb capability opts out of eviction: a peer
+    that sends nothing for many deadlines keeps its slot (controllers
+    send verbs rarely — that was always legal)."""
+    server = make_server(golden_root, tmp_path, turns=10**9, chunk=64,
+                         heartbeat_secs=0.1, evict_secs=0.3).start()
+    sock = socket.create_connection(server.address, timeout=10)
+    wire.send_msg(sock, {"t": "hello", "want_flips": False})  # no "hb"
+    # Pause so the stream idles (the eviction-arming condition for hb
+    # peers) — beacons flow, this peer never answers one, and it must
+    # STILL keep its slot: it never opted into the liveness contract.
+    wire.send_msg(sock, {"t": "key", "key": "p"})
+    sock.settimeout(1.0)
+    deadline = time.monotonic() + 1.5  # many eviction deadlines
+    closed = False
+    try:
+        while time.monotonic() < deadline:
+            try:
+                if wire.recv_msg(sock) is None:
+                    closed = True
+                    break
+            except TimeoutError:
+                continue
+            except (wire.WireError, OSError):
+                closed = True
+                break
+        assert not closed, "legacy quiet peer was evicted"
+        wire.send_msg(sock, {"t": "key", "key": "k"})
+    finally:
+        sock.close()
+    assert server.wait(60)
+
+
+def test_hello_timeout_frees_the_accept_thread(golden_root, tmp_path):
+    """A TCP connect that never says hello is rejected at
+    HELLO_TIMEOUT — it can no longer wedge the single accept thread
+    forever (the next controller attaches fine while the mute one is
+    still connected)."""
+    server = make_server(golden_root, tmp_path, turns=10**9,
+                         heartbeat_secs=0.0)
+    server.HELLO_TIMEOUT = 0.3
+    server.start()
+    mute = socket.create_connection(server.address, timeout=10)
+    try:
+        time.sleep(0.5)  # past the hello deadline
+        ctl = Controller(*server.address, want_flips=False,
+                         reconnect=False, timeout=5.0)
+        assert ctl.wait_sync(60)
+        ctl.send_key("k")
+        assert server.wait(60)
+        ctl.close()
+    finally:
+        mute.close()
+
+
+# --- satellite: _Conn.finish budget ---
+
+
+def test_conn_finish_default_budget_is_finish_timeout(monkeypatch):
+    """The interactive writer-flush default is FINISH_TIMEOUT (5s, the
+    DRAIN_TIMEOUT order of magnitude) — not the old 30s that let one
+    wedged writer stall a detach for half a minute."""
+    assert _Conn.FINISH_TIMEOUT == 5.0
+    a, b = socket.socketpair()
+    try:
+        conn = _Conn(a, want_flips=False)
+        seen = {}
+        monkeypatch.setattr(
+            conn, "join_writer", lambda t: seen.update(t=t)
+        )
+        conn._writer = threading.Thread(target=lambda: None)  # armed
+        conn.finish()
+        assert seen["t"] == _Conn.FINISH_TIMEOUT
+        conn.finish(timeout=1.25)
+        assert seen["t"] == 1.25
+    finally:
+        a.close()
+        b.close()
+
+
+# --- reconnect edge cases ---
+
+
+def test_reconnect_rides_out_busy_slot(golden_root, tmp_path):
+    """After a client-side reset the server may not have noticed the
+    dead driver yet — re-dials bounce off 'busy' until the slot frees.
+    The backoff loop must absorb that and still get back in."""
+    server = make_server(golden_root, tmp_path, chunk=1,
+                         heartbeat_secs=0.5).start()
+    # Hold the driver slot hostage briefly with an observer? No —
+    # observers don't take the slot. Instead: reset the client, and
+    # the reconnect races the server's own detach of the dead conn;
+    # seeded backoff retries make the race deterministic-in-outcome.
+    faults.install(FaultPlan.parse("client:reset@recv:30"))
+    ctl = Controller(*server.address, want_flips=True,
+                     **fast_reconnect(seed=3))
+    final = None
+    for ev in ctl.events:
+        if isinstance(ev, FinalTurnComplete):
+            final = ev
+    assert final is not None and final.completed_turns == 100
+    assert ctl.reconnects >= 1
+    assert server.wait(30)
+    ctl.close()
+
+
+def test_turn_stream_monotone_across_reconnect(golden_root, tmp_path):
+    """Consumers see monotone non-decreasing completed_turns across the
+    failover: the resync's TurnComplete lands at-or-after the last
+    pre-reset turn — never a rewind."""
+    faults.install(FaultPlan.parse("client:reset@recv:50"))
+    server = make_server(golden_root, tmp_path, turns=200, chunk=1,
+                         heartbeat_secs=0.5).start()
+    ctl = Controller(*server.address, want_flips=True, batch=True,
+                     **fast_reconnect(seed=5))
+    turns = []
+    for ev in ctl.events:
+        if isinstance(ev, TurnComplete):
+            turns.append(ev.completed_turns)
+    assert ctl.reconnects >= 1
+    assert turns, "no turns observed"
+    assert all(b >= a for a, b in zip(turns, turns[1:])), (
+        "turn stream rewound across reconnect"
+    )
+    assert turns[-1] == 200
+    assert server.wait(30)
+    ctl.close()
+
+
+def test_engine_client_alias_and_metrics_surface():
+    """The coursework name maps to the Controller, and the resilience
+    counters the issue names exist in the registry."""
+    from gol_tpu import obs
+
+    assert EngineClient is Controller
+    snap = obs.registry().snapshot()
+    for series in ("gol_tpu_client_reconnects_total",
+                   "gol_tpu_client_heartbeat_miss_total",
+                   "gol_tpu_server_heartbeats_total",
+                   "gol_tpu_server_peer_evicted_total",
+                   "gol_tpu_resume_turn"):
+        assert any(k.startswith(series) for k in snap), series

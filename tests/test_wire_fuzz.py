@@ -1,0 +1,265 @@
+"""Negative/fuzz coverage for the wire decoders (ISSUE 3 satellite):
+`recv_msg` and `_parse_frame` against truncated frames, oversized
+length prefixes, zlib bombs near the raw ceiling, corrupted payloads
+and unknown tags. The contract under attack input: raise WireError (or
+surface clean EOF/idle states) — NEVER hang, never OOM past the stated
+bounds, never leak a non-WireError exception that would kill an
+accept/reader thread.
+
+One deliberate exception, pinned here so nobody "fixes" it by
+accident: unknown binary tags and unknown JSON kinds are IGNORABLE
+(forward compatibility — an old peer must survive a newer server's
+frames), so they decode to a `bin<N>` placeholder rather than raising.
+"""
+
+import socket
+import struct
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from gol_tpu.distributed import wire
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+# --- truncation ---
+
+
+def test_truncated_header_and_payload_raise_or_eof():
+    # Clean close at a frame boundary: None (EOF), not an error.
+    a, b = _pair()
+    a.close()
+    assert wire.recv_msg(b) is None
+    b.close()
+
+    # Partial length header then close: mid-frame, must raise.
+    a, b = _pair()
+    a.sendall(b"\x00\x00")
+    a.close()
+    with pytest.raises(wire.WireError):
+        wire.recv_msg(b)
+    b.close()
+
+    # Full header, partial payload then close: mid-frame, must raise.
+    a, b = _pair()
+    a.sendall(struct.pack(">I", 100) + b"x" * 40)
+    a.close()
+    with pytest.raises(wire.WireError):
+        wire.recv_msg(b)
+    b.close()
+
+
+def test_oversized_length_prefix_rejected_before_allocation():
+    """A hostile 4 GiB length prefix must be rejected from the header
+    alone — fast, and without the receiver ever allocating it."""
+    a, b = _pair()
+    try:
+        a.sendall(struct.pack(">I", 0xFFFFFFFF))
+        t0 = time.monotonic()
+        with pytest.raises(wire.WireError, match="frame too large"):
+            wire.recv_msg(b)
+        assert time.monotonic() - t0 < 1.0
+        # Just past the cap is equally dead.
+        a2, b2 = _pair()
+        a2.sendall(struct.pack(">I", wire.MAX_FRAME + 1))
+        with pytest.raises(wire.WireError, match="frame too large"):
+            wire.recv_msg(b2)
+        a2.close()
+        b2.close()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_send_side_refuses_oversized_frames():
+    a, b = _pair()
+    try:
+        with pytest.raises(wire.WireError, match="frame too large"):
+            wire.send_frame(a, b"\x01" + bytes(wire.MAX_FRAME))
+    finally:
+        a.close()
+        b.close()
+
+
+# --- zlib bombs ---
+
+
+def test_decompress_bound_near_max_raw():
+    """_decompress near its ceiling: exactly-at-limit inflates, one
+    byte past raises — the peer's stated sizes are never trusted."""
+    limit = 1 << 16  # same code path as MAX_RAW, test-sized
+    blob_at = zlib.compress(bytes(limit), 1)
+    assert wire._decompress(blob_at, limit=limit) == bytes(limit)
+    blob_over = zlib.compress(bytes(limit + 1), 1)
+    with pytest.raises(wire.WireError, match="exceeds"):
+        wire._decompress(blob_over, limit=limit)
+
+
+def test_flips_frame_zlib_bomb_is_bounded():
+    """A flips frame whose zlib payload would inflate past MAX_RAW
+    must die in the decompressor, not allocate unboundedly. Built by
+    patching the ceiling down so the test never touches 512 MiB."""
+    bomb = wire._FLIPS_HDR.pack(wire._TAG_FLIPS, 1) + zlib.compress(
+        bytes(1 << 20), 9
+    )  # ~1 KiB on the wire, 1 MiB inflated
+    orig = wire.MAX_RAW
+    wire.MAX_RAW = 1 << 16
+    try:
+        with pytest.raises(wire.WireError):
+            wire._parse_frame(bomb)
+    finally:
+        wire.MAX_RAW = orig
+    # At the real ceiling the same frame is a legal (if large) decode.
+    msg = wire._parse_frame(bomb)
+    assert msg["t"] == "flips" and len(msg["coords"]) == (1 << 20) // 8
+
+
+def test_board_frame_dimension_lies_rejected():
+    world = np.zeros((64, 64), np.uint8)
+    frame = wire.board_to_frame(3, world)
+    # Header claims a tiny raster for a big payload: bounded inflate.
+    lie = wire._BOARD_HDR.pack(wire._TAG_BOARD, 3, 2, 2, 0)
+    with pytest.raises(wire.WireError):
+        wire._parse_frame(lie + frame[wire._BOARD_HDR.size:])
+    # Zero/negative/overflow dimensions die on the plausibility check.
+    for w, h in ((0, 4), (4, 0), (1 << 31, 1 << 31)):
+        hdr = wire._BOARD_HDR.pack(wire._TAG_BOARD, 3, w % (1 << 32),
+                                   h % (1 << 32), 0)
+        with pytest.raises(wire.WireError):
+            wire._parse_frame(hdr + b"x")
+
+
+# --- malformed structure ---
+
+
+def test_malformed_frames_raise_wireerror_only():
+    """Every handcrafted malformation surfaces as WireError — a bare
+    struct/zlib/ValueError here would kill the server threads whose
+    handlers only expect WireError/OSError."""
+    cases = [
+        b"",                                               # empty
+        b"\x01",                                           # bare tag
+        b"\x01\x07\x00",                                   # short header
+        wire._FLIPS_HDR.pack(wire._TAG_FLIPS, 2) + b"junkzlib",
+        wire._FLIPS_HDR.pack(wire._TAG_FLIPS, 2)
+        + zlib.compress(b"odd-len", 1),                    # %8 != 0
+        wire._LFLIPS_HDR.pack(wire._TAG_LFLIPS, 1, 10**6) + b"tiny",
+        wire._BOARD_HDR.pack(wire._TAG_BOARD, 1, 8, 8, 0) + b"notzlib",
+        wire._HB_HDR.pack(wire._TAG_HB, 0)[:-3],           # short hb
+    ]
+    for payload in cases:
+        with pytest.raises(wire.WireError):
+            wire._parse_frame(payload)
+
+
+def test_seeded_corruption_sweep_never_escapes_wireerror():
+    """200 seeded random corruptions of valid frames: each decode
+    either returns a dict or raises WireError — nothing else, and
+    nothing slow."""
+    rng = np.random.default_rng(1234)
+    cells = rng.integers(0, 64, size=(300, 2)).astype(np.int32)
+    world = (rng.integers(0, 2, size=(32, 32)) * 255).astype(np.uint8)
+    frames = [
+        wire.flips_to_frame(9, cells),
+        wire.board_to_frame(5, world, token=2),
+        wire.final_to_frame(7, cells[:50]),
+        wire.level_flips_to_frame(
+            4, cells[:100],
+            rng.integers(0, 256, size=100).astype(np.uint8)),
+        wire.heartbeat_to_frame(123),
+    ]
+    t0 = time.monotonic()
+    for i in range(200):
+        frame = bytearray(frames[i % len(frames)])
+        for _ in range(int(rng.integers(1, 4))):
+            frame[int(rng.integers(0, len(frame)))] = int(
+                rng.integers(0, 256))
+        try:
+            out = wire._parse_frame(bytes(frame))
+        except wire.WireError:
+            continue
+        assert isinstance(out, dict) and "t" in out
+    assert time.monotonic() - t0 < 30
+
+
+def test_malformed_json_raises_wireerror():
+    a, b = _pair()
+    try:
+        wire.send_frame(a, b"{broken json")
+        with pytest.raises(wire.WireError):
+            wire.recv_msg(b)
+        # Non-UTF8 inside a JSON-looking frame.
+        wire.send_frame(a, b"{\xff\xfe\x00")
+        with pytest.raises(wire.WireError):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# --- forward compatibility (the deliberate non-error) ---
+
+
+def test_unknown_tags_and_kinds_stay_ignorable():
+    """Unknown binary tags decode to an ignorable placeholder and
+    unknown JSON kinds pass through — the forward-compat contract the
+    heartbeat frame itself relies on (an old peer receiving hb frames
+    must keep working, not die)."""
+    assert wire._parse_frame(bytes([9]) + b"future")["t"] == "bin9"
+    assert wire._parse_frame(bytes([0x1F]))["t"] == "bin31"
+    a, b = _pair()
+    try:
+        wire.send_msg(a, {"t": "from-the-future", "x": 1})
+        assert wire.recv_msg(b)["t"] == "from-the-future"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_heartbeat_frame_roundtrip():
+    a, b = _pair()
+    try:
+        wire.send_frame(a, wire.heartbeat_to_frame(31337))
+        assert wire.recv_msg(b) == {"t": "hb", "turn": 31337}
+        wire.send_msg(a, {"t": "hb", "turn": 2})
+        assert wire.recv_msg(b) == {"t": "hb", "turn": 2}
+    finally:
+        a.close()
+        b.close()
+
+
+# --- read-deadline semantics (the liveness plane's wire contract) ---
+
+
+def test_idle_timeout_vs_midframe_timeout():
+    """A deadline expiring with ZERO bytes of the next frame is clean
+    idleness (TimeoutError — the caller's heartbeat logic judges it);
+    expiring mid-frame means the stream position is lost and must be
+    WireError."""
+    a, b = _pair()
+    b.settimeout(0.1)
+    try:
+        with pytest.raises(TimeoutError):
+            wire.recv_msg(b)  # idle at a boundary
+        a.sendall(b"\x00\x00")  # half a length header, then silence
+        with pytest.raises(wire.WireError, match="mid-frame"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+    a, b = _pair()
+    b.settimeout(0.1)
+    try:
+        a.sendall(struct.pack(">I", 64))  # header, no payload
+        with pytest.raises(wire.WireError, match="mid-frame"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
