@@ -386,9 +386,78 @@ def measure_diff_rate(latency: float) -> dict:
         "flips_per_turn": round(total_flips / (chunks * kd), 1),
         "board": "settled (turn 10k+)",
     }
+
+    # Tier 4: delivered COMPACT chunks on the same settled board — the
+    # engine's r6 steady-state watched path: per-turn [count, bitmap]
+    # headers plus ONE stream-compacted value buffer, fetched only up
+    # to the summed count (bucketed prefix). The value slab the sparse
+    # rows reserved per turn is gone; the link pays for actual
+    # activity.
+    if stepper.step_n_with_diffs_compact is None:
+        return {"kernel": kernel, "delivered": delivered,
+                "delivered_sparse_settled": sparse,
+                "turns_per_sec": kernel["turns_per_sec"]}
+    compact = _compact_tier(stepper, q, kd, chunks, kd * capd)
+    compact["board"] = "settled (turn 10k+)"
     return {"kernel": kernel, "delivered": delivered,
             "delivered_sparse_settled": sparse,
+            "delivered_compact_settled": compact,
             "turns_per_sec": kernel["turns_per_sec"]}
+
+
+def _compact_tier(stepper, q, kd: int, chunks: int, total_cap: int) -> dict:
+    """The ONE compact fetch+decode+accounting loop both compact tiers
+    share (single-device and ring): warm, chain `chunks` dispatches,
+    fetch headers + the used value prefix exactly as the engine does,
+    expand every turn to flip cells, tally the real link bytes."""
+    import numpy as np
+
+    from gol_tpu.ops.bitlife import unpack_np
+    from gol_tpu.parallel.stepper import (
+        compact_decode_rows,
+        compact_value_prefix,
+    )
+    from gol_tpu.utils.cell import cells_from_mask
+
+    hw = H // 32
+    fetch_vals = stepper.fetch_compact_values or compact_value_prefix
+    q2, hdr, vals, count = stepper.step_n_with_diffs_compact(
+        q, kd, total_cap
+    )  # warm
+    int(count)
+    q2, total_flips, link_bytes = q, 0, 0
+    t0 = time.perf_counter()
+    for _ in range(chunks):
+        q2, hdr, vals, count = stepper.step_n_with_diffs_compact(
+            q2, kd, total_cap
+        )
+        header = np.ascontiguousarray(np.asarray(hdr)).view(np.uint32)
+        header = header.copy()  # force materialization (lazy on axon)
+        total = int(header[:, 0].sum())
+        if total > total_cap:
+            # Activity burst past the buffer: the engine redoes such a
+            # chunk densely; the bench just reports the overflow
+            # instead of aborting the whole diff-rate capture.
+            return {"backend": stepper.name, "chunk": kd,
+                    "total_cap_words": total_cap,
+                    "overflow": f"Σcounts {total} > total_cap"}
+        v = np.asarray(fetch_vals(vals, total))
+        if v.dtype != np.uint32:
+            v = np.ascontiguousarray(v).view(np.uint32)
+        link_bytes += header.nbytes + v.nbytes
+        for words in compact_decode_rows(header, v, hw * W):
+            total_flips += len(
+                cells_from_mask(unpack_np(words.reshape(hw, W), H))
+            )
+    dt = time.perf_counter() - t0
+    return {
+        "backend": stepper.name,
+        "turns_per_sec": round(chunks * kd / dt, 1),
+        "chunk": kd,
+        "total_cap_words": total_cap,
+        "link_bytes_per_turn": round(link_bytes / (chunks * kd), 1),
+        "flips_per_turn": round(total_flips / (chunks * kd), 1),
+    }
 
 
 def _delivered_sparse(stepper, settle_turns: int = 10_000) -> dict:
@@ -442,6 +511,78 @@ def _delivered_sparse(stepper, settle_turns: int = 10_000) -> dict:
     }
 
 
+def _delivered_compact(stepper, settle_turns: int = 10_000) -> dict:
+    """Delivered turns/s of the COMPACT chunks on a settled board —
+    `_delivered_sparse`'s r6 twin (the measurement loop itself is the
+    shared `_compact_tier`)."""
+    import numpy as np
+
+    from gol_tpu.engine.distributor import DIFF_CHUNK
+
+    kd, chunks = DIFF_CHUNK, 4
+    p = stepper.put(_world(W))
+    q, _ = stepper.step_n(p, settle_turns)
+    q, diffs, count = stepper.step_n_with_diffs(q, kd)
+    int(count)
+    host = (stepper.fetch_diffs or np.asarray)(diffs)
+    host = np.asarray(host).copy()
+    max_words = max(int(np.count_nonzero(host[i])) for i in range(kd))
+    hw = H // 32
+    capd = min(max(64, 1 << (2 * max_words - 1).bit_length()), hw * W // 2)
+    out = _compact_tier(stepper, q, kd, chunks, kd * capd)
+    out["board"] = f"settled (turn {settle_turns}+)"
+    return out
+
+
+def measure_wire_delta_bytes(settle_turns: int = 10_000,
+                             turns: int = 256) -> dict:
+    """The VERDICT r5 item-7 decision, measured: per-turn wire bytes of
+    the delta-of-sparse frames vs the binary coord frames on the
+    settled 512² fixture. Byte counts are substrate-independent (the
+    encoders are pure host code over the actual flip stream), so this
+    capture is valid from any backend; the turns/s consequence rides
+    `wire_watched_512x512` vs `_coords`."""
+    import jax
+    import numpy as np
+
+    from gol_tpu.distributed import wire
+    from gol_tpu.ops.bitlife import unpack_np
+    from gol_tpu.parallel.stepper import make_stepper
+    from gol_tpu.utils.cell import xy_from_mask
+
+    stepper = make_stepper(threads=1, height=H, width=W,
+                           devices=[jax.devices()[0]])
+    q, _ = stepper.step_n(stepper.put(_world(W)), settle_turns)
+    q, diffs, count = stepper.step_n_with_diffs(q, turns)
+    int(count)
+    host = np.asarray((stepper.fetch_diffs or np.asarray)(diffs)).copy()
+    coord_bytes = delta_bytes = 0
+    prev = None
+    for i in range(turns):
+        row = host[i]
+        mask = unpack_np(row, H) if row.dtype == np.uint32 else row
+        cells = xy_from_mask(mask)
+        if len(cells) == 0:
+            continue  # no frame either way; the delta chain holds
+        coord_bytes += len(wire.flips_to_frame(i, cells))
+        bitmap, words = wire.coords_to_words(cells, W, H)
+        delta_bytes += len(wire.delta_flips_to_frame(
+            i, bitmap if prev is None else bitmap ^ prev, words
+        ))
+        prev = bitmap
+    ratio = delta_bytes / max(coord_bytes, 1)
+    return {
+        "board": f"{W}x{H} settled (turn {settle_turns}+)",
+        "turns": turns,
+        "coord_frame_bytes_per_turn": round(coord_bytes / turns, 1),
+        "delta_frame_bytes_per_turn": round(delta_bytes / turns, 1),
+        "delta_over_coords": round(ratio, 3),
+        "decision": ("productized: Controller negotiates delta by "
+                     "default" if ratio < 0.9 else
+                     "negative: coord frames kept as default"),
+    }
+
+
 def _counting_proxy(target) -> tuple:
     """Loopback TCP forwarder that counts engine->controller bytes —
     the true link cost of the watched wire, measured outside both
@@ -482,7 +623,7 @@ def _counting_proxy(target) -> tuple:
     return lsock.getsockname(), stats
 
 
-def measure_wire_watched(binary: bool = True) -> dict:
+def measure_wire_watched(binary: bool = True, delta: bool = True) -> dict:
     """The fully assembled watched product path: a real EngineServer on
     this TPU, a controller attached over loopback TCP with
     want_flips=True, delivered TurnComplete rate at the controller —
@@ -512,7 +653,7 @@ def measure_wire_watched(binary: bool = True) -> dict:
     # batch=True is the product visualiser configuration (per-turn
     # FlipBatch arrays end to end — see events.FlipBatch).
     ctl = Controller(*proxy_addr, want_flips=True, batch=True,
-                     binary=binary)
+                     binary=binary, delta=delta)
     counts: _q.Queue = _q.Queue()
 
     def drain():
@@ -543,8 +684,10 @@ def measure_wire_watched(binary: bool = True) -> dict:
     if got is None:
         return {"error": "no turns delivered within 300s"}
     turns, secs, nbytes = got
+    encoding = ("binary-delta-frames" if binary and delta
+                else "binary-frames" if binary else "compact-json")
     return {"turns_per_sec": round(turns / secs, 1), "turns": turns,
-            "encoding": "binary-frames" if binary else "compact-json",
+            "encoding": encoding,
             "link_bytes_per_turn": round(nbytes / turns, 1)}
 
 
@@ -696,17 +839,31 @@ def main() -> None:
         detail["wire_watched_512x512"] = measure_wire_watched()
     except Exception as e:
         detail["wire_watched_512x512"] = {"error": repr(e)}
-    # The binary-frame A/B: the same watched path forced onto the
-    # legacy compact (base64-inside-JSON) encodings (r5 wire change).
+    # Wire-encoding A/Bs: the same watched path forced onto binary
+    # coord frames without the delta-of-sparse chain (r6), and onto
+    # the legacy compact (base64-inside-JSON) encodings (r5).
+    try:
+        detail["wire_watched_512x512_coords"] = measure_wire_watched(
+            delta=False
+        )
+    except Exception as e:
+        detail["wire_watched_512x512_coords"] = {"error": repr(e)}
     try:
         detail["wire_watched_512x512_json"] = measure_wire_watched(
-            binary=False
+            binary=False, delta=False
         )
     except Exception as e:
         detail["wire_watched_512x512_json"] = {"error": repr(e)}
-    # Sparse delivery through the RING stepper (r5: the steady-state
-    # watched relief is no longer single-device only). 1-device ring:
-    # the same program as a multi-chip mesh.
+    # The delta-of-sparse DECISION capture (VERDICT r5 item 7): exact
+    # per-turn wire bytes of both encodings over the same settled flip
+    # stream.
+    try:
+        detail["wire_delta_sparse"] = measure_wire_delta_bytes()
+    except Exception as e:
+        detail["wire_delta_sparse"] = {"error": repr(e)}
+    # Sparse + compact delivery through the RING stepper (r5/r6: the
+    # steady-state watched relief is not single-device only). 1-device
+    # ring: the same program as a multi-chip mesh.
     try:
         from gol_tpu.models.rules import LIFE as _LIFE
         from gol_tpu.parallel.packed_halo import (
@@ -718,6 +875,17 @@ def main() -> None:
         )
     except Exception as e:
         detail["diff_ring1_512x512_sparse"] = {"error": repr(e)}
+    try:
+        from gol_tpu.models.rules import LIFE as _LIFE
+        from gol_tpu.parallel.packed_halo import (
+            packed_sharded_stepper as _ring,
+        )
+
+        detail["diff_ring1_512x512_compact"] = _delivered_compact(
+            _ring(_LIFE, [_jax.devices()[0]], H)
+        )
+    except Exception as e:
+        detail["diff_ring1_512x512_compact"] = {"error": repr(e)}
     # Balanced-split vs divisible-count packed ring parity (r5; needs
     # n devices for n shards, so it runs on the virtual CPU mesh in a
     # subprocess and reports ratios — see the probe's docstring).

@@ -350,6 +350,16 @@ def checked_stepper(stepper, name: Optional[str] = None):
             chk.sparse(world, out[0])
             return out
 
+    step_n_with_diffs_compact = None
+    if stepper.step_n_with_diffs_compact is not None:
+        def step_n_with_diffs_compact(world, k, total_cap):
+            # Compact chunks carry the same overflow-redo contract as
+            # sparse rows (the redo must re-step this exact input), so
+            # they register in the same outstanding window.
+            out = stepper.step_n_with_diffs_compact(world, k, total_cap)
+            chk.sparse(world, out[0])
+            return out
+
     wrapped = dataclasses.replace(
         stepper,
         name=f"checked-{stepper.name}",
@@ -360,6 +370,7 @@ def checked_stepper(stepper, name: Optional[str] = None):
         step_n_with_diffs=step_n_with_diffs,
         step_n_with_diffs_redo=step_n_with_diffs_redo,
         step_n_with_diffs_sparse=step_n_with_diffs_sparse,
+        step_n_with_diffs_compact=step_n_with_diffs_compact,
     )
     wrapped.checker = chk
     return wrapped

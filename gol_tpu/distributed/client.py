@@ -67,7 +67,7 @@ class _ClientMetrics:
             t: obs.counter(
                 "gol_tpu_client_messages_total",
                 "Server messages handled by kind", {"kind": t},
-            ) for t in ("board", "flips", "ev", "other")
+            ) for t in ("board", "flips", "dflips", "ev", "other")
         }
         self.reconnects = obs.counter(
             "gol_tpu_client_reconnects_total",
@@ -110,6 +110,7 @@ class Controller:
         batch: bool = False,
         binary: bool = True,
         levels: bool = False,
+        delta: bool = True,
         observe: bool = False,
         reconnect: bool = True,
         max_reconnects: Optional[int] = None,
@@ -169,9 +170,16 @@ class Controller:
         #: Heartbeat cadence the server confirmed in its attach-ack
         #: (0 = none negotiated; the read deadline stays unarmed).
         self._hb_secs = 0.0
+        #: Delta-of-sparse flips chain state (r6): the changed-word
+        #: bitmap of the last applied delta frame, reset at every
+        #: board sync (the server resets its twin when it sends one).
+        self._delta_prev: Optional[np.ndarray] = None
         hello = {"t": "hello", "want_flips": want_flips,
                  "compact": True, "binary": bool(binary),
-                 "levels": bool(levels), "hb": True}
+                 "levels": bool(levels), "hb": True,
+                 # Delta frames carry no levels, so level mode keeps
+                 # the LFLIPS encoding (negotiated OFF here).
+                 "delta": bool(delta) and bool(binary) and not levels}
         if observe:
             # Read-only attach (r5 multi-observer serving): the
             # driver slot stays free, steering verbs are rejected
@@ -358,7 +366,39 @@ class Controller:
                         self.events.put(CellFlipped(self.sync_turn, cell))
             self.events.put(TurnComplete(self.sync_turn))
             self.synced_turn = self.sync_turn
+            self._delta_prev = None  # delta chain restarts at a sync
             self.synced.set()
+            return True
+        if t == "dflips":
+            # Delta-of-sparse flips (r6): XOR the bitmap delta against
+            # the chain state FIRST — the chain must advance even for
+            # a frame the synced_turn gate then drops, or every later
+            # frame would decode against a stale bitmap.
+            if self.board is None:
+                raise wire.WireError(
+                    "delta-flips frame before any board sync"
+                )
+            h, w = self.board.shape
+            _, nb = wire.grid_words(w, h)
+            if len(msg["dbitmap"]) != nb:
+                raise wire.WireError(
+                    f"delta-flips bitmap of {len(msg['dbitmap'])} words, "
+                    f"board needs {nb}"
+                )
+            prev = (self._delta_prev if self._delta_prev is not None
+                    else np.zeros(nb, np.uint32))
+            bitmap = msg["dbitmap"] ^ prev
+            self._delta_prev = bitmap
+            turn = msg["turn"]
+            if turn <= self.synced_turn:
+                return True
+            coords = wire.words_to_coords(bitmap, msg["dwords"], w, h)
+            self._track_flips(coords, None)
+            if self._batch:
+                self.events.put(FlipBatch(turn, coords))
+            else:
+                for x, y in coords:
+                    self.events.put(CellFlipped(turn, Cell(int(x), int(y))))
             return True
         if t == "flips":
             turn, coords = wire.msg_flips_array(msg)

@@ -167,6 +167,26 @@ class _EngineMetrics:
             "gol_tpu_engine_sparse_redos_total",
             "Sparse chunks redone densely after a cap overflow",
         )
+        self.compact_chunks = obs.counter(
+            "gol_tpu_engine_compact_chunks_total",
+            "Diff chunks shipped with the variable-length compact "
+            "encoding",
+        )
+        self.compact_bytes = obs.counter(
+            "gol_tpu_engine_compact_bytes_total",
+            "Host-link bytes fetched for compact diff chunks "
+            "(headers + used value prefix)",
+        )
+        self.compact_ratio = obs.gauge(
+            "gol_tpu_engine_compact_ratio",
+            "Last compact chunk's fetched bytes over the dense packed "
+            "stack's bytes for the same turns",
+        )
+        self.compact_redos = obs.counter(
+            "gol_tpu_engine_compact_redos_total",
+            "Compact chunks redone densely after a value-buffer "
+            "overflow",
+        )
         self.throttle_stalls = obs.counter(
             "gol_tpu_engine_throttle_stalls_total",
             "Times the engine entered the event-backpressure wait",
@@ -729,12 +749,16 @@ class Engine:
         one-turn path produced (ref contract: gol/distributor.go:212-220
         via sdl_test.go:57-74). Returns the new completed-turn count.
 
-        Steady-state watched runs on a slow host link ride the SPARSE
-        encoding when the stepper offers it: once a plain chunk shows
-        the board changes few enough words per turn, subsequent chunks
-        ship [count, bitmap, word values] rows instead of full masks,
-        adapting the cap to the observed activity; a truncated row
-        (activity burst past the cap) is detected by its count and the
+        Steady-state watched runs on a slow host link ride the
+        device-compacted encodings when the stepper offers them: once a
+        plain chunk shows the board changes few enough words per turn,
+        subsequent chunks ship COMPACT chunks — per-turn [count,
+        bitmap] headers plus ONE shared stream-compacted value buffer,
+        fetched only up to the summed count, so the link pays for
+        actual activity (r6) — or, on steppers without the compact
+        entry, fixed-width sparse [count, bitmap, values] rows. Both
+        adapt the cap to observed activity; an overflow (activity
+        burst past the cap/buffer) is detected from the counts and the
         chunk is redone densely — the stream is bit-identical on every
         path."""
         return self._diff_consume(turn, self._diff_dispatch(turn))
@@ -799,8 +823,25 @@ class Engine:
             # world of the in-flight chunk.
             world = self._pending_diffs["new_world"]
         pending = {"k": k, "world_before": world, "sparse_cap": None,
-                   "tick": time.perf_counter()}
-        if self._sparse_cap is not None:
+                   "compact_cap": None, "tick": time.perf_counter()}
+        if (self._sparse_cap is not None
+                and self.stepper.step_n_with_diffs_compact is not None):
+            # Variable-length compact chunk (r6): the fetch pays for
+            # headers + actual activity, not the cap — preferred over
+            # fixed-width sparse rows whenever the stepper offers it.
+            total_cap = self._compact_total_cap(k)
+            pending["compact_cap"] = total_cap
+            _METRICS.compact_chunks.inc()
+            new_world, buf, values, count = (
+                self.stepper.step_n_with_diffs_compact(world, k, total_cap)
+            )
+            # The value buffer is NOT eagerly copied: the used prefix
+            # is unknowable until the headers land, and an async copy
+            # of the whole (total_cap,) slab would ship the very
+            # per-turn value reservation this encoding exists to
+            # avoid. Only the header stack overlaps the fan-out.
+            pending["values"] = values
+        elif self._sparse_cap is not None:
             pending["sparse_cap"] = self._sparse_cap
             _METRICS.sparse_chunks.inc()
             new_world, buf, count = self.stepper.step_n_with_diffs_sparse(
@@ -813,6 +854,22 @@ class Engine:
             start_copy()
         pending.update(new_world=new_world, buf=buf, count=count)
         return pending
+
+    def _compact_total_cap(self, k: int) -> int:
+        """Value-buffer size for the next compact chunk: the maximum
+        turns a chunk can carry times the per-turn activity cap the
+        sparse adaptation maintains (2x headroom over the observed
+        peak). Sized from the CHUNK BUDGET rather than this dispatch's
+        `k` — not to save compiles (`k` is itself a static argument of
+        the scan, so a clipped chunk recompiles either way) but so a
+        tail/autosave-clipped chunk inherits the full chunk's absolute
+        burst headroom instead of a proportionally tinier buffer that
+        a single active turn could overflow. `max(..., k)` is only a
+        guard; k never exceeds the budget by construction."""
+        budget = min(DIFF_CHUNK, self._diff_chunk_cap(False))
+        if self.p.chunk > 0:
+            budget = min(budget, self.p.chunk)
+        return max(budget, k) * self._sparse_cap
 
     def _diff_chunk_cap(self, pipelined: bool) -> int:
         """Max diff-chunk turns the device stack budget allows, from the
@@ -841,22 +898,29 @@ class Engine:
         k = pending["k"]
         new_world, count = pending["new_world"], pending["count"]
         rows = None
-        if pending["sparse_cap"] is not None:
+        encoded = (pending["sparse_cap"] is not None
+                   or pending["compact_cap"] is not None)
+        if pending["compact_cap"] is not None:
+            rows = self._decode_compact(pending)
+            if rows is None:  # Σ counts burst past the value buffer
+                _METRICS.compact_redos.inc()
+        elif pending["sparse_cap"] is not None:
             rows = self._decode_sparse(pending)
             if rows is None:  # truncated: the board burst past the cap
-                self._sparse_cap = None
                 _METRICS.sparse_redos.inc()
-                # The EXPLICIT redo entry when the stepper has one
-                # (mirrored steppers broadcast a dedicated opcode so
-                # workers re-step from their saved pre-sparse state —
-                # never inferred from object identity); plain steppers
-                # redo through the ordinary dense scan.
-                redo = (self.stepper.step_n_with_diffs_redo
-                        or self.stepper.step_n_with_diffs)
-                new_world, diffs, count = redo(pending["world_before"], k)
-                # (bit-identical to the discarded sparse result)
+        if encoded and rows is None:
+            self._sparse_cap = None
+            # The EXPLICIT redo entry when the stepper has one
+            # (mirrored steppers broadcast a dedicated opcode so
+            # workers re-step from their saved pre-dispatch state —
+            # never inferred from object identity); plain steppers
+            # redo through the ordinary dense scan.
+            redo = (self.stepper.step_n_with_diffs_redo
+                    or self.stepper.step_n_with_diffs)
+            new_world, diffs, count = redo(pending["world_before"], k)
+            # (bit-identical to the discarded encoded result)
         if rows is None:
-            if pending["sparse_cap"] is None:
+            if not encoded:
                 diffs = pending["buf"]
             host_diffs = (self.stepper.fetch_diffs or np.asarray)(diffs)
             rows = [host_diffs[i] for i in range(k)]
@@ -919,6 +983,45 @@ class Engine:
             for words in sparse_decode_rows(host, hw * w)
         ]
         self._adapt_sparse_cap(max_m)
+        return rows
+
+    def _decode_compact(self, pending: dict):
+        """Headers + used value prefix of a dispatched compact chunk ->
+        dense word rows, or None when the summed counts overran the
+        value buffer (overflow — the buffer holds dropped writes and
+        must not be trusted). The fetch is the whole point of the
+        encoding: 4k + k·nb·4 header bytes plus ~4·Σmₜ value bytes,
+        with the fixed per-turn value slab of the sparse rows gone."""
+        from gol_tpu.parallel.stepper import (
+            compact_decode_rows,
+            compact_value_prefix,
+        )
+
+        header = np.ascontiguousarray(
+            np.asarray(pending["buf"])
+        ).view(np.uint32)
+        counts = header[:, 0]
+        total = int(counts.sum())
+        if total > pending["compact_cap"]:
+            return None
+        fetch_vals = (self.stepper.fetch_compact_values
+                      or compact_value_prefix)
+        vals = np.asarray(fetch_vals(pending["values"], total))
+        if vals.dtype != np.uint32:
+            vals = np.ascontiguousarray(vals).view(np.uint32)
+        hw, w = self.p.image_height // 32, self.p.image_width
+        rows = [
+            words.reshape(hw, w)
+            for words in compact_decode_rows(header, vals, hw * w)
+        ]
+        self._adapt_sparse_cap(int(counts.max()) if counts.size else 0)
+        # Actual link cost: the header stack plus the (bucketed) value
+        # prefix that was really fetched.
+        nbytes = header.nbytes + vals.nbytes
+        _METRICS.compact_bytes.inc(nbytes)
+        dense = pending["k"] * hw * w * 4
+        if dense:
+            _METRICS.compact_ratio.set(round(nbytes / dense, 5))
         return rows
 
     def _sparse_cap_ceiling(self) -> int:

@@ -111,7 +111,7 @@ def halo_step_states_uneven(
 def _gens_ring_stepper(name, devices, step_n, put, fetch,
                        fetch_diffs=None, one_turn=None,
                        packed_diffs=False, strip=None,
-                       sparse_post=None):
+                       sparse_post=None, compact_post=None):
     """Shared Stepper assembly for the sharded gens variants (the
     _ring_stepper analog, plus the family's alive-only count and
     alive_mask). `one_turn` overrides the single-turn step the diff
@@ -157,12 +157,17 @@ def _gens_ring_stepper(name, devices, step_n, put, fetch,
         return old != new
 
     _snd = scan_diffs(one_turn or (lambda w: step_n(w, 1)[0]), _diff, count)
-    # Sparse rows for the packed rings (VERDICT r4 Missing #2): same
-    # per-turn scan, diff stripped to the canonical word layout on
-    # device, rows replicated (see packed_halo.replicate_rows).
+    # Sparse + compact rows for the packed rings (VERDICT r4 Missing
+    # #2; r6 compact chunks): same per-turn scan, diff stripped to the
+    # canonical word layout on device, outputs replicated (see
+    # packed_halo.replicate_rows / replicate_compact).
     _snd_sparse = None
+    _snd_compact = None
     if packed_diffs and one_turn is not None:
-        from gol_tpu.parallel.stepper import sparse_scan_diffs
+        from gol_tpu.parallel.stepper import (
+            compact_scan_diffs,
+            sparse_scan_diffs,
+        )
 
         def _diff_canonical(old, new):
             x = _diff(old, new)
@@ -170,6 +175,9 @@ def _gens_ring_stepper(name, devices, step_n, put, fetch,
 
         _snd_sparse = sparse_scan_diffs(
             one_turn, _diff_canonical, count, post=sparse_post
+        )
+        _snd_compact = compact_scan_diffs(
+            one_turn, _diff_canonical, count, post=compact_post
         )
     _sync = cpu_serializing_sync(devices)
 
@@ -194,6 +202,10 @@ def _gens_ring_stepper(name, devices, step_n, put, fetch,
         step_n_with_diffs_sparse=(
             None if _snd_sparse is None
             else lambda w, k, cap: _sync(_snd_sparse(w, int(k), int(cap)))
+        ),
+        step_n_with_diffs_compact=(
+            None if _snd_compact is None
+            else lambda w, k, cap: _sync(_snd_compact(w, int(k), int(cap)))
         ),
     )
 
@@ -513,12 +525,13 @@ def packed_gens_sharded_stepper(rule: GenRule, devices: list, height: int,
     def _one_turn(planes):
         return halo_step_packed_gens(planes, rule)
 
-    from gol_tpu.parallel.packed_halo import replicate_rows
+    from gol_tpu.parallel.packed_halo import replicate_compact, replicate_rows
 
     return _gens_ring_stepper(
         f"gens-packed-halo-ring-{n}", devices, step_n, put, fetch,
         fetch_diffs=spmd_fetch, one_turn=_one_turn, packed_diffs=True,
         sparse_post=replicate_rows(mesh),
+        compact_post=replicate_compact(mesh),
     )
 
 
@@ -713,10 +726,11 @@ def packed_gens_sharded_stepper_uneven(rule: GenRule, devices: list,
     def _one_turn(planes):
         return halo_step_packed_gens_balanced(planes, rule, _real())
 
-    from gol_tpu.parallel.packed_halo import replicate_rows
+    from gol_tpu.parallel.packed_halo import replicate_compact, replicate_rows
 
     return _gens_ring_stepper(
         f"gens-packed-halo-ring-uneven-{n}", devices, step_n, put, fetch,
         fetch_diffs=fetch_diffs, one_turn=_one_turn, packed_diffs=True,
         strip=_strip, sparse_post=replicate_rows(mesh),
+        compact_post=replicate_compact(mesh),
     )

@@ -132,6 +132,7 @@ _OP_PUT, _OP_STEP, _OP_STEP_N, _OP_DIFF, _OP_COUNT = 0, 1, 2, 3, 4
 _OP_FETCH_WORLD, _OP_FETCH_MASK, _OP_STOP = 5, 6, 7
 _OP_STEP_N_DIFFS, _OP_FETCH_DIFFS = 8, 9
 _OP_STEP_N_DIFFS_SPARSE, _OP_STEP_N_DIFFS_REDO = 10, 11
+_OP_STEP_N_DIFFS_COMPACT = 12
 
 
 def _bcast(value: np.ndarray) -> np.ndarray:
@@ -322,6 +323,25 @@ def spmd_stepper(inner):
             _sparse_in["in"], _sparse_in["out"] = world, out[0]
             return out
 
+    step_n_with_diffs_compact = None
+    if inner.step_n_with_diffs_compact is not None:
+        def step_n_with_diffs_compact(world, k, total_cap):
+            # Same outstanding-token discipline as the sparse entry:
+            # an overflowing compact chunk is redone through the SAME
+            # dedicated redo opcode, so the records share one slot.
+            if _sparse_in["in"] is not None \
+                    and world is not _sparse_in["out"]:
+                raise RuntimeError(
+                    "compact diffs dispatch on an unrecognized world "
+                    "while a sparse/compact dispatch is outstanding"
+                )
+            _bcast_cmd(_OP_STEP_N_DIFFS_COMPACT, int(k), int(total_cap))
+            out = inner.step_n_with_diffs_compact(
+                world, int(k), int(total_cap)
+            )
+            _sparse_in["in"], _sparse_in["out"] = world, out[0]
+            return out
+
     fetch_diffs = None
     if inner.step_n_with_diffs is not None:
         def fetch_diffs(diffs):
@@ -348,6 +368,19 @@ def spmd_stepper(inner):
         fetch_diffs=fetch_diffs,
         packed_diffs=inner.packed_diffs,
         step_n_with_diffs_sparse=step_n_with_diffs_sparse,
+        step_n_with_diffs_compact=step_n_with_diffs_compact,
+        # The compact value buffer is replicated over a mesh that spans
+        # processes: a coordinator-only device slice of it would not be
+        # addressable, so the mirror materializes the whole buffer with
+        # a plain np.asarray (no opcode, no collective — replicated
+        # arrays are locally readable on every process) and lets the
+        # host take the prefix.
+        fetch_compact_values=(
+            None if inner.step_n_with_diffs_compact is None
+            else lambda values, total: np.ascontiguousarray(
+                np.asarray(values)
+            ).view(np.uint32)
+        ),
         # Host-side traffic arithmetic, no dispatch — the mirrored ring
         # runs the same block plan, so the inner accounting holds.
         halo_cost=inner.halo_cost,
@@ -390,6 +423,15 @@ def spmd_worker_loop(inner, height: int, width: int) -> None:
             # pre-sparse state is kept for a possible overflow redo.
             pre_sparse = state
             state, _rows, _ = inner.step_n_with_diffs_sparse(
+                state, arg, arg2
+            )
+        elif op == _OP_STEP_N_DIFFS_COMPACT:
+            # Compact chunks mirror exactly like sparse rows: headers
+            # and the value buffer are replicated (the coordinator
+            # reads its local copies, no further opcode), and the
+            # pre-dispatch state is kept for a possible overflow redo.
+            pre_sparse = state
+            state, _hdr, _vals, _ = inner.step_n_with_diffs_compact(
                 state, arg, arg2
             )
         elif op == _OP_STEP_N_DIFFS_REDO:

@@ -114,14 +114,18 @@ DEEP_WORDS = 4
 
 def _strip_shape_factor(r: int) -> float:
     """Throughput discount of thin tile heights — the dependency-chain
-    wall (docs/PERF.md, the 512² study). r/(r+2.6) is the r5
-    multi-shape fit: forced-r sweeps at 2048²/8192²/16384² (r in
-    8..64, scripts/kernel_ab.py, BENCH_DETAIL kernel_ab.fit) agree on
-    c=1.9-3.1 per shape, c=2.6 jointly at 2.4% relative rms. The r4
-    single-shape constant (6) overstated the thin-strip penalty; at
-    the one config in a 104-point selection sweep where the pick
-    changes (1024-word shards 8192 wide), the refit's choice measured
-    11% faster on hardware (kernel_ab.selection_ab)."""
+    wall (docs/PERF.md, the 512² study). The production constant 2.6
+    sits between the committed r5 fits (forced-r sweeps at
+    2048²/8192²/16384², r in 8..64, scripts/kernel_ab.py): the
+    authoritative LIFE-ONLY fit is c=2.8 at 2.55% relative rms
+    (per-shape 2.1-3.4, BENCH_DETAIL kernel_ab.fit_life_only); the
+    joint fit including the gens points reads c=2.1 at 5.7% rms, but
+    plane-scaled VMEM pressure distorts the gens r-trend, so the
+    production constant follows the life-only fit. Selection is
+    insensitive between 2.1 and 2.8 — one delta in the 104-config
+    sweep — and at that one config (1024-word shards 8192 wide) the
+    choice measured 11% faster (kernel_ab.selection_ab). The r4
+    single-shape constant (6) overstated the thin-strip penalty."""
     return r / (r + 2.6)
 
 
@@ -394,6 +398,14 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
         _one_turn, lambda old, new: old ^ new, count,
         post=replicate_rows(mesh),
     )
+    # Variable-length compact chunks (r6): same per-turn scan, shared
+    # value buffer, headers + values replicated.
+    from gol_tpu.parallel.stepper import compact_scan_diffs
+
+    _snd_compact = compact_scan_diffs(
+        _one_turn, lambda old, new: old ^ new, count,
+        post=replicate_compact(mesh),
+    )
 
     _sync = cpu_serializing_sync(devices)
 
@@ -411,6 +423,9 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
         packed_diffs=True,
         step_n_with_diffs_sparse=lambda p, k, cap: _sync(
             _snd_sparse(p, int(k), int(cap))
+        ),
+        step_n_with_diffs_compact=lambda p, k, cap: _sync(
+            _snd_compact(p, int(k), int(cap))
         ),
         halo_cost=packed_ring_halo_cost(
             n, strip_words, on_tpu, force_local_pallas
@@ -477,6 +492,21 @@ def replicate_rows(mesh):
             rows, NamedSharding(mesh, P())
         )
         return new, rows, count
+
+    return post
+
+
+def replicate_compact(mesh):
+    """`post` hook for compact_scan_diffs on ring steppers: pin the
+    headers AND the shared value buffer fully replicated over `mesh`
+    (same rationale as replicate_rows — multiprocess coordinators
+    materialize both with plain np.asarray)."""
+    rep = NamedSharding(mesh, P())
+
+    def post(new, headers, values, count):
+        headers = jax.lax.with_sharding_constraint(headers, rep)
+        values = jax.lax.with_sharding_constraint(values, rep)
+        return new, headers, values, count
 
     return post
 
@@ -665,14 +695,19 @@ def packed_sharded_stepper_uneven(rule: Rule, devices: list, height: int,
         return halo_step_packed_balanced(block, rule, _real())
 
     _snd = scan_diffs(_one_turn, lambda old, new: old ^ new, count)
-    # Sparse rows over the canonical layout: the diff is stripped of
-    # padding ON DEVICE, so the encode covers exactly (H/32)*W words —
-    # the engine's decoder needs no balanced-split awareness.
-    from gol_tpu.parallel.stepper import sparse_scan_diffs
+    # Sparse/compact rows over the canonical layout: the diff is
+    # stripped of padding ON DEVICE, so the encode covers exactly
+    # (H/32)*W words — the engine's decoders need no balanced-split
+    # awareness.
+    from gol_tpu.parallel.stepper import compact_scan_diffs, sparse_scan_diffs
 
     _snd_sparse = sparse_scan_diffs(
         _one_turn, lambda old, new: _strip(old ^ new), count,
         post=replicate_rows(mesh),
+    )
+    _snd_compact = compact_scan_diffs(
+        _one_turn, lambda old, new: _strip(old ^ new), count,
+        post=replicate_compact(mesh),
     )
 
     _sync = cpu_serializing_sync(devices)
@@ -691,6 +726,9 @@ def packed_sharded_stepper_uneven(rule: Rule, devices: list, height: int,
         packed_diffs=True,
         step_n_with_diffs_sparse=lambda p, k, cap: _sync(
             _snd_sparse(p, int(k), int(cap))
+        ),
+        step_n_with_diffs_compact=lambda p, k, cap: _sync(
+            _snd_compact(p, int(k), int(cap))
         ),
         halo_cost=packed_ring_halo_cost(
             n, Sw, on_tpu, force_local_pallas, max_h=floor_words

@@ -94,13 +94,43 @@ class Stepper:
     #: (world, k) -> (world, diffs, count): the EXPLICIT sparse-overflow
     #: redo — same signature and result as `step_n_with_diffs`, but the
     #: contract is different: `world` must be the exact input of the
-    #: immediately preceding sparse call whose rows came back truncated.
-    #: The engine prefers this entry for redos so mirrored steppers can
-    #: broadcast a dedicated redo opcode instead of guessing from object
-    #: identity (a guess that would silently diverge the ring if the
-    #: dispatch pattern ever changed — ADVICE r5 #2). None = redo rides
-    #: plain `step_n_with_diffs` (single-process steppers don't care).
+    #: immediately preceding sparse/compact call whose rows came back
+    #: truncated. The engine prefers this entry for redos so mirrored
+    #: steppers can broadcast a dedicated redo opcode instead of
+    #: guessing from object identity (a guess that would silently
+    #: diverge the ring if the dispatch pattern ever changed — ADVICE
+    #: r5 #2). None = redo rides plain `step_n_with_diffs`
+    #: (single-process steppers don't care).
     step_n_with_diffs_redo: Optional[Callable] = None
+    #: (world, k, total_cap) -> (world, headers, values, count): the
+    #: VARIABLE-LENGTH diff scan (r6). Where the sparse rows above
+    #: reserve `cap` value slots for EVERY turn (a fixed-width row, so
+    #: a quiet turn still ships the whole slab), this entry prefix-sums
+    #: the per-turn changed counts on device and scatters each turn's
+    #: changed words into ONE shared (total_cap,) value buffer:
+    #:   headers: (k, 1 + total_words/32) int32 — [count, bitmap] per
+    #:            turn, no value slots;
+    #:   values:  (total_cap,) int32 — every turn's changed words,
+    #:            back to back, ascending word index within a turn.
+    #: The host fetches the headers (4k + k·nb·4 bytes), sums the
+    #: counts, and fetches only the USED value prefix (~4·Σmₜ bytes) —
+    #: the link pays for actual activity instead of the cap. Overflow
+    #: (Σmₜ > total_cap) is detected from the summed host-side counts;
+    #: the chunk is then redone densely via `step_n_with_diffs_redo`,
+    #: exactly like a truncated sparse row. Built by
+    #: `compact_scan_diffs`, decoded by `compact_decode_rows`, offered
+    #: by every packed backend (rows cover the CANONICAL word layout,
+    #: balanced splits strip padding on device; ring outputs are
+    #: replicated).
+    step_n_with_diffs_compact: Optional[Callable] = None
+    #: (values_device, total) -> host uint32 array of >= total words:
+    #: how the engine fetches the used value prefix of a compact chunk.
+    #: None = `compact_value_prefix` (pow2-bucketed device slice —
+    #: fine whenever the buffer is addressable from this process);
+    #: the SPMD mirror overrides it to materialize the replicated
+    #: buffer whole (a coordinator-only device slice on a
+    #: cross-process array would not be addressable).
+    fetch_compact_values: Optional[Callable] = None
     #: (world, k, per_turn) -> {"exchanges": int, "bytes": int}: HOST-
     #: SIDE accounting of the ring traffic one k-turn dispatch of this
     #: stepper generates — pure arithmetic over the same block plan the
@@ -216,6 +246,127 @@ def sparse_scan_diffs(step_fn, diff_fn, count_fn, post=None):
     return step_n_with_diffs_sparse
 
 
+def compact_scan_diffs(step_fn, diff_fn, count_fn, post=None):
+    """Build a `step_n_with_diffs_compact` (see the Stepper field): one
+    scanned program whose per-turn output is only the [count, bitmap]
+    header while the changed-word VALUES are stream-compacted — each
+    turn's words scattered at offset prefix_sum(counts so far) into one
+    shared (total_cap,) buffer carried through the scan. The scatter
+    needs no sort and no per-turn cap: within a turn the rank of a
+    changed word is cumsum(changed) - 1, so the target index is
+    offset + rank where changed, dropped otherwise (out-of-range
+    targets — an overflowing chunk — fall into `mode="drop"`; the host
+    detects the overflow from the summed counts and never trusts the
+    buffer). Value order is ascending word index per turn, matching
+    `compact_decode_rows`' bitmap walk.
+
+    Sharded steppers pass their shard_mapped per-turn halo step and a
+    canonical-layout diff (as for sparse_scan_diffs); the compaction
+    runs under plain jit over the sharded diff, the value buffer stays
+    unsharded, and `post` pins headers + values replicated so any
+    process can materialize them."""
+    import jax.numpy as jnp
+    from jax import lax as _lax
+
+    @functools.partial(jax.jit, static_argnames=("k", "total_cap"))
+    def step_n_with_diffs_compact(state, k, total_cap):
+        def body(carry, _):
+            q, off, buf = carry
+            new = step_fn(q)
+            d = diff_fn(q, new).reshape(-1)
+            nb = sparse_bitmap_words(d.shape[0])
+            changed = d != 0
+            padded = jnp.pad(changed, (0, nb * 32 - d.shape[0]))
+            m = jnp.sum(changed, dtype=jnp.int32)
+            bits = padded.astype(jnp.uint32).reshape(nb, 32)
+            weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+            bitmap = jnp.sum(bits * weights, axis=1, dtype=jnp.uint32)
+            rank = jnp.cumsum(changed, dtype=jnp.int32) - 1
+            target = jnp.where(changed, off + rank, jnp.int32(total_cap))
+            buf = buf.at[target].set(d, mode="drop")
+            header = jnp.concatenate([m[None].astype(jnp.uint32), bitmap])
+            return (new, off + m, buf), _lax.bitcast_convert_type(
+                header, jnp.int32
+            )
+
+        buf0 = jnp.zeros((total_cap,), jnp.uint32)
+        (new, _total, buf), headers = _lax.scan(
+            body, (state, jnp.int32(0), buf0), None, length=max(int(k), 0)
+        )
+        out = (new, headers, _lax.bitcast_convert_type(buf, jnp.int32),
+               count_fn(new))
+        return post(*out) if post is not None else out
+
+    return step_n_with_diffs_compact
+
+
+def compact_decode_rows(headers, values, total_words: int):
+    """Decode a compact chunk (see Stepper.step_n_with_diffs_compact)
+    into flat (total_words,) uint32 word arrays — the single host-side
+    decoder the engine and the bench share. `headers` is the fetched
+    (k, 1 + nb) stack viewed as uint32, `values` the (>= Σcounts,)
+    uint32 value prefix. Yields one array per turn; raises ValueError
+    on any inconsistency — a count disagreeing with its bitmap's
+    popcount, or offsets running past the supplied values — so callers
+    can reject a truncated/corrupt chunk instead of mis-attributing
+    words to turns."""
+    import numpy as _np
+
+    nb = sparse_bitmap_words(total_words)
+    if headers.ndim != 2 or headers.shape[1] != 1 + nb:
+        raise ValueError(
+            f"compact header shape {headers.shape} != (k, {1 + nb})"
+        )
+    shifts = _np.arange(32, dtype=_np.uint32)
+    off = 0
+    for t in range(headers.shape[0]):
+        m = int(headers[t, 0])
+        words = _np.zeros(nb * 32, _np.uint32)
+        bits = (headers[t, 1 : 1 + nb, None] >> shifts) & 1
+        idx = _np.flatnonzero(bits)
+        if idx.size != m:
+            raise ValueError(
+                f"compact turn {t}: bitmap pops {idx.size} words, "
+                f"count says {m}"
+            )
+        if off + m > len(values):
+            raise ValueError(
+                f"compact chunk truncated: turn {t} needs value words "
+                f"{off}..{off + m}, have {len(values)}"
+            )
+        if m:
+            words[idx] = values[off : off + m]
+        off += m
+        yield words[:total_words]
+
+
+def compact_value_bucket(total: int) -> int:
+    """Fetched-prefix length for `total` used value words: rounded up
+    to 1/8th-of-a-power-of-two granularity (floor 1024), so the
+    op-by-op slice dispatched per chunk compiles a BOUNDED set of
+    distinct shapes over a run (<=8 per octave) while wasting under
+    25% of the value bytes worst-case (12.5% at the top of each
+    octave) — a plain pow2 bucket measured a 2x hit exactly when Σm
+    sat just past a power of two (the settled 512² fixture lands
+    there)."""
+    if total <= 1024:
+        return 1024
+    step = 1 << ((total - 1).bit_length() - 3)
+    return -(-total // step) * step
+
+
+def compact_value_prefix(values, total: int):
+    """Fetch (at least) the first `total` words of a compact chunk's
+    device value buffer as host uint32 — the bucketed device slice
+    (see compact_value_bucket); only this prefix crosses the link."""
+    import numpy as _np
+
+    if total <= 0:
+        return _np.zeros(0, _np.uint32)
+    n = min(int(values.shape[0]), compact_value_bucket(total))
+    return _np.ascontiguousarray(_np.asarray(values[:n])).view(_np.uint32)
+
+
 def _single_device(rule: Rule, device=None) -> Stepper:
     dev = device or jax.devices()[0]
 
@@ -285,6 +436,11 @@ def _packed_state_stepper(name: str, rule: Rule, height: int,
         ),
         packed_diffs=True,
         step_n_with_diffs_sparse=sparse_scan_diffs(
+            lambda q: bitlife.step_packed(q, rule),
+            lambda old, new: old ^ new,
+            bitlife.count_packed,
+        ),
+        step_n_with_diffs_compact=compact_scan_diffs(
             lambda q: bitlife.step_packed(q, rule),
             lambda old, new: old ^ new,
             bitlife.count_packed,
@@ -545,6 +701,9 @@ def _gens_stepper_packed(rule: GenRule, device, height: int,
     _snd_sparse = sparse_scan_diffs(
         lambda p: bitgens.step_packed_gens(p, rule), _planes_xor, _count
     )
+    _snd_compact = compact_scan_diffs(
+        lambda p: bitgens.step_packed_gens(p, rule), _planes_xor, _count
+    )
 
     return Stepper(
         name="generations-packed-1",
@@ -560,6 +719,9 @@ def _gens_stepper_packed(rule: GenRule, device, height: int,
         packed_diffs=True,
         step_n_with_diffs_sparse=lambda p, k, cap: _sync(
             _snd_sparse(p, int(k), int(cap))
+        ),
+        step_n_with_diffs_compact=lambda p, k, cap: _sync(
+            _snd_compact(p, int(k), int(cap))
         ),
     )
 
@@ -594,7 +756,7 @@ def instrument_stepper(s: Stepper) -> Stepper:
     seconds = {}
     for entry in ("put", "fetch", "step", "step_n", "step_with_diff",
                   "step_n_with_diffs", "step_n_with_diffs_sparse",
-                  "step_n_with_diffs_redo"):
+                  "step_n_with_diffs_compact", "step_n_with_diffs_redo"):
         dispatches[entry] = obs.counter(
             "gol_tpu_stepper_dispatches_total",
             "Stepper entry invocations", {**backend, "entry": entry},
@@ -685,6 +847,11 @@ def instrument_stepper(s: Stepper) -> Stepper:
             None if s.step_n_with_diffs_sparse is None
             else _diffy("step_n_with_diffs_sparse",
                         s.step_n_with_diffs_sparse)
+        ),
+        step_n_with_diffs_compact=(
+            None if s.step_n_with_diffs_compact is None
+            else _diffy("step_n_with_diffs_compact",
+                        s.step_n_with_diffs_compact)
         ),
         step_n_with_diffs_redo=(
             None if s.step_n_with_diffs_redo is None

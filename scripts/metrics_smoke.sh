@@ -53,6 +53,8 @@ for series in \
     gol_tpu_engine_dispatches_total \
     gol_tpu_engine_turns_total \
     gol_tpu_engine_committed_turn \
+    gol_tpu_engine_compact_bytes_total \
+    gol_tpu_engine_compact_redos_total \
     gol_tpu_stepper_dispatches_total \
     gol_tpu_halo_bytes_total
 do

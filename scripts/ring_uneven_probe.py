@@ -37,8 +37,8 @@ from gol_tpu.parallel.packed_halo import (  # noqa: E402
 SIDE, TURNS, CHUNK = 512, 24_000, 2_000
 
 
-def rate(stepper) -> float:
-    world = np.asarray(random_world(SIDE, SIDE, seed=3))
+def rate(stepper, height: int = SIDE) -> float:
+    world = np.asarray(random_world(height, SIDE, seed=3))
     p = stepper.put(world)
     p, c = stepper.step_n(p, CHUNK)
     int(c)  # warm/compile
@@ -77,6 +77,19 @@ def main() -> None:
         out[f"uneven{n}_over_even4_normalized"] = round(
             u / even * sw / 4.0, 3
         )
+    # SAME-shard-count A/B (VERDICT r5 item 4): even-4 at 512² vs
+    # uneven-4 at 544 rows (17 word-rows -> 5/4/4/4). Same thread
+    # count, same substrate contention — the one comparison that
+    # isolates the split's own machinery (dynamic ghost splices,
+    # padding masks) from shard-count arithmetic. Per-word
+    # normalization: the uneven ring's per-turn critical path is its
+    # LARGEST shard (Sw=5 words vs even-4's 4) and its board is 17/16
+    # the work, so `*_normalized` rescales by Sw_uneven/Sw_even — at
+    # parity machinery the normalized ratio sits near 1.0.
+    u4 = rate(packed_sharded_stepper_uneven(LIFE, devs[:4], 544), height=544)
+    out["uneven_shards4_544_turns_per_sec"] = round(u4, 1)
+    out["uneven4_544_over_even4"] = round(u4 / even, 3)
+    out["uneven4_544_over_even4_normalized"] = round(u4 / even * 5 / 4.0, 3)
     print(json.dumps(out))
 
 

@@ -1,0 +1,116 @@
+#!/usr/bin/env bash
+# Real-libSDL2 evidence run (VERDICT r5 item 5 / Missing #1): the
+# windowed visualiser path is proven against a fake-ABI stub
+# (tests/fake_sdl.cpp); this script closes the "real library accepts
+# our ABI assumptions" gap when the host can provide genuine SDL2.
+#
+# With a real libSDL2 present it runs the full windowed lifecycle
+# (dlopen -> SDL_Init -> window/renderer/texture -> FlipPixel ->
+# RenderFrame -> PollEvent drain -> teardown) under
+# SDL_VIDEODRIVER=dummy (no display needed) and asserts the pixel
+# count the genuine SDL_UpdateTexture path rendered. Without one it
+# records the documented impossibility. EITHER WAY it writes the
+# outcome to docs/SDL_REAL.md so the evidence state is committed, not
+# implied.
+#
+# Usage: scripts/sdl_real_check.sh    (CPU-safe; ~10s)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DOC=docs/SDL_REAL.md
+STAMP=$(date -u +%Y-%m-%d)
+
+find_sdl() {
+    python3 - <<'PY'
+import ctypes, ctypes.util
+for name in ("libSDL2-2.0.so.0", "libSDL2.so", "SDL2"):
+    cand = name if name.startswith("lib") else ctypes.util.find_library(name)
+    if not cand:
+        continue
+    try:
+        lib = ctypes.CDLL(cand)
+    except OSError:
+        continue
+    # Genuine-symbol sanity: the five entry points board.cpp resolves.
+    syms = ["SDL_Init", "SDL_CreateWindow", "SDL_CreateRenderer",
+            "SDL_UpdateTexture", "SDL_PollEvent"]
+    if all(hasattr(lib, s) for s in syms):
+        print(cand)
+        break
+PY
+}
+
+LIB=$(find_sdl || true)
+
+if [ -z "$LIB" ]; then
+    cat >"$DOC" <<EOF
+# Real-libSDL2 run — documented attempt
+
+**Status ($STAMP): not possible in this image.** No genuine libSDL2 is
+installed (\`ctypes.util.find_library("SDL2")\` and the soname dlopen
+both fail) and the image has no package source to install one, so the
+windowed path cannot be bound to real SDL2 symbols here.
+
+What IS proven: the full windowed ABI conversation — dlopen + symbol
+resolution, SDL_Init → window → renderer → texture lifecycle,
+UpdateTexture ARGB pixel upload, and the hand-indexed event-union
+keycode extraction — against the logged fake-ABI stub
+(\`tests/fake_sdl.cpp\` driving \`gol_tpu/native/board.cpp\`,
+\`tests/test_sdl_stub.py\`). The residual inference is only that real
+SDL2 honors its own documented ABI for those five calls.
+
+Re-run \`scripts/sdl_real_check.sh\` on any host with libSDL2 (no
+display needed — it uses \`SDL_VIDEODRIVER=dummy\`); it will replace
+this file with the real-run evidence.
+EOF
+    echo "sdl real check: NO real libSDL2 in this image — documented in $DOC"
+    exit 0
+fi
+
+echo "sdl real check: found genuine SDL2 at $LIB"
+OUT=$(SDL_VIDEODRIVER=dummy PYTHONPATH=. python3 - <<'PY'
+import json
+from gol_tpu.visual.board import NativeBoard
+
+b = NativeBoard(8, 4, want_window=True)
+out = {"has_window": b.has_window}
+b.set(1, 1, True)   # FlipPixel path
+b.flip(5, 0)
+b.render()          # RenderFrame path (UpdateTexture + Present)
+keys = []
+for _ in range(4):  # PollEvent drain (dummy driver: no input events)
+    k = b.poll_key()
+    if k is None:
+        break
+    keys.append(k)
+out["keys"] = keys
+out["count"] = b.count()
+b.destroy()
+print(json.dumps(out))
+PY
+)
+echo "$OUT"
+python3 - "$OUT" <<'PY'
+import json, sys
+r = json.loads(sys.argv[1])
+assert r["has_window"] is True, "real SDL2 present but window not created"
+assert r["count"] == 2, r
+PY
+cat >"$DOC" <<EOF
+# Real-libSDL2 run — evidence
+
+**Status ($STAMP): PASSED against genuine SDL2** (\`$LIB\`,
+\`SDL_VIDEODRIVER=dummy\`): dlopen bound the real symbols, the
+window/renderer/texture lifecycle ran, two FlipPixel writes survived a
+RenderFrame (UpdateTexture + Present), and the PollEvent drain
+returned cleanly. Raw driver output:
+
+\`\`\`json
+$OUT
+\`\`\`
+
+(Keypress synthesis needs a display or SDL_PushEvent, which the
+frozen dlopen surface deliberately omits; the keycode-extraction ABI
+remains pinned by the logged stub in tests/test_sdl_stub.py.)
+EOF
+echo "sdl real check: OK — evidence written to $DOC"

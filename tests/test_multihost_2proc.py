@@ -99,13 +99,34 @@ if multihost.is_coordinator():
         assert int(c4) >= 0
         # The engine's sparse-overflow fallback re-steps the SAME chunk
         # densely from the sparse call's input — the one non-linear
-        # dispatch, which must ride its own redo opcode so workers
-        # replay from their saved pre-sparse state. Same turns, same
-        # board: counts agree and the run stays on the golden track.
-        p, rediffs, c5 = s.step_n_with_diffs(prev, 3)
+        # dispatch, which must ride its own DEDICATED redo opcode so
+        # workers replay from their saved pre-sparse state (the r5
+        # token validation rejects it through the plain dense entry).
+        # Same turns, same board: counts agree and the run stays on
+        # the golden track.
+        p, rediffs, c5 = s.step_n_with_diffs_redo(prev, 3)
         assert rediffs.shape[0] == 3 if hasattr(rediffs, "shape") else True
         assert int(c5) == int(c4), (int(c5), int(c4))
         extra = 3
+    if s.step_n_with_diffs_compact is not None:
+        # Mirrored COMPACT chunks (r6): (k, total_cap) ride the opcode,
+        # headers + value buffer replicate, the mirror's value fetch
+        # materializes locally, and the decoded chunk is bit-identical
+        # to the dense stack a redo from the same input produces.
+        from gol_tpu.parallel.stepper import compact_decode_rows
+        prev = p
+        p, hdr, vals, c6 = s.step_n_with_diffs_compact(prev, 2, 4096)
+        hdr = np.ascontiguousarray(np.asarray(hdr)).view(np.uint32)
+        total = int(hdr[:, 0].sum())
+        v = s.fetch_compact_values(vals, total)
+        rows = list(compact_decode_rows(hdr, v, tw * size))
+        p, rediffs, c7 = s.step_n_with_diffs_redo(prev, 2)
+        host = s.fetch_diffs(rediffs)
+        for t in range(2):
+            assert np.array_equal(rows[t].reshape(tw, size),
+                                  np.asarray(host[t])), f"compact turn {t}"
+        assert int(c7) == int(c6)
+        extra += 2
     p, count = s.step_n(p, turns // 2 - 6 - extra)
     got = s.fetch(p)
     assert np.array_equal(got, golden), "board mismatch"

@@ -263,3 +263,109 @@ def test_idle_timeout_vs_midframe_timeout():
     finally:
         a.close()
         b.close()
+
+
+# --- delta-of-sparse flips frames (r6) ---
+
+
+def test_delta_flips_roundtrip_and_order():
+    """coords -> (bitmap, words) -> frame -> parse -> coords is the
+    identity (row-major order preserved), including the empty turn."""
+    rng = np.random.default_rng(7)
+    cells = np.unique(rng.integers(0, 64, (200, 2)), axis=0).astype(np.int32)
+    bitmap, words = wire.coords_to_words(cells, 64, 64)
+    msg = wire._parse_frame(wire.delta_flips_to_frame(9, bitmap, words))
+    assert msg["t"] == "dflips" and msg["turn"] == 9
+    got = wire.words_to_coords(msg["dbitmap"], msg["dwords"], 64, 64)
+    want = cells[np.lexsort((cells[:, 0], cells[:, 1]))]
+    np.testing.assert_array_equal(got, want)
+
+    empty = wire._parse_frame(wire.delta_flips_to_frame(
+        3, *wire.coords_to_words(np.zeros((0, 2), np.int32), 64, 64)
+    ))
+    assert len(empty["dwords"]) == 0
+    assert len(wire.words_to_coords(
+        empty["dbitmap"], empty["dwords"], 64, 64)) == 0
+
+
+def test_delta_chain_matches_coord_stream_across_sync():
+    """The server-side encode chain (bitmap XORed against the previous
+    SENT turn, reset at a sync) decoded by the client-side chain
+    reproduces the exact per-turn coords — including a mid-stream
+    reset."""
+    rng = np.random.default_rng(3)
+    turns = [np.unique(rng.integers(0, 64, (rng.integers(1, 80), 2)),
+                       axis=0).astype(np.int32) for _ in range(8)]
+    _, nb = wire.grid_words(64, 64)
+    enc_prev = dec_prev = None
+    for i, cells in enumerate(turns):
+        if i == 4:  # BoardSync: both ends restart the chain
+            enc_prev = dec_prev = None
+        bitmap, words = wire.coords_to_words(cells, 64, 64)
+        frame = wire.delta_flips_to_frame(
+            i, bitmap if enc_prev is None else bitmap ^ enc_prev, words
+        )
+        enc_prev = bitmap
+        msg = wire._parse_frame(frame)
+        prev = dec_prev if dec_prev is not None else np.zeros(nb, np.uint32)
+        cur = msg["dbitmap"] ^ prev
+        dec_prev = cur
+        got = wire.words_to_coords(cur, msg["dwords"], 64, 64)
+        want = cells[np.lexsort((cells[:, 0], cells[:, 1]))]
+        np.testing.assert_array_equal(got, want, err_msg=f"turn {i}")
+
+
+def test_delta_flips_corruption_rejected():
+    """Truncated/corrupt delta frames raise WireError, never anything
+    that would kill a reader thread: blob-length lies, word-count
+    lies, popcount/word mismatches, out-of-grid bits, and implausible
+    counts."""
+    cells = np.array([[1, 1], [2, 40], [63, 63]], np.int32)
+    bitmap, words = wire.coords_to_words(cells, 64, 64)
+    frame = wire.delta_flips_to_frame(5, bitmap, words)
+
+    # Bitmap blob length overrunning the frame.
+    bad = bytearray(frame)
+    struct.pack_into("<I", bad, wire._DFLIPS_HDR.size - 4, 1 << 20)
+    with pytest.raises(wire.WireError):
+        wire._parse_frame(bytes(bad))
+
+    # Word-count lie: header says one more word than the payload has.
+    lying = wire._DFLIPS_HDR.pack(
+        wire._TAG_DFLIPS, 5, len(words) + 1,
+        len(zlib.compress(bitmap.tobytes(), 1)),
+    ) + zlib.compress(bitmap.tobytes(), 1) + zlib.compress(
+        words.tobytes(), 1)
+    with pytest.raises(wire.WireError):
+        wire._parse_frame(lying)
+
+    # Implausible count rejected before any inflation.
+    huge = wire._DFLIPS_HDR.pack(wire._TAG_DFLIPS, 5, 1 << 31, 4)
+    with pytest.raises(wire.WireError):
+        wire._parse_frame(huge + b"xxxx")
+
+    # Popcount/word mismatch surfaces at coordinate reconstruction.
+    with pytest.raises(wire.WireError):
+        wire.words_to_coords(bitmap, words[:-1], 64, 64)
+    # A set bit outside the grid.
+    big = bitmap.copy()
+    big[-1] |= np.uint32(1) << 31
+    with pytest.raises(wire.WireError):
+        wire.words_to_coords(big, np.append(words, np.uint32(1)), 64, 64)
+    # A mask bit past the board height (board of 40 rows -> 2 words,
+    # second word holds rows 32..39 only).
+    b2, w2 = wire.coords_to_words(np.array([[0, 39]], np.int32), 8, 40)
+    w2 = w2 | np.uint32(1 << 15)  # row 47 of a 40-row board
+    with pytest.raises(wire.WireError):
+        wire.words_to_coords(b2, w2, 8, 40)
+
+
+def test_delta_flips_truncated_mid_frame_rejected():
+    """A delta frame cut anywhere inside either zlib blob raises
+    WireError (the seeded-corruption discipline of the other frames)."""
+    rng = np.random.default_rng(11)
+    cells = np.unique(rng.integers(0, 64, (50, 2)), axis=0).astype(np.int32)
+    frame = wire.delta_flips_to_frame(2, *wire.coords_to_words(cells, 64, 64))
+    for cut in (wire._DFLIPS_HDR.size + 1, len(frame) - 3):
+        with pytest.raises(wire.WireError):
+            wire._parse_frame(frame[:cut])
