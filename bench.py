@@ -728,7 +728,14 @@ def metrics_capture() -> dict:
     for k in list(phases):
         if isinstance(phases[k], float):
             phases[k] = round(phases[k], 4)
-    return {"phases": phases, "snapshot": snap}
+    # Span-tracer accounting (r7): how much of the run's session
+    # timeline the ring retained — a future `bench_compare` between two
+    # captures flags a tracer that suddenly drops most of its window.
+    from gol_tpu.obs import tracing
+
+    trace = {"recorded": tracing.TRACER.recorded,
+             "dropped": tracing.TRACER.dropped}
+    return {"phases": phases, "snapshot": snap, "trace": trace}
 
 
 def expected_alive() -> int | None:

@@ -1,15 +1,19 @@
-"""obs-in-jit — metrics calls inside traced functions.
+"""obs-in-jit — metrics/span/flight calls inside traced functions.
 
 The gol_tpu.obs contract is explicit: instrumentation is HOST-SIDE, at
 dispatch/event granularity, never inside a jit/pallas trace. A metric
 call under trace would either be baked in as a once-per-compile no-op
 (silently recording nothing per step — the worst kind of broken
-observability) or force a host callback per traced op. This check makes
-the contract machine-enforced: any call that reaches the registry —
-through the `obs` module object, a name imported from `gol_tpu.obs`, or
-a module-level metric handle assigned from one — is flagged when it
-sits in a jit context (decorated defs, scan/shard_map/fori_loop bodies,
-jitted lambdas — the same discovery every other check uses).
+observability) or force a host callback per traced op. The same holds
+for the span tracer and the flight recorder (gol_tpu.obs.tracing /
+.flight): a span enter/exit or a black-box note under trace records
+once per COMPILE — a timeline that silently shows nothing. This check
+makes the contract machine-enforced: any call that reaches the
+registry, the tracer, or the recorder — through the `obs` module
+object, a name imported from any gol_tpu.obs module, or a module-level
+handle assigned from one — is flagged when it sits in a jit context
+(decorated defs, scan/shard_map/fori_loop bodies, jitted lambdas — the
+same discovery every other check uses).
 """
 
 from __future__ import annotations
@@ -21,10 +25,18 @@ from gol_tpu.analysis.core import Finding, ModuleContext
 
 CHECK = "obs-in-jit"
 
-#: Metric mutation/construction method names — used only to flag calls
-#: on HANDLES we traced back to an obs binding, so plain `.inc()` on an
-#: unrelated object never fires.
-_OBS_MODULES = ("gol_tpu.obs", "gol_tpu.obs.registry", "gol_tpu.obs.http")
+#: The observability plane's modules — a name imported FROM any of
+#: these (or binding one) becomes a tainted root, so calls through it
+#: under trace are flagged; plain `.inc()` on an unrelated object never
+#: fires. tracing/flight joined in r7: span enter/exit and
+#: flight-recorder appends are as host-side-only as metric mutations.
+_OBS_MODULES = (
+    "gol_tpu.obs",
+    "gol_tpu.obs.registry",
+    "gol_tpu.obs.http",
+    "gol_tpu.obs.tracing",
+    "gol_tpu.obs.flight",
+)
 
 
 def _target_roots(tgt: ast.AST) -> Iterator[str]:

@@ -72,6 +72,15 @@ def violations_total() -> int:
     return int(sum(c.value for c in _VIOLATIONS.values()))
 
 
+def _flight_note(checker: str, msg: str) -> None:
+    """A violation is flight-recorder material: the black box must
+    show protocol breaches in the window before a crash, even when the
+    raising thread's traceback only lands in a log."""
+    from gol_tpu.obs import flight
+
+    flight.note("invariant.violation", checker=checker, msg=msg)
+
+
 class InvariantViolation(AssertionError):
     """A distributed-protocol invariant was observed broken."""
 
@@ -107,6 +116,7 @@ class EventStreamChecker:
 
     def _fail(self, msg: str) -> None:
         _VIOLATIONS["event-stream"].inc()
+        _flight_note("event-stream", f"[{self.source}] {msg}")
         raise InvariantViolation(f"[{self.source}] {msg}")
 
     def observe(self, ev) -> None:
@@ -243,6 +253,7 @@ class DispatchLinearityChecker:
 
     def _fail(self, msg: str) -> None:
         _VIOLATIONS["dispatch-linearity"].inc()
+        _flight_note("dispatch-linearity", f"[{self.name}] {msg}")
         raise InvariantViolation(f"[{self.name}] {msg}")
 
     def put(self, world) -> None:

@@ -233,6 +233,21 @@ def main(argv: Optional[list[str]] = None) -> int:
         multihost.spmd_worker_loop(s, args.h, args.w)
         return 0
 
+    # Observability bootstrap (docs/OBSERVABILITY.md): label this
+    # process for merged timelines, arm the flight recorder's dump
+    # directory (--out — where the checkpoints already live), and dump
+    # the black box the instant SIGTERM lands (the handler then raises
+    # KeyboardInterrupt, so every mode's ordinary graceful-shutdown
+    # path still runs). All no-ops under GOL_TPU_METRICS=0.
+    from gol_tpu.obs import flight, tracing
+
+    tracing.set_process_label(
+        "serve" if args.serve is not None
+        else "connect" if args.connect is not None else "local"
+    )
+    flight.configure(args.out)
+    flight.install_sigterm_handler()
+
     # Banner (ref: main.go:48-50).
     print("Threads:", args.t)
     print("Width:", args.w)
@@ -348,6 +363,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         # Sidecar BEFORE the engine thread: a failed port bind aborts a
         # run that hasn't started anything needing cleanup yet.
         metrics = _start_metrics(args, health=engine.health)
+        from gol_tpu.obs import flight as _flight
+
+        _flight.set_state_provider(engine.health)
         engine.start()
         try:
             if args.novis:
@@ -421,6 +439,9 @@ def _serve(args, params: Params, resume_path: Optional[str] = None) -> int:
     # after start would skip the shutdown path and strand multi-host
     # workers waiting for their next opcode).
     metrics = _start_metrics(args, health=server.health)
+    from gol_tpu.obs import flight as _flight
+
+    _flight.set_state_provider(server.health)
     server.start()
     try:
         while not server.wait(timeout=1.0):
@@ -496,6 +517,9 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
         # Inside the try: a failed sidecar bind must still detach the
         # controller (ctl.close() in the finally frees the driver slot).
         metrics = _start_metrics(args, health=_ctl_health)
+        from gol_tpu.obs import flight as _flight
+
+        _flight.set_state_provider(_ctl_health)
         if args.novis:
             for ev in ctl.events:
                 s = str(ev)

@@ -23,6 +23,16 @@ missed flips are never replayed, present ones never doubled, and
 When reconnection is disabled or exhausted the client parts with an
 explicit `ConnectionLost` state (`lost` event, `state == "lost"`)
 rather than an indistinguishable closed stream.
+
+Observability (docs/OBSERVABILITY.md): the attach handshake runs a
+clock probe against servers that advertise it — the min-RTT offset
+sample corrects the emit→apply turn-latency histogram onto the
+server's timebase, is exported as gol_tpu_client_clock_offset_seconds,
+and rides the tracer's dump metadata so `gol_tpu.obs.report merge` can
+join this side's spans with the server's on one timeline. Link
+lifecycle (link_down / reconnected / board_sync / lost) lands on the
+same timeline and in the flight recorder; reconnect exhaustion dumps
+the black box.
 """
 
 from __future__ import annotations
@@ -39,6 +49,7 @@ import numpy as np
 
 from gol_tpu import obs
 from gol_tpu.distributed import wire
+from gol_tpu.obs import flight, tracing
 from gol_tpu.engine.distributor import EventQueue
 from gol_tpu.events import CellFlipped, FlipBatch, TurnComplete
 from gol_tpu.utils.cell import Cell, cells_from_mask, xy_from_mask
@@ -80,6 +91,11 @@ class _ClientMetrics:
         self.lost = obs.counter(
             "gol_tpu_client_connection_lost_total",
             "Links declared permanently lost (reconnect off/exhausted)",
+        )
+        self.clock_offset = obs.gauge(
+            "gol_tpu_client_clock_offset_seconds",
+            "Handshake-estimated wall-clock offset to the server "
+            "(server_time - client_time; min-RTT probe sample)",
         )
 
 
@@ -170,13 +186,23 @@ class Controller:
         #: Heartbeat cadence the server confirmed in its attach-ack
         #: (0 = none negotiated; the read deadline stays unarmed).
         self._hb_secs = 0.0
+        #: Clock-offset estimate to the server (seconds; server_time ≈
+        #: client_time + offset), measured by the handshake ping/pong
+        #: probe when the server advertises "clock" in its attach-ack.
+        #: None until a probe run completes (legacy servers never echo,
+        #: so it simply stays None and the latency math falls back to
+        #: the raw cross-host subtraction, as before).
+        self.clock_offset: Optional[float] = None
+        self._clk_samples: "list[tuple[float, float]]" = []
+        self._clk_left = 0
+        self._clk_last_send = 0.0
         #: Delta-of-sparse flips chain state (r6): the changed-word
         #: bitmap of the last applied delta frame, reset at every
         #: board sync (the server resets its twin when it sends one).
         self._delta_prev: Optional[np.ndarray] = None
         hello = {"t": "hello", "want_flips": want_flips,
                  "compact": True, "binary": bool(binary),
-                 "levels": bool(levels), "hb": True,
+                 "levels": bool(levels), "hb": True, "clock": True,
                  # Delta frames carry no levels, so level mode keeps
                  # the LFLIPS encoding (negotiated OFF here).
                  "delta": bool(delta) and bool(binary) and not levels}
@@ -314,26 +340,121 @@ class Controller:
 
     # --- reader ---
 
+    #: Clock probes per (re)attach: enough samples for the min-RTT
+    #: filter to dodge a scheduling hiccup, few enough to finish within
+    #: the first seconds of a session.
+    CLOCK_PROBES = 8
+
+    #: A probe whose echo is this stale gets re-sent (from the next
+    #: inbound message) instead of stalling the run forever — one
+    #: dropped echo must not leave clock_offset unmeasured all session.
+    CLOCK_PROBE_RETRY_SECS = 2.0
+
+    def _send_clk(self) -> None:
+        """One clock probe: the server echoes t0 back with its own
+        wall clock (queue-free), and the reply's RTT bounds the offset
+        error. Failures are ignored — the link supervisor owns socket
+        death, and an unmeasured offset just stays None."""
+        self._clk_last_send = time.monotonic()
+        with contextlib.suppress(OSError, ConnectionError, wire.WireError):
+            with self._send_lock:
+                wire.send_msg(self._sock, {"t": "clk", "t0": time.time()})
+
     def _handle(self, msg: dict) -> bool:
         """Apply one server message; False ends the stream (metrics:
         one counter + one apply-seconds observation per message, and
         the emit→apply lag for stamped TurnCompletes)."""
         t0 = time.perf_counter()
+        wall0 = time.time()
         try:
             return self._handle_inner(msg)
         finally:
             t = msg.get("t")
+            dt = time.perf_counter() - t0
             _METRICS.messages.get(t, _METRICS.messages["other"]).inc()
-            _METRICS.apply_seconds.observe(time.perf_counter() - t0)
+            _METRICS.apply_seconds.observe(dt)
+            tracing.add_span("client.apply", "client", wall0, dt,
+                             {"t": t})
+            if (self._clk_left > 0 and t != "clk"
+                    and time.monotonic() - self._clk_last_send
+                    > self.CLOCK_PROBE_RETRY_SECS):
+                # A probe's echo went missing (dropped frame, or the
+                # send itself failed silently): re-fire on the next
+                # inbound traffic rather than stalling the run with
+                # clock_offset forever unmeasured. Stream-idle links
+                # retry off the heartbeat cadence at worst.
+                self._send_clk()
             if t == "ev" and msg.get("k") == "turn" and "ts" in msg:
-                # Clamped at 0: a sub-millisecond negative reading is
-                # clock granularity, not time travel.
-                _METRICS.turn_latency.observe(
-                    max(0.0, time.time() - float(msg["ts"]))
-                )
+                # The handshake-estimated offset moves this reading
+                # onto the SERVER's timebase (server_now ≈ client_now +
+                # offset), turning the documented cross-host skew into
+                # a measured correction; legacy servers leave the
+                # offset None and the raw subtraction stands. Clamped
+                # at 0: a sub-millisecond negative reading is clock
+                # granularity (or residual probe error), not time
+                # travel.
+                off = self.clock_offset or 0.0
+                lag = max(0.0, time.time() + off - float(msg["ts"]))
+                _METRICS.turn_latency.observe(lag)
+                # The CLIENT half of the per-turn wire correlation
+                # (pairs with the server's `turn.emit` in merged
+                # timelines).
+                tracing.event("turn.apply", "wire", turn=msg.get("turn"),
+                              lag_s=round(lag, 6))
 
     def _handle_inner(self, msg: dict) -> bool:
         t = msg.get("t")
+        if t == "attach-ack":
+            # Start the clock-probe run on servers that echo probes
+            # (negotiated via the ack's "clock"; re-measured after
+            # every reconnect — the offset can drift with the peer).
+            if msg.get("clock"):
+                self._clk_samples = []
+                self._clk_left = self.CLOCK_PROBES
+                self._send_clk()
+            return True
+        if t == "clk":
+            if self._clk_left <= 0:
+                # Stray echo after the run finalized (a retry raced a
+                # late original): the offset is published and latencies
+                # were observed against it — never re-finalize or
+                # duplicate the clock_sync lifecycle marks.
+                return True
+            t1 = time.time()
+            try:
+                pt0, ts = float(msg["t0"]), float(msg["ts"])
+            except (KeyError, TypeError, ValueError):
+                return True  # malformed echo: drop the sample
+            rtt = max(0.0, t1 - pt0)
+            # NTP-style midpoint estimate: the server stamped somewhere
+            # inside [t0, t1]; assuming the midpoint bounds the error
+            # by RTT/2, and keeping the MIN-RTT sample makes that bound
+            # the tightest the link offered.
+            self._clk_samples.append((rtt, ts - (pt0 + t1) / 2.0))
+            self._clk_left -= 1
+            if self._clk_left > 0:
+                self._send_clk()
+            else:
+                rtt, off = min(self._clk_samples)
+                if abs(off) <= rtt / 2.0:
+                    # Zero lies inside the estimate's own error bound
+                    # (±RTT/2): the clocks are indistinguishable from
+                    # synchronized, and "correcting" by the residual
+                    # would INJECT up to RTT/2 of noise — enough to
+                    # reorder emit→apply pairs on a same-host run whose
+                    # true latency is microseconds. Snap to the only
+                    # value the measurement actually supports. Real
+                    # cross-host skew (≫ RTT/2) always survives this.
+                    off = 0.0
+                self.clock_offset = off
+                tracing.set_clock_offset(off)
+                _METRICS.clock_offset.set(off)
+                tracing.event("client.clock_sync", "lifecycle",
+                              offset_s=round(off, 6),
+                              rtt_s=round(rtt, 6))
+                flight.note("client.clock_sync", offset_s=round(off, 6),
+                            rtt_s=round(rtt, 6))
+            return True
         if t == "board":
             self.sync_turn, board = wire.msg_to_board(msg)
             # Replay as a flip burst + a render tick so any attached
@@ -367,7 +488,15 @@ class Controller:
             self.events.put(TurnComplete(self.sync_turn))
             self.synced_turn = self.sync_turn
             self._delta_prev = None  # delta chain restarts at a sync
+            was_synced = self.synced.is_set()
             self.synced.set()
+            # Lifecycle mark: a re-sync after a reconnect is the gap's
+            # closing edge on the merged timeline (the opening edge is
+            # client.link_down).
+            tracing.event("client.board_sync", "lifecycle",
+                          turn=self.sync_turn, resync=was_synced)
+            flight.note("client.board_sync", turn=self.sync_turn,
+                        resync=was_synced)
             return True
         if t == "dflips":
             # Delta-of-sparse flips (r6): XOR the bitmap delta against
@@ -475,6 +604,8 @@ class Controller:
             if self._closing.is_set() or self.detached.is_set():
                 self.close()
                 return
+            tracing.event("client.link_down", "lifecycle", reason=reason)
+            flight.note("client.link_down", reason=reason)
             msg = self._try_reconnect(reason)
             if msg is None:
                 self._mark_lost(reason)
@@ -523,6 +654,9 @@ class Controller:
                 self._arm_read_deadline()
                 self.reconnects += 1
                 _METRICS.reconnects.inc()
+                tracing.event("client.reconnected", "lifecycle",
+                              attempt=attempt)
+                flight.note("client.reconnected", attempt=attempt)
                 log.warning(
                     "reconnected to %s:%d on attempt %d — resyncing "
                     "via BoardSync", self._host, self._port, attempt,
@@ -537,6 +671,12 @@ class Controller:
                     self._host, self._port, reason)
         self.lost.set()
         _METRICS.lost.inc()
+        tracing.event("client.lost", "lifecycle", reason=reason)
+        flight.note("client.lost", reason=reason)
+        # Reconnect exhaustion is this side's black-box moment: dump
+        # the recent history crash-atomically (no-op without a
+        # configured directory) before the caller tears down.
+        flight.dump("connection-lost")
         self.close()
 
 

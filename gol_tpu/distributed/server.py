@@ -49,6 +49,7 @@ import numpy as np
 
 from gol_tpu import obs
 from gol_tpu.checkpoint import snapshot_turn
+from gol_tpu.obs import flight, tracing
 from gol_tpu.distributed import wire
 from gol_tpu.engine.distributor import Engine
 from gol_tpu.events import (
@@ -271,6 +272,20 @@ class _Conn:
 
     def send(self, msg: dict) -> None:
         self._enqueue(json.dumps(msg, separators=(",", ":")).encode())
+
+    def send_direct(self, msg: dict) -> None:
+        """Send NOW, bypassing the writer queue (still serialized with
+        it — the queue's writer holds the same per-frame lock, so
+        frames never interleave). For the clock-probe echo ONLY: its
+        whole value is a prompt turnaround, and queueing it behind a
+        burst of flip frames would smuggle the backlog delay into the
+        client's RTT/offset estimate. Stream-ordering-sensitive
+        messages must keep using send()."""
+        payload = json.dumps(msg, separators=(",", ":")).encode()
+        _METRICS.frames.inc()
+        _METRICS.frame_bytes.inc(len(payload))
+        with self._lock:
+            wire.send_frame(self.sock, payload)
 
     def send_raw(self, payload: bytes) -> None:
         self._enqueue(payload)
@@ -537,7 +552,12 @@ class EngineServer:
             # TPU that can be a 40s compile away. The ack lands within
             # ms so attaches never time out behind a dispatch (clients
             # ignore unknown message kinds, so old ones are unaffected).
-            ack = {"t": "attach-ack"}
+            # Clock-probe negotiation (docs/OBSERVABILITY.md): the ack
+            # advertises that this server echoes {"t":"clk"} probes
+            # with its wall clock, so the peer can estimate the
+            # emit-stamp offset instead of documenting the skew. Legacy
+            # peers ignore the unknown key.
+            ack = {"t": "attach-ack", "clock": True}
             if hb:
                 # The client arms its own miss-detector from this: a
                 # server that stays silent past a few multiples of
@@ -549,6 +569,9 @@ class EngineServer:
                 self._detach(conn)
                 continue
             conn.start_writer(self._detach)
+            tracing.event("server.attach", "lifecycle", role=role,
+                          token=conn.token)
+            flight.note("server.attach", role=role, token=conn.token)
             self._attach(conn)
             threading.Thread(
                 target=self._reader_loop, args=(conn,),
@@ -592,6 +615,9 @@ class EngineServer:
             )
         if removed:  # idempotent under the detach/close double-call
             _METRICS.detaches.inc()
+            tracing.event("server.detach", "lifecycle", role=conn.role,
+                          token=conn.token)
+            flight.note("server.detach", role=conn.role, token=conn.token)
         _METRICS.peers.set(remaining)
 
     def _detach(self, conn: _Conn) -> None:
@@ -648,6 +674,15 @@ class EngineServer:
             # exist precisely to generate this refresh on idle links.
             conn.last_rx = time.monotonic()
             conn.hb_unanswered = 0
+            if msg.get("t") == "clk":
+                # Clock probe: echo the peer's t0 with our wall clock,
+                # immediately and queue-free (send_direct) — the reply
+                # delay IS the measurement error. The probe is
+                # observer-safe: it steers nothing.
+                with contextlib.suppress(wire.WireError, OSError):
+                    conn.send_direct({"t": "clk", "t0": msg.get("t0"),
+                                      "ts": time.time()})
+                continue
             if msg.get("t") != "key":
                 continue
             key = msg.get("key")
@@ -705,7 +740,19 @@ class EngineServer:
                         conn.hb_unanswered,
                     )
                     _METRICS.evicted.inc()
+                    tracing.event("server.evict", "lifecycle",
+                                  role=conn.role, token=conn.token,
+                                  silent_s=round(now - conn.last_rx, 3))
+                    flight.note("server.evict", role=conn.role,
+                                token=conn.token,
+                                silent_s=round(now - conn.last_rx, 3))
                     self._detach(conn)
+                    # An eviction is the black-box moment for the peer
+                    # that just vanished: snapshot the recent history
+                    # (crash-atomic, no-op without a configured dir) so
+                    # the post-mortem exists even if whatever killed
+                    # the peer takes this process down next.
+                    flight.dump("peer-eviction")
                     # An eviction is instability evidence: nudge an
                     # immediate checkpoint (engine 's' verb, async +
                     # crash-atomic) so a restart after whatever killed
@@ -747,6 +794,12 @@ class EngineServer:
         ride only to peers that advertised the capability).
         `delta_words` is the shared per-turn (bitmap, words) pair for
         delta peers (see _delta_words)."""
+        with tracing.span("wire.encode_flips", "wire", turn=turn):
+            self._send_flips_inner(conn, turn, flips, flips_levels,
+                                   delta_words)
+
+    def _send_flips_inner(self, conn: _Conn, turn: int, flips,
+                          flips_levels, delta_words=None) -> None:
         lv = flips_levels if conn.levels else None
         if conn.delta and lv is None:
             # Delta-of-sparse (r6): changed-word masks with the bitmap
@@ -907,6 +960,12 @@ class EngineServer:
                 _METRICS.queue_depth.set(
                     max((c._out.qsize() for c in conns), default=0)
                 )
+                # The SERVER half of the per-turn wire correlation: one
+                # instant mark per broadcast turn, carrying the turn
+                # number — `report merge` pairs it with the client's
+                # `turn.apply` on the offset-corrected timebase.
+                tracing.event("turn.emit", "wire",
+                              turn=ev.completed_turns)
             delta_words = None
             if flush and flips_levels is None and any(
                     c.delta and c.synced and c.want_flips

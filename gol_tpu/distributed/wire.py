@@ -55,6 +55,14 @@ Message catalog:
         {"t":"hb"} pong, which is what refreshes the server's
         idle-eviction clock. Peers that predate the frame ignore it
         (unknown kinds are ignorable on both sides).
+  clock probe (docs/OBSERVABILITY.md — negotiated via the attach-ack's
+  "clock" key; legacy peers on either side just never exchange these):
+    {"t":"clk","t0":T}                controller ping carrying its wall
+        clock; the server echoes {"t":"clk","t0":T,"ts":S} immediately
+        and QUEUE-FREE with its own wall clock, giving the client an
+        NTP-style offset sample bounded by RTT/2 — the min-RTT sample
+        becomes gol_tpu_client_clock_offset_seconds and corrects the
+        turn-latency math and merged timelines.
 """
 
 from __future__ import annotations
@@ -78,6 +86,7 @@ from gol_tpu.events import (
     StateChange,
     TurnComplete,
 )
+from gol_tpu.obs import tracing
 from gol_tpu.utils.cell import Cell
 
 MAX_FRAME = 64 << 20
@@ -119,6 +128,10 @@ def send_frame(sock: socket.socket, payload: bytes) -> None:
     if len(payload) > MAX_FRAME:
         raise WireError(f"frame too large: {len(payload)} bytes")
     sock.sendall(_LEN.pack(len(payload)) + payload)
+    # One instant mark per frame at THE send chokepoint both planes
+    # share — the wire hop of the session timeline (gol_tpu.obs.tracing;
+    # a no-op flag read when the plane is off).
+    tracing.event("wire.send", "wire", bytes=len(payload))
 
 
 def send_msg(sock: socket.socket, msg: dict) -> None:
@@ -160,12 +173,17 @@ def recv_msg(sock: socket.socket,
         ) from None
     if payload[:1] == b"{":
         try:
-            return json.loads(payload.decode())
+            msg = json.loads(payload.decode())
         except (ValueError, UnicodeDecodeError) as e:
             raise WireError(f"malformed JSON frame: {e}") from None
-    if not allow_binary:
+    elif not allow_binary:
         raise WireError("unexpected binary frame on a control-only link")
-    return _parse_frame(payload)
+    else:
+        msg = _parse_frame(payload)
+    # The receive-side twin of send_frame's mark: frame size + decoded
+    # kind, so a merged timeline shows each hop's traffic inline.
+    tracing.event("wire.recv", "wire", bytes=n, t=msg.get("t"))
+    return msg
 
 
 def _recv_exact(sock: socket.socket, n: int, allow_eof: bool) -> Optional[bytes]:

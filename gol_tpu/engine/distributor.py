@@ -32,6 +32,7 @@ Verb semantics (ref README.md:177-183 and gol/distributor.go:223-280):
 from __future__ import annotations
 
 import atexit
+import contextlib
 import queue
 import threading
 import time
@@ -42,6 +43,7 @@ import numpy as np
 
 from gol_tpu import obs
 from gol_tpu.engine.cycles import CycleDetector
+from gol_tpu.obs import flight, tracing
 from gol_tpu.events import (
     AliveCellsCount,
     BoardSync,
@@ -474,6 +476,14 @@ class Engine:
             # (ref: gol/distributor.go:50-52, util/check.go); here the
             # stream closes cleanly and the error is kept for callers.
             self.error = e
+            # The black-box moment: dump the recent dispatch history
+            # crash-atomically BEFORE teardown (gol_tpu.obs.flight —
+            # a no-op without a configured dump directory), so the
+            # post-mortem pins the turn the engine died at even when
+            # the traceback only lands in a log.
+            flight.note("engine.fatal", error=repr(e))
+            with contextlib.suppress(Exception):
+                flight.dump("engine-exception")
         finally:
             self._ticker_stop.set()
             self._finished.set()
@@ -583,6 +593,10 @@ class Engine:
                 _METRICS.dispatches["diff"].inc()
                 _METRICS.turns["diff"].inc()
                 _METRICS.dispatch_seconds["diff"].observe(elapsed)
+                tracing.add_span("engine.dispatch", "engine",
+                                 time.time() - elapsed, elapsed,
+                                 {"kind": "diff", "turn": turn,
+                                  "turns": 1})
                 if self.timeline:
                     self.timeline.record(turn, 1, elapsed, "diff")
                 self._emit_turn_flips(turn, host_mask)
@@ -665,7 +679,18 @@ class Engine:
                     # timing would measure the async enqueue, not the
                     # dispatch (the observer tax stays opt-in).
                     _METRICS.dispatch_seconds["chunk"].observe(elapsed)
+                    tracing.add_span("engine.dispatch", "engine",
+                                     time.time() - elapsed, elapsed,
+                                     {"kind": "chunk", "turn": turn + k,
+                                      "turns": k})
                     self.timeline.record(turn + k, k, elapsed, "chunk")
+                else:
+                    # Un-realized dispatch: an instant mark keeps the
+                    # fused cadence on the timeline without the
+                    # realizing observer tax a measured span would
+                    # force.
+                    tracing.event("engine.dispatch", "engine",
+                                  kind="chunk", turn=turn + k, turns=k)
                 first = turn + 1
                 turn += k
                 self._commit(turn, world, count)
@@ -904,10 +929,17 @@ class Engine:
             rows = self._decode_compact(pending)
             if rows is None:  # Σ counts burst past the value buffer
                 _METRICS.compact_redos.inc()
+                tracing.event("engine.compact_redo", "engine",
+                              turn=turn + k,
+                              total_cap=pending["compact_cap"])
+                flight.note("engine.compact_redo", turn=turn + k)
         elif pending["sparse_cap"] is not None:
             rows = self._decode_sparse(pending)
             if rows is None:  # truncated: the board burst past the cap
                 _METRICS.sparse_redos.inc()
+                tracing.event("engine.sparse_redo", "engine",
+                              turn=turn + k, cap=pending["sparse_cap"])
+                flight.note("engine.sparse_redo", turn=turn + k)
         if encoded and rows is None:
             self._sparse_cap = None
             # The EXPLICIT redo entry when the stepper has one
@@ -935,6 +967,11 @@ class Engine:
         _METRICS.dispatches["diffs"].inc()
         _METRICS.turns["diffs"].inc(k)
         _METRICS.dispatch_seconds["diffs"].observe(now - start)
+        tracing.add_span(
+            "engine.dispatch", "engine",
+            time.time() - (now - start), now - start,
+            {"kind": "diffs", "turn": turn + k, "turns": k},
+        )
         if self.timeline:
             self.timeline.record(turn + k, k, now - start, "diffs")
         self._commit(turn + k, new_world, count)
@@ -960,7 +997,11 @@ class Engine:
                     self._throttle_events(t)
         finally:
             self._emitting = False
-            _METRICS.host_seconds.observe(time.perf_counter() - emit_tick)
+            emit_dt = time.perf_counter() - emit_tick
+            _METRICS.host_seconds.observe(emit_dt)
+            tracing.add_span("engine.emit", "engine",
+                             time.time() - emit_dt, emit_dt,
+                             {"turns": k, "turn": turn + k})
         turn += k
         self._throttle_events()
         self._maybe_autosave(turn, new_world)
@@ -1050,16 +1091,24 @@ class Engine:
         (each distinct cap is a recompile of the k-turn scan). The
         pow2-floored clamp still covers any peak the enable check
         admits: 2*peak <= ceiling implies peak <= pow2floor(ceiling)."""
+        prev = self._sparse_cap
         ceiling = self._sparse_cap_ceiling()
         if ceiling < DIFF_SPARSE_MIN_CAP or 2 * max_words > ceiling:
             self._sparse_cap = None
-            return
-        want = (
-            max(DIFF_SPARSE_MIN_CAP, 1 << (2 * max_words - 1).bit_length())
-            if max_words
-            else DIFF_SPARSE_MIN_CAP
-        )
-        self._sparse_cap = min(want, 1 << (ceiling.bit_length() - 1))
+        else:
+            want = (
+                max(DIFF_SPARSE_MIN_CAP,
+                    1 << (2 * max_words - 1).bit_length())
+                if max_words
+                else DIFF_SPARSE_MIN_CAP
+            )
+            self._sparse_cap = min(want, 1 << (ceiling.bit_length() - 1))
+        if self._sparse_cap != prev:
+            # An encoding decision is timeline-worthy: each distinct
+            # cap recompiles the k-turn scan, and a flapping cap is
+            # exactly the pathology a post-mortem should show.
+            tracing.event("engine.sparse_cap", "engine",
+                          cap=self._sparse_cap, peak=max_words)
 
     def _seed_gens_states(self, host_levels) -> None:
         """(Re)anchor the level-mode state grid to a known gray board —
@@ -1116,6 +1165,11 @@ class Engine:
     def _commit(self, turn: int, world, count) -> None:
         self._committed = (turn, world, count)
         _METRICS.committed_turn.set(turn)
+        # One black-box note per committed dispatch: the flight
+        # recorder's dump contract — its last recorded turn is within
+        # one dispatch chunk of the engine's committed turn — rests on
+        # exactly this line.
+        flight.note("engine.commit", turn=turn)
 
     def _service_requests(self) -> None:
         """Engine thread: answer all pending cross-thread requests by

@@ -1,9 +1,21 @@
-"""gol_tpu.obs — unified metrics: registry, exposition, HTTP sidecar.
+"""gol_tpu.obs — unified observability: metrics, spans, black box.
 
-The metrics plane of the observability story (utils/trace.py is the
-trace plane): Counter / Gauge / Histogram in a process-global Registry
-(`gol_tpu.obs.registry`), exposed as Prometheus text and JSON, served
-live by `MetricsServer` (`gol_tpu.obs.http`, CLI `--metrics-port`).
+Three planes (catalog: docs/OBSERVABILITY.md):
+
+- **metrics** — Counter / Gauge / Histogram in a process-global
+  Registry (`gol_tpu.obs.registry`), exposed as Prometheus text and
+  JSON, served live by `MetricsServer` (`gol_tpu.obs.http`, CLI
+  `--metrics-port`);
+- **spans** — the named-span tracer (`gol_tpu.obs.tracing`): every hop
+  of a session (engine dispatch, stepper entry, wire frames, client
+  apply, lifecycle) records into a bounded ring exported as
+  Chrome-trace JSON (`/trace`); `python -m gol_tpu.obs.report merge`
+  joins server + client dumps onto one clock-corrected timeline;
+- **black box** — the flight recorder (`gol_tpu.obs.flight`): a
+  crash-surviving ring of recent lifecycle notes + metric deltas,
+  dumped crash-atomically on SIGTERM / fatal engine errors / peer
+  eviction / reconnect exhaustion, live at `/flightrecorder`, rendered
+  by `python -m gol_tpu.obs.report render`.
 
 Instrumented layers and their series (catalog: docs/OBSERVABILITY.md):
 
@@ -13,10 +25,11 @@ Instrumented layers and their series (catalog: docs/OBSERVABILITY.md):
 - client decode/apply + turn latency distributed/client.py  gol_tpu_client_*
 - invariant violations               analysis/invariants.py gol_tpu_invariant_violations_total
 
-Ground rules (enforced by the `obs-in-jit` linter check): metrics are
-host-side and dispatch/event-granular — never inside a jit/pallas
-trace, never per cell. `GOL_TPU_METRICS=0` (or `set_enabled(False)`)
-turns the plane off behind a single flag check.
+Ground rules (enforced by the `obs-in-jit` linter check): metrics,
+spans and flight notes are host-side and dispatch/event-granular —
+never inside a jit/pallas trace, never per cell. `GOL_TPU_METRICS=0`
+(or `set_enabled(False)`) turns all three planes off behind a single
+flag check — zero wrappers built, no ring allocations.
 
 Stdlib-only on purpose: `analysis.invariants` must stay importable from
 worker processes and the linter CLI with zero dependency cost, and it

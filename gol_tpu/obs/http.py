@@ -1,5 +1,5 @@
-"""Metrics HTTP sidecar — `/metrics`, `/healthz`, `/vars` on a live
-engine.
+"""Metrics HTTP sidecar — `/metrics`, `/healthz`, `/vars`, `/trace`,
+`/flightrecorder` on a live engine.
 
 Opt-in (`--metrics-port` in the CLI, or `MetricsServer(...)` from
 library code): a ThreadingHTTPServer on its own daemon thread serving
@@ -9,7 +9,18 @@ library code): a ThreadingHTTPServer on its own daemon thread serving
               convention — curl-and-jq friendly);
 - `/healthz`  the caller's health dict as JSON, HTTP 200 when its
               "status" is "ok", 503 otherwise — liveness for probes
-              that don't parse metrics.
+              that don't parse metrics;
+- `/trace`    the recent span window of the process tracer
+              (gol_tpu.obs.tracing) as Chrome-trace JSON — save it and
+              feed `python -m gol_tpu.obs.report merge`;
+- `/flightrecorder`  the live black box (gol_tpu.obs.flight): recent
+              lifecycle notes, metric deltas, spans and the current
+              state snapshot — what a crash dump WOULD contain, for a
+              process that is still alive.
+
+With the plane disabled (`GOL_TPU_METRICS=0`) the last two return an
+explicit `{"enabled": false}` payload so a scraper can tell "disabled"
+from "idle".
 
 The sidecar runs entirely off the engine's threads: a scrape can never
 stall a dispatch, and a wedged engine still answers (that is the point
@@ -64,6 +75,21 @@ class MetricsServer:
                 elif path == "/vars":
                     self._reply(
                         200, json.dumps(reg.snapshot(), indent=2).encode(),
+                        "application/json",
+                    )
+                elif path == "/trace":
+                    from gol_tpu.obs.tracing import trace_payload
+
+                    self._reply(
+                        200, json.dumps(trace_payload()).encode(),
+                        "application/json",
+                    )
+                elif path == "/flightrecorder":
+                    from gol_tpu.obs import flight
+
+                    self._reply(
+                        200,
+                        json.dumps(flight.payload(), indent=1).encode(),
                         "application/json",
                     )
                 elif path == "/healthz":

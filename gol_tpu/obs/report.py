@@ -1,0 +1,288 @@
+"""Session reports — merge per-process traces, render post-mortems.
+
+Two subcommands (stdlib only, no engine import):
+
+  python -m gol_tpu.obs.report merge SERVER.json CLIENT.json -o OUT.json
+      Join two (or more) Chrome-trace dumps (`Tracer.dump` / the
+      `/trace` endpoint) into ONE Chrome-trace file on the corrected
+      timebase: each input's `metadata.clock_offset_seconds` — the
+      handshake-estimated offset to the session's reference clock,
+      measured by the wire clock probe (docs/OBSERVABILITY.md) — shifts
+      its events before the union, so a server-emit span and its
+      client-apply span for the same turn (both carry `args.turn`) line
+      up on one timeline even across hosts with skewed clocks. Load the
+      output in Perfetto / chrome://tracing.
+
+  python -m gol_tpu.obs.report render FLIGHT.json
+      Human post-mortem of a flight-recorder dump (`gol_tpu.obs.flight`):
+      why/when it dumped, the state it died in, a turn-rate curve from
+      the recorded dispatch commits, stall windows, reconnect storms,
+      eviction and invariant-violation history, and the biggest metric
+      deltas. `render` on a bare path is the default subcommand.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+# --- merge ---------------------------------------------------------------
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise ValueError(f"{path}: not a Chrome-trace dump "
+                         "(no traceEvents key)")
+    return data
+
+
+def merge_traces(dumps: list, labels: Optional[list] = None) -> dict:
+    """Union the dumps' traceEvents on the corrected timebase. Each
+    dump's `metadata.clock_offset_seconds` (offset TO the reference
+    clock: ref_time ≈ local_time + offset; None/absent means this dump
+    IS the reference, e.g. the server) shifts its events. Distinct pids
+    keep the processes apart in the viewer; a process_name metadata
+    event labels each."""
+    events = []
+    offsets = {}
+    used_pids = set()
+    for i, dump in enumerate(dumps):
+        meta = dump.get("metadata") or {}
+        off_us = (meta.get("clock_offset_seconds") or 0.0) * 1e6
+        pid = orig_pid = meta.get("pid", i)
+        # Two containerized processes are routinely both PID 1: a
+        # shared pid would interleave both sides into ONE viewer track
+        # (with conflicting labels) — remap the later dump instead.
+        while pid in used_pids:
+            pid = pid * 1000 + i + 1
+        used_pids.add(pid)
+        label = (labels[i] if labels and i < len(labels) else None) \
+            or meta.get("process_label") or f"proc{i}"
+        offsets[str(pid)] = {"label": label, "source_pid": orig_pid,
+                             "clock_offset_seconds": off_us / 1e6}
+        seen_name = False
+        for ev in dump.get("traceEvents", []):
+            ev = dict(ev)
+            if ev.get("ph") == "M":
+                seen_name = ev.get("name") == "process_name" or seen_name
+            elif "ts" in ev:
+                ev["ts"] = ev["ts"] + off_us
+            ev["pid"] = pid
+            events.append(ev)
+        if not seen_name:
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "args": {"name": label}})
+    events.sort(key=lambda e: (e.get("ph") == "M" and -1 or 0,
+                               e.get("ts", 0)))
+    return {
+        "traceEvents": events,
+        "metadata": {"merged_from": offsets,
+                     "timebase": "reference (server) wall clock, "
+                                 "clock-probe corrected"},
+    }
+
+
+def turn_pairs(merged: dict) -> dict:
+    """{turn: {"emit": ts_us, "apply": ts_us}} from a merged trace —
+    the per-turn wire correlation the acceptance ordering is judged on
+    (first emit / first apply per turn; reconnect replays keep the
+    earliest)."""
+    pairs: dict = {}
+    for ev in merged.get("traceEvents", []):
+        name = ev.get("name")
+        if name not in ("turn.emit", "turn.apply"):
+            continue
+        turn = (ev.get("args") or {}).get("turn")
+        if turn is None:
+            continue
+        side = "emit" if name == "turn.emit" else "apply"
+        slot = pairs.setdefault(int(turn), {})
+        ts = ev.get("ts", 0.0)
+        if side not in slot or ts < slot[side]:
+            slot[side] = ts
+    return pairs
+
+
+def _cmd_merge(args) -> int:
+    dumps = [load_trace(p) for p in args.paths]
+    merged = merge_traces(dumps)
+    out = json.dumps(merged, indent=1)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out)
+        pairs = turn_pairs(merged)
+        matched = sum(1 for v in pairs.values()
+                      if "emit" in v and "apply" in v)
+        print(f"merged {len(args.paths)} dumps -> {args.output} "
+              f"({len(merged['traceEvents'])} events, "
+              f"{matched} turns matched emit<->apply)")
+    else:
+        sys.stdout.write(out + "\n")
+    return 0
+
+
+# --- render --------------------------------------------------------------
+
+
+def _fmt_ts(ts: Optional[float]) -> str:
+    if not ts:
+        return "?"
+    import datetime
+
+    return datetime.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
+
+
+def _sparkline(values: list) -> str:
+    if not values:
+        return ""
+    blocks = " ▁▂▃▄▅▆▇█"
+    top = max(values) or 1
+    return "".join(blocks[min(8, int(v / top * 8))] for v in values)
+
+
+def render_flight(dump: dict, out=None) -> None:
+    """Print the human post-mortem of one flight-recorder payload."""
+    out = out or sys.stdout
+    w = out.write
+    if not dump.get("enabled", True):
+        w("flight recorder: DISABLED — %s\n"
+          % dump.get("reason", "no reason recorded"))
+        return
+    w("flight recorder post-mortem\n")
+    w("  reason:   %s\n" % (dump.get("reason") or "live snapshot"))
+    w("  process:  pid %s%s\n" % (
+        dump.get("pid"),
+        " (%s)" % dump["process_label"] if dump.get("process_label") else "",
+    ))
+    w("  dumped:   %s\n" % _fmt_ts(dump.get("dumped_at")))
+    off = dump.get("clock_offset_seconds")
+    if off is not None:
+        w("  clock:    %+.6fs offset to the session reference\n" % off)
+    state = dump.get("state")
+    if state:
+        w("  state:    %s\n" % json.dumps(state, sort_keys=True))
+
+    entries = dump.get("entries", [])
+    commits = [e for e in entries if e.get("kind") == "engine.commit"]
+    if commits:
+        last = commits[-1]
+        w("  last committed turn recorded: %s at %s\n"
+          % (last.get("turn"), _fmt_ts(last.get("ts"))))
+        # Turn-rate curve: turns advanced per wall-second bucket over
+        # the recorded window.
+        t0, t1 = commits[0]["ts"], commits[-1]["ts"]
+        span = max(t1 - t0, 1e-9)
+        buckets = min(60, max(1, int(span) + 1))
+        rate = [0.0] * buckets
+        prev = commits[0].get("turn", 0)
+        for e in commits[1:]:
+            i = min(buckets - 1, int((e["ts"] - t0) / span * buckets))
+            rate[i] += max(0, e.get("turn", prev) - prev)
+            prev = e.get("turn", prev)
+        w("  turn rate (%.1fs window, %d buckets): |%s|\n"
+          % (span, buckets, _sparkline(rate)))
+        # Stalls: inter-commit gaps far beyond the typical cadence.
+        gaps = [(b["ts"] - a["ts"], a) for a, b in zip(commits, commits[1:])]
+        if gaps:
+            typical = sorted(g for g, _ in gaps)[len(gaps) // 2]
+            thresh = max(1.0, 5.0 * typical)
+            stalls = [(g, a) for g, a in gaps if g > thresh]
+            if stalls:
+                w("  stalls (> %.2fs between dispatch commits):\n" % thresh)
+                for g, a in stalls[:10]:
+                    w("    %.2fs after turn %s (%s)\n"
+                      % (g, a.get("turn"), _fmt_ts(a.get("ts"))))
+            else:
+                w("  stalls: none (max gap %.3fs)\n"
+                  % max(g for g, _ in gaps))
+
+    by_kind: dict = {}
+    for e in entries:
+        by_kind.setdefault(e.get("kind"), []).append(e)
+    lifecycle = [k for k in by_kind
+                 if k and not k.startswith("engine.commit")]
+    if lifecycle:
+        w("  lifecycle events:\n")
+        for k in sorted(lifecycle):
+            evs = by_kind[k]
+            w("    %-28s x%-4d last %s\n"
+              % (k, len(evs), _fmt_ts(evs[-1].get("ts"))))
+    storms = [e["ts"] for e in entries
+              if e.get("kind") in ("client.reconnected", "server.evict")]
+    # A storm is a RATE, not a lifetime count: three benign reconnects
+    # hours apart (nightly restarts) must not cry wolf. Flag >= 3
+    # events inside any sliding 5-minute window.
+    STORM_N, STORM_WINDOW = 3, 300.0
+    worst = None
+    for i in range(len(storms) - STORM_N + 1):
+        span_s = storms[i + STORM_N - 1] - storms[i]
+        if span_s <= STORM_WINDOW and (worst is None or span_s < worst):
+            worst = span_s
+    if worst is not None:
+        w("  RECONNECT STORM: %d+ reconnect/eviction events within "
+          "%.1fs\n" % (STORM_N, worst))
+    violations = [e for e in entries
+                  if e.get("kind") == "invariant.violation"]
+    if violations:
+        w("  INVARIANT VIOLATIONS: %d (latest: %s)\n"
+          % (len(violations), violations[-1]))
+
+    deltas = dump.get("metric_deltas") or {}
+    moved = sorted(
+        ((k, v) for k, v in deltas.items()
+         if isinstance(v, (int, float)) and v),
+        key=lambda kv: -abs(kv[1]),
+    )
+    if moved:
+        w("  top metric deltas since armed:\n")
+        for k, v in moved[:12]:
+            w("    %-58s %+g\n" % (k, v))
+    if dump.get("dropped"):
+        w("  (%d older notes evicted from the ring)\n" % dump["dropped"])
+
+
+def _cmd_render(args) -> int:
+    with open(args.path) as f:
+        dump = json.load(f)
+    render_flight(dump)
+    return 0
+
+
+# --- entry ---------------------------------------------------------------
+
+
+def main(argv: Optional[list] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # Bare-path convenience: `report FLIGHT.json` renders it.
+    if argv and argv[0] not in ("merge", "render", "-h", "--help"):
+        argv.insert(0, "render")
+    ap = argparse.ArgumentParser(
+        prog="python -m gol_tpu.obs.report",
+        description="Merge per-process trace dumps / render "
+                    "flight-recorder post-mortems",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser("merge", help="join trace dumps onto one "
+                                      "clock-corrected timeline")
+    mp.add_argument("paths", nargs="+",
+                    help="Chrome-trace dumps (server first is "
+                         "conventional; offsets come from each dump's "
+                         "own metadata)")
+    mp.add_argument("-o", "--output", default=None,
+                    help="write the merged trace here (default stdout)")
+    mp.set_defaults(fn=_cmd_merge)
+    rp = sub.add_parser("render", help="human post-mortem of a "
+                                       "flight-recorder dump")
+    rp.add_argument("path")
+    rp.set_defaults(fn=_cmd_render)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

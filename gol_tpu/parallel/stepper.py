@@ -750,6 +750,7 @@ def instrument_stepper(s: Stepper) -> Stepper:
     import time
 
     from gol_tpu import obs
+    from gol_tpu.obs import tracing
 
     backend = {"backend": s.name}
     dispatches = {}
@@ -780,43 +781,60 @@ def instrument_stepper(s: Stepper) -> Stepper:
         backend,
     )
 
-    def _charge_halo(world, k, per_turn: bool) -> None:
+    def _charge_halo(world, k, per_turn: bool):
         if s.halo_cost is None:
-            return
+            return None
         cost = s.halo_cost(world, k, per_turn)
         halo_exchanges.inc(cost["exchanges"])
         halo_bytes.inc(cost["bytes"])
+        return cost
+
+    def _span(entry, wall0, dt, cost=None) -> None:
+        # One host-side span per stepper entry call on the session
+        # timeline (gol_tpu.obs.tracing) — the priced halo traffic
+        # rides as args so a merged trace shows where the link budget
+        # went without cross-referencing the registry.
+        args = {"halo_bytes": cost["bytes"]} if cost else None
+        tracing.add_span(f"stepper.{entry}", "stepper", wall0, dt, args)
 
     def timed(entry, fn):
         disp, hist = dispatches[entry], seconds[entry]
 
         def wrapper(*args):
             disp.inc()
+            wall0 = time.time()
             t0 = time.perf_counter()
             out = fn(*args)
-            hist.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            hist.observe(dt)
+            _span(entry, wall0, dt)
             return out
 
         return wrapper
 
     def step_n(world, k):
         dispatches["step_n"].inc()
-        _charge_halo(world, int(k), False)
+        cost = _charge_halo(world, int(k), False)
+        wall0 = time.time()
         t0 = time.perf_counter()
         out = s.step_n(world, k)
         dt = time.perf_counter() - t0
         seconds["step_n"].observe(dt)
         if s.halo_cost is not None:
             halo_seconds.observe(dt)
+        _span("step_n", wall0, dt, cost)
         return out
 
     def _diffy(entry, fn):
         def wrapper(world, k, *rest):
             dispatches[entry].inc()
-            _charge_halo(world, int(k), True)
+            cost = _charge_halo(world, int(k), True)
+            wall0 = time.time()
             t0 = time.perf_counter()
             out = fn(world, k, *rest)
-            seconds[entry].observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            seconds[entry].observe(dt)
+            _span(entry, wall0, dt, cost)
             return out
 
         return wrapper
@@ -824,10 +842,13 @@ def instrument_stepper(s: Stepper) -> Stepper:
     def _one_turn(entry, fn):
         def wrapper(world):
             dispatches[entry].inc()
-            _charge_halo(world, 1, True)
+            cost = _charge_halo(world, 1, True)
+            wall0 = time.time()
             t0 = time.perf_counter()
             out = fn(world)
-            seconds[entry].observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            seconds[entry].observe(dt)
+            _span(entry, wall0, dt, cost)
             return out
 
         return wrapper

@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""bench_compare — diff two bench captures, gate on regressions.
+
+The repo's bench trajectory (BENCH_r*.json round captures, plus the
+rich BENCH_DETAIL.json breakdown) had no tooling to READ it: the
+no-drift rule was enforced by grep and eyeballs. This script diffs any
+two captures of the same shape and prints a per-metric regression
+table; `--fail-over PCT` turns it into a CI gate that exits 1 when any
+direction-aware metric regresses by more than PCT percent.
+
+    python scripts/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python scripts/bench_compare.py OLD_DETAIL.json BENCH_DETAIL.json \
+        --fail-over 10
+
+Shapes understood (auto-detected, both sides must match by key):
+
+- round captures ({"parsed": {"metric", "value", ...}} — the
+  BENCH_r*.json driver format) and
+- arbitrary nested JSON (BENCH_DETAIL.json): every numeric leaf
+  becomes a dotted-path metric.
+
+Direction is inferred from the metric name: throughput-ish names
+(`*_per_sec`, `*throughput*`, `*rate*`, `gcells*`) regress DOWN;
+cost-ish names (`*seconds*`, `*_s`, `*_ms`, `*bytes*`, `*latency*`)
+regress UP. Everything else is reported as informational and never
+gates — a changed alive count is drift for the TESTS to judge, not a
+perf regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Dict, Optional
+
+HIGHER_BETTER = re.compile(
+    r"(per_sec|per_s$|throughput|rate$|gcells|speedup)", re.I
+)
+LOWER_BETTER = re.compile(
+    r"(seconds|_secs?$|_s$|_ms$|bytes|latency|overhead|stalls|redos"
+    r"|dropped|_kb$)", re.I
+)
+
+
+def flatten(obj, prefix: str = "", out: Optional[Dict[str, float]] = None
+            ) -> Dict[str, float]:
+    """Numeric leaves of arbitrary nested JSON as dotted-path keys.
+    Bools are skipped (drift in a flag is not a metric); list elements
+    key by index."""
+    if out is None:
+        out = {}
+    if isinstance(obj, bool):
+        return out
+    if isinstance(obj, (int, float)):
+        out[prefix or "value"] = float(obj)
+    elif isinstance(obj, dict):
+        for k, v in sorted(obj.items()):
+            flatten(v, f"{prefix}.{k}" if prefix else str(k), out)
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            flatten(v, f"{prefix}[{i}]", out)
+    return out
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict) \
+            and "metric" in data["parsed"]:
+        # BENCH_r*.json: one headline metric per round capture.
+        p = data["parsed"]
+        out = {str(p["metric"]): float(p["value"])}
+        if isinstance(p.get("vs_baseline"), (int, float)):
+            out[f"{p['metric']}.vs_baseline"] = float(p["vs_baseline"])
+        return out
+    return flatten(data)
+
+
+def direction(key: str) -> int:
+    """+1 = higher is better, -1 = lower is better, 0 = informational."""
+    if HIGHER_BETTER.search(key):
+        return +1
+    if LOWER_BETTER.search(key):
+        return -1
+    return 0
+
+
+def compare(old: Dict[str, float], new: Dict[str, float]) -> list:
+    """[(key, old, new, pct_change, regression_pct|None)] for every key
+    present in both captures. `regression_pct` is the worse-direction
+    change (positive = regressed) for direction-aware metrics, None for
+    informational ones. A direction-aware metric moving OFF a zero
+    baseline has no percentage but still a verdict: a cost counter
+    going 0 → N (redos, stalls, dropped — zero IS the healthy baseline
+    for exactly the counters this gate targets) is an infinite
+    regression and always trips the gate; a throughput appearing from
+    zero is an improvement."""
+    rows = []
+    for key in sorted(set(old) & set(new)):
+        o, n = old[key], new[key]
+        pct = None if o == 0 else (n - o) / abs(o) * 100.0
+        d = direction(key)
+        reg = None
+        if d:
+            if pct is not None:
+                reg = -pct if d > 0 else pct
+            elif n != 0:  # off a zero baseline
+                reg = float("inf") if d < 0 else -float("inf")
+            else:
+                reg = 0.0  # 0 -> 0
+        rows.append((key, o, n, pct, reg))
+    return rows
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.6g}"
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_compare",
+        description="Diff two bench captures; gate on regressions",
+    )
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--fail-over", type=float, default=None, metavar="PCT",
+                    help="exit 1 if any direction-aware metric regresses "
+                         "by more than PCT percent")
+    ap.add_argument("--all", action="store_true",
+                    help="print unchanged and informational metrics too "
+                         "(default: changed direction-aware ones, plus "
+                         "anything past the gate)")
+    args = ap.parse_args(argv)
+
+    old, new = load_metrics(args.old), load_metrics(args.new)
+    rows = compare(old, new)
+    if not rows:
+        print(f"no shared numeric metrics between {args.old} and "
+              f"{args.new}", file=sys.stderr)
+        return 2
+
+    width = max(len(k) for k, *_ in rows)
+    failures = []
+    printed = 0
+    print(f"{'metric':<{width}}  {'old':>14}  {'new':>14}  {'change':>9}"
+          f"  verdict")
+    for key, o, n, pct, reg in rows:
+        gate = args.fail_over is not None and reg is not None \
+            and reg > args.fail_over
+        if gate:
+            verdict = f"REGRESSED (> {args.fail_over:g}%)"
+            failures.append((key, reg))
+        elif reg is not None and reg > 0:
+            verdict = "worse"
+        elif reg is not None and reg < 0:
+            verdict = "better"
+        elif reg is not None:
+            verdict = "same"
+        else:
+            verdict = "info"
+        show = args.all or gate or (reg is not None and reg != 0.0)
+        if not show:
+            continue
+        printed += 1
+        if pct is not None:
+            chg = f"{pct:+8.2f}%"
+        elif o == 0 and n != 0:
+            chg = "0 -> new"
+        else:
+            chg = "n/a"
+        print(f"{key:<{width}}  {_fmt(o):>14}  {_fmt(n):>14}  {chg:>9}"
+              f"  {verdict}")
+    if printed == 0:
+        print("(no direction-aware metric changed; --all shows the rest)")
+    only_old = sorted(set(old) - set(new))
+    only_new = sorted(set(new) - set(old))
+    if only_old:
+        print(f"# {len(only_old)} metric(s) only in {args.old}: "
+              + ", ".join(only_old[:8])
+              + (" …" if len(only_old) > 8 else ""))
+    if only_new:
+        print(f"# {len(only_new)} metric(s) only in {args.new}: "
+              + ", ".join(only_new[:8])
+              + (" …" if len(only_new) > 8 else ""))
+    if failures:
+        worst = max(failures, key=lambda kv: kv[1])
+        print(f"FAIL: {len(failures)} metric(s) regressed past "
+              f"{args.fail_over:g}% (worst: {worst[0]} "
+              f"{worst[1]:+.2f}%)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,20 +1,27 @@
 #!/usr/bin/env bash
 # Metrics smoke test: boot a real headless engine with --metrics-port,
-# hit /metrics + /healthz + /vars on the live sidecar, and assert the
-# core series are present and moving. Exercises the full opt-in path
-# (cli flag -> gol_tpu.obs.http -> process registry) the way an
-# operator's probe would — no pytest, no mocks.
+# hit /metrics + /healthz + /vars + /trace + /flightrecorder on the
+# live sidecar, and assert the core series are present and moving.
+# Then SIGTERM a real --serve run and assert it leaves a readable
+# flight-recorder dump that `python -m gol_tpu.obs.report` renders.
+# Exercises the full opt-in path (cli flag -> gol_tpu.obs.http ->
+# process registry/tracer/black box) the way an operator's probe would
+# — no pytest, no mocks.
 #
-# Usage: scripts/metrics_smoke.sh   (CPU-safe; ~15s)
+# Usage: scripts/metrics_smoke.sh   (CPU-safe; ~30s)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 LOG=$(mktemp)
 OUT=$(mktemp -d)
+LOG2=$(mktemp)
+OUT2=$(mktemp -d)
 cleanup() {
     kill "$PID" 2>/dev/null || true
     wait "$PID" 2>/dev/null || true
-    rm -rf "$LOG" "$OUT"
+    [ -n "${PID2:-}" ] && kill "$PID2" 2>/dev/null || true
+    [ -n "${PID2:-}" ] && wait "$PID2" 2>/dev/null || true
+    rm -rf "$LOG" "$OUT" "$LOG2" "$OUT2"
 }
 
 python -m gol_tpu -noVis -t 2 -w 64 -h 64 -turns 1000000000 \
@@ -86,4 +93,76 @@ assert sum(turns) > 0, f"engine committed no turns yet: {turns}"
     exit 1
 }
 
-echo "metrics smoke: OK ($BASE — /metrics, /healthz, /vars all live)"
+# The span tracer: /trace must serve a Chrome-trace payload with
+# engine dispatch spans already on it. (Payloads are big: pipe them,
+# never pass as argv.)
+fetch "$BASE/trace" | python -c '
+import json, sys
+t = json.load(sys.stdin)
+assert t.get("enabled") is True, f"tracer not enabled: {t}"
+names = {e.get("name") for e in t["traceEvents"]}
+assert "engine.dispatch" in names, f"no engine.dispatch span: {sorted(names)[:12]}"
+' || {
+    echo "metrics smoke: FAILED — /trace has no live engine spans" >&2
+    exit 1
+}
+
+# The flight recorder: the live black box must already hold dispatch
+# commit notes and the engine state snapshot.
+fetch "$BASE/flightrecorder" | python -c '
+import json, sys
+f = json.load(sys.stdin)
+assert f.get("enabled") is True, f"flight recorder not enabled: {f}"
+kinds = {e.get("kind") for e in f["entries"]}
+assert "engine.commit" in kinds, f"no commit notes: {sorted(kinds)}"
+assert f.get("state", {}).get("completed_turns", 0) > 0, f["state"]
+' || {
+    echo "metrics smoke: FAILED — /flightrecorder black box is empty" >&2
+    exit 1
+}
+
+# --- SIGTERM leaves a readable crash dump (the black-box contract) ---
+
+python -m gol_tpu -noVis -t 2 -w 64 -h 64 -turns 1000000000 \
+    --images fixtures/images --out "$OUT2" --platform cpu --chunk 16 \
+    --serve 127.0.0.1:0 >"$LOG2" 2>&1 &
+PID2=$!
+for _ in $(seq 1 240); do
+    grep -q '^engine serving on ' "$LOG2" && break
+    if ! kill -0 "$PID2" 2>/dev/null; then
+        echo "metrics smoke: FAILED — server died during startup:" >&2
+        cat "$LOG2" >&2
+        exit 1
+    fi
+    sleep 0.5
+done
+sleep 3   # let it commit some dispatches
+kill -TERM "$PID2"
+for _ in $(seq 1 60); do
+    kill -0 "$PID2" 2>/dev/null || break
+    sleep 0.5
+done
+wait "$PID2" 2>/dev/null || true
+DUMP=$(ls "$OUT2"/flightrecorder-*.json 2>/dev/null | head -1)
+if [ -z "$DUMP" ]; then
+    echo "metrics smoke: FAILED — SIGTERM left no flight-recorder dump in $OUT2:" >&2
+    cat "$LOG2" >&2
+    exit 1
+fi
+python -c '
+import json, sys
+d = json.load(open(sys.argv[1]))
+assert d["reason"] == "sigterm", d["reason"]
+assert any(e.get("kind") == "engine.commit" for e in d["entries"]), \
+    "dump carries no dispatch history"
+' "$DUMP" || {
+    echo "metrics smoke: FAILED — flight dump unreadable or empty: $DUMP" >&2
+    exit 1
+}
+python -m gol_tpu.obs.report render "$DUMP" >/dev/null || {
+    echo "metrics smoke: FAILED — gol_tpu.obs.report could not render $DUMP" >&2
+    exit 1
+}
+
+echo "metrics smoke: OK ($BASE — /metrics, /healthz, /vars, /trace,"
+echo "  /flightrecorder all live; SIGTERM dump at $DUMP renders clean)"

@@ -455,6 +455,47 @@ def test_obs_in_jit_ignores_unrelated_inc_methods(tmp_path):
     assert [f for f in findings if f.check == "obs-in-jit"] == []
 
 
+def test_obs_in_jit_flags_tracer_and_flight_calls(tmp_path):
+    """r7: span enter/exit and flight-recorder appends are as
+    host-side-only as metric mutations — a span under trace records
+    once per COMPILE. All three spellings must be caught."""
+    findings = _lint(tmp_path, """
+        import jax
+        from gol_tpu.obs import flight, tracing
+        from gol_tpu.obs.tracing import span
+
+        @jax.jit
+        def f(x):
+            tracing.event("boom")            # tracer event under trace
+            with span("s", "cat"):           # span enter/exit under trace
+                x = x + 1
+            flight.note("engine.commit")     # black-box append under trace
+            return x
+    """)
+    hits = [f for f in findings if f.check == "obs-in-jit"]
+    assert len(hits) == 3
+    assert all("host-side" in f.message for f in hits)
+
+
+def test_obs_in_jit_allows_host_side_tracer_and_flight_use(tmp_path):
+    findings = _lint(tmp_path, """
+        import jax
+        from gol_tpu.obs import flight, tracing
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def dispatch(x):
+            with tracing.span("engine.dispatch", "engine"):
+                out = step(x)    # host side: jit call, not jit body
+            tracing.event("engine.commit", turn=1)
+            flight.note("engine.commit", turn=1)
+            return out
+    """)
+    assert [f for f in findings if f.check == "obs-in-jit"] == []
+
+
 def test_repo_is_obs_in_jit_clean():
     """The contract the tentpole claims — no metrics call sits inside a
     jit/pallas-traced function anywhere in the package — enforced over

@@ -1,0 +1,681 @@
+"""The session-timeline layer (gol_tpu.obs.tracing / .flight /
+.report): span tracer semantics, flight-recorder black-box dumps, the
+merge/render CLI, the clock-offset handshake, and the two acceptance
+contracts —
+
+- a served run with one client produces, via `report merge`, ONE
+  Chrome-trace timeline in which every turn's client-apply mark starts
+  after its server-emit mark on the offset-corrected timebase, for
+  ≥ 50 consecutive turns across a fault-injected mid-run reconnect
+  (gap visible as lifecycle events, no span loss outside it);
+- a fatal engine exception and a SIGTERM both leave a crash-atomic
+  flight dump whose last recorded turn is within one dispatch chunk of
+  the engine's committed turn.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from gol_tpu import obs
+from gol_tpu.obs import flight, report, tracing
+from gol_tpu.obs.flight import FlightRecorder
+from gol_tpu.obs.tracing import Tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolated_flight_dir():
+    """The flight recorder is process-global: never let a test leave a
+    dump directory armed (a later test's eviction/crash path would
+    write files into a dead tmp dir)."""
+    yield
+    flight.FLIGHT._dir = None
+    flight.FLIGHT._state = None
+
+
+# --- tracer semantics ---------------------------------------------------
+
+
+def test_span_records_name_cat_duration_args():
+    t = Tracer()
+    with t.span("unit.work", "test", turn=7):
+        time.sleep(0.01)
+    (ph, name, cat, ts, dur, tid, args), = t.records
+    assert (ph, name, cat) == ("X", "unit.work", "test")
+    assert args == {"turn": 7}
+    assert dur >= 0.01
+    assert abs(ts - time.time()) < 5.0  # wall-anchored
+    assert tid == threading.get_ident()
+
+
+def test_events_and_ring_eviction_keep_recent_window():
+    t = Tracer(capacity=8)
+    for i in range(20):
+        t.event("tick", "test", i=i)
+    assert t.recorded == 20
+    assert t.dropped == 12
+    kept = [r[6]["i"] for r in t.records]
+    assert kept == list(range(12, 20))  # oldest evicted
+
+
+def test_chrome_trace_export_shape_and_metadata():
+    t = Tracer()
+    t.process_label = "unit"
+    t.clock_offset_seconds = 0.125
+    with t.span("s", "c", x=1):
+        pass
+    t.event("e", "c")
+    out = t.chrome_trace()
+    meta = out["metadata"]
+    assert meta["clock_offset_seconds"] == 0.125
+    assert meta["pid"] == os.getpid()
+    evs = out["traceEvents"]
+    assert evs[0]["ph"] == "M" and evs[0]["args"]["name"] == "unit"
+    span_ev = next(e for e in evs if e["name"] == "s")
+    assert span_ev["ph"] == "X" and span_ev["dur"] >= 0
+    assert span_ev["ts"] > 1e15  # epoch microseconds
+    inst = next(e for e in evs if e["name"] == "e")
+    assert inst["ph"] == "i"
+    json.dumps(out)  # must serialize as-is
+
+
+def test_tracer_dump_is_crash_atomic(tmp_path, monkeypatch):
+    import importlib
+
+    reg_mod = importlib.import_module("gol_tpu.obs.registry")
+    t = Tracer()
+    t.event("before", "test")
+    out = tmp_path / "trace.json"
+    t.dump(out)
+    first = out.read_text()
+    monkeypatch.setattr(
+        reg_mod.os, "replace",
+        lambda *a: (_ for _ in ()).throw(OSError("disk full")),
+    )
+    t.event("after", "test")
+    with pytest.raises(OSError):
+        t.dump(out)
+    monkeypatch.undo()
+    assert out.read_text() == first
+    assert [p for p in tmp_path.iterdir() if p.suffix == ".tmp"] == []
+
+
+# --- satellite: GOL_TPU_METRICS=0 kills this plane end to end ----------
+
+
+def test_disabled_tracer_allocates_nothing_and_shares_null_span():
+    t = Tracer()
+    obs.set_enabled(False)
+    try:
+        s1, s2 = tracing.span("a"), tracing.span("b", x=1)
+        assert s1 is s2  # the one shared null manager: no per-call alloc
+        with s1:
+            pass
+        t.event("e")
+        t.add_span("s", "c", time.time(), 0.0)
+        with t.span("s2"):
+            pass
+        assert t._ring is None  # no ring allocation on the hot path
+        assert t.recorded == 0
+        f = FlightRecorder()
+        f.note("engine.commit", turn=1)
+        assert f._ring is None
+    finally:
+        obs.set_enabled(True)
+    # Re-enabled: the same objects record again.
+    t.event("alive")
+    assert t.recorded == 1
+
+
+def test_disabled_flight_dump_writes_no_file(tmp_path):
+    f = FlightRecorder()
+    f.configure(str(tmp_path))
+    obs.set_enabled(False)
+    try:
+        assert f.dump("test") is None
+    finally:
+        obs.set_enabled(True)
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_disabled_http_trace_and_flightrecorder_report_it():
+    """The live endpoints must say DISABLED explicitly — a scraper has
+    to tell 'plane off' from 'process idle'."""
+    from gol_tpu.obs.http import MetricsServer
+
+    srv = MetricsServer(port=0).start()
+    host, port = srv.address
+    try:
+        obs.set_enabled(False)
+        try:
+            for path in ("/trace", "/flightrecorder"):
+                with urllib.request.urlopen(
+                    f"http://{host}:{port}{path}", timeout=10
+                ) as resp:
+                    body = json.loads(resp.read().decode())
+                assert body["enabled"] is False
+                assert "GOL_TPU_METRICS" in body["reason"]
+        finally:
+            obs.set_enabled(True)
+        # Enabled again: a real Chrome-trace payload.
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/trace", timeout=10
+        ) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["enabled"] is True and "traceEvents" in body
+    finally:
+        srv.close()
+
+
+# --- flight recorder ----------------------------------------------------
+
+
+def test_flight_payload_carries_notes_state_deltas_and_spans(tmp_path):
+    f = FlightRecorder()
+    c = obs.counter("tracing_test_delta_total")
+    f.configure(str(tmp_path), state=lambda: {"completed_turns": 42})
+    c.inc(5)
+    f.note("engine.commit", turn=40)
+    f.note("client.reconnected", attempt=2)
+    p = f.payload("unit")
+    assert p["reason"] == "unit" and p["state"]["completed_turns"] == 42
+    kinds = [e["kind"] for e in p["entries"]]
+    assert kinds == ["engine.commit", "client.reconnected"]
+    assert p["metric_deltas"]["tracing_test_delta_total"] == 5.0
+    assert isinstance(p["spans"], list)
+    path = f.dump("unit")
+    assert os.path.dirname(path) == str(tmp_path)
+    dumped = json.loads(open(path).read())
+    assert dumped["reason"] == "unit"
+    assert f.dumps == [path]
+
+
+def test_flight_state_provider_failure_does_not_kill_dump(tmp_path):
+    f = FlightRecorder()
+
+    def broken():
+        raise RuntimeError("probe died")
+
+    f.configure(str(tmp_path), state=broken)
+    path = f.dump("unit")
+    state = json.loads(open(path).read())["state"]
+    assert state["status"] == "error" and "probe died" in state["error"]
+
+
+def test_flight_dump_creates_missing_out_dir(tmp_path):
+    f = FlightRecorder()
+    f.configure(str(tmp_path / "not-yet" / "out"))
+    f.note("engine.commit", turn=1)
+    path = f.dump("early-crash")
+    assert path is not None and os.path.exists(path)
+
+
+# --- report: merge + render --------------------------------------------
+
+
+def _trace_file(path, events, pid, label, offset=None):
+    data = {
+        "traceEvents": events,
+        "metadata": {"pid": pid, "process_label": label,
+                     "clock_offset_seconds": offset},
+    }
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_merge_applies_clock_offset_and_pairs_turns(tmp_path):
+    base = 1_000_000_000.0 * 1e6  # epoch µs
+    server = _trace_file(
+        tmp_path / "server.json",
+        [{"name": "turn.emit", "cat": "wire", "ph": "i",
+          "ts": base + t * 1000, "pid": 1, "tid": 1,
+          "args": {"turn": t}} for t in range(1, 4)],
+        pid=1, label="serve",
+    )
+    # Client clock runs 2.0s BEHIND the server: raw apply stamps sit
+    # ~2s before their emits; the +2.0 offset in its metadata must
+    # restore the true ordering.
+    client = _trace_file(
+        tmp_path / "client.json",
+        [{"name": "turn.apply", "cat": "wire", "ph": "i",
+          "ts": base - 2.0 * 1e6 + t * 1000 + 300, "pid": 2, "tid": 9,
+          "args": {"turn": t}} for t in range(1, 4)],
+        pid=2, label="connect", offset=2.0,
+    )
+    out = tmp_path / "merged.json"
+    rc = report.main(["merge", server, client, "-o", str(out)])
+    assert rc == 0
+    merged = json.loads(out.read_text())
+    pairs = report.turn_pairs(merged)
+    assert sorted(pairs) == [1, 2, 3]
+    for t, p in pairs.items():
+        assert p["apply"] > p["emit"]
+        assert p["apply"] - p["emit"] == pytest.approx(300, abs=1)
+    labels = {e["args"]["name"] for e in merged["traceEvents"]
+              if e.get("ph") == "M"}
+    assert {"serve", "connect"} <= labels
+
+
+def test_merge_keeps_same_pid_processes_apart(tmp_path):
+    """Two containerized processes are routinely both PID 1; merge
+    must remap instead of interleaving them into one viewer track."""
+    base = 1_000_000_000.0 * 1e6
+    a = _trace_file(
+        tmp_path / "a.json",
+        [{"name": "turn.emit", "ph": "i", "ts": base, "pid": 1, "tid": 1,
+          "args": {"turn": 1}}], pid=1, label="serve")
+    b = _trace_file(
+        tmp_path / "b.json",
+        [{"name": "turn.apply", "ph": "i", "ts": base + 9, "pid": 1,
+          "tid": 1, "args": {"turn": 1}}], pid=1, label="connect",
+        offset=0.0)
+    merged = report.merge_traces([report.load_trace(a),
+                                  report.load_trace(b)])
+    pids = {e["pid"] for e in merged["traceEvents"]
+            if e.get("ph") != "M"}
+    assert len(pids) == 2
+    labels = {e["args"]["name"] for e in merged["traceEvents"]
+              if e.get("ph") == "M"}
+    assert {"serve", "connect"} <= labels
+    assert len(merged["metadata"]["merged_from"]) == 2
+
+
+def test_render_storm_is_rate_gated(tmp_path, capsys):
+    """Three benign reconnects hours apart are not a storm; three
+    inside a five-minute window are."""
+    now = time.time()
+
+    def dump_with(gaps):
+        ts = now - 10_000
+        entries = []
+        for g in gaps:
+            ts += g
+            entries.append({"ts": ts, "kind": "client.reconnected"})
+        return {"enabled": True, "reason": "test", "dumped_at": now,
+                "pid": 1, "entries": entries, "dropped": 0,
+                "metric_deltas": {}, "spans": []}
+
+    p = tmp_path / "calm.json"
+    p.write_text(json.dumps(dump_with([0, 3600, 3600])))
+    assert report.main(["render", str(p)]) == 0
+    assert "RECONNECT STORM" not in capsys.readouterr().out
+    p.write_text(json.dumps(dump_with([0, 5, 5])))
+    assert report.main(["render", str(p)]) == 0
+    assert "RECONNECT STORM" in capsys.readouterr().out
+
+
+def test_render_flight_dump_prints_postmortem(tmp_path, capsys):
+    now = time.time()
+    dump = {
+        "enabled": True, "reason": "sigterm", "dumped_at": now,
+        "pid": 123, "process_label": "serve",
+        "clock_offset_seconds": None,
+        "state": {"completed_turns": 96, "status": "ok"},
+        "entries": (
+            [{"ts": now - 10 + i, "kind": "engine.commit", "turn": i * 8}
+             for i in range(1, 13)]
+            + [{"ts": now - 4, "kind": "client.reconnected", "attempt": 2},
+               {"ts": now - 3, "kind": "invariant.violation",
+                "checker": "event-stream", "msg": "boom"}]
+        ),
+        "dropped": 0,
+        "metric_deltas": {"gol_tpu_engine_turns_total": 96.0},
+        "spans": [],
+    }
+    p = tmp_path / "flight.json"
+    p.write_text(json.dumps(dump))
+    assert report.main([str(p)]) == 0  # bare path defaults to render
+    out = capsys.readouterr().out
+    assert "sigterm" in out
+    assert "last committed turn recorded: 96" in out
+    assert "turn rate" in out
+    assert "INVARIANT VIOLATIONS: 1" in out
+    assert "client.reconnected" in out
+
+
+def test_render_disabled_dump_says_so(tmp_path, capsys):
+    p = tmp_path / "f.json"
+    p.write_text(json.dumps({"enabled": False, "reason": "off"}))
+    assert report.main(["render", str(p)]) == 0
+    assert "DISABLED" in capsys.readouterr().out
+
+
+# --- satellite: bench_compare ------------------------------------------
+
+
+def _bench_compare(*argv):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", os.path.join(REPO, "scripts", "bench_compare.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(list(argv))
+
+
+def test_bench_compare_gates_on_directional_regressions(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps({
+        "engine": {"turns_per_sec": 100.0, "host_seconds": 2.0},
+        "alive": 55,
+    }))
+    new.write_text(json.dumps({
+        "engine": {"turns_per_sec": 89.0, "host_seconds": 1.5},
+        "alive": 56,
+    }))
+    # Throughput -11% regresses past a 10% gate; host_seconds improved.
+    assert _bench_compare(str(old), str(new), "--fail-over", "10") == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "better" in out
+    # A looser gate passes; the informational 'alive' never gates.
+    assert _bench_compare(str(old), str(new), "--fail-over", "20") == 0
+
+
+def test_bench_compare_gates_cost_counters_off_zero_baseline(tmp_path):
+    """Zero IS the healthy baseline for the cost counters the gate
+    targets (redos, stalls, dropped): 0 -> N has no percentage but
+    must still trip --fail-over; a throughput appearing from zero is
+    an improvement and must not."""
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps({"redos": 0, "turns_per_sec": 0}))
+    new.write_text(json.dumps({"redos": 500, "turns_per_sec": 100.0}))
+    assert _bench_compare(str(old), str(new), "--fail-over", "1000") == 1
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps({"redos": 0, "turns_per_sec": 100.0}))
+    assert _bench_compare(str(old), str(ok), "--fail-over", "1000") == 0
+
+
+def test_bench_compare_reads_round_capture_shape(tmp_path):
+    old = tmp_path / "r1.json"
+    new = tmp_path / "r2.json"
+    for p, v in ((old, 100.0), (new, 99.5)):
+        p.write_text(json.dumps({
+            "n": 1, "cmd": "bench", "rc": 0, "tail": "",
+            "parsed": {"metric": "gol_throughput", "value": v,
+                       "unit": "turns/s", "vs_baseline": v / 10},
+        }))
+    assert _bench_compare(str(old), str(new), "--fail-over", "5") == 0
+    assert _bench_compare(str(old), str(new), "--fail-over", "0.1") == 1
+
+
+# --- clock-offset handshake (satellite: the skew hole, fixed) -----------
+
+
+def test_clock_probe_measures_skew_and_corrects_turn_latency():
+    """A server whose clock runs 5s BEHIND stamps TurnComplete 5s in
+    the past; PR 2's raw subtraction read that as 5s of latency (the
+    documented skew hole). The handshake probe must measure the -5s
+    offset, export it, and bring the corrected reading back under a
+    second."""
+    import socket as socklib
+
+    from gol_tpu.distributed import Controller, wire
+
+    SKEW = -5.0
+    lis = socklib.create_server(("127.0.0.1", 0))
+    addr = lis.getsockname()
+    done = threading.Event()
+
+    def fake_server():
+        sock, _ = lis.accept()
+        sock.settimeout(20.0)
+        wire.recv_msg(sock)  # hello
+        wire.send_msg(sock, {"t": "attach-ack", "clock": True})
+        probes = 0
+        while probes < Controller.CLOCK_PROBES:
+            msg = wire.recv_msg(sock)
+            if msg and msg.get("t") == "clk":
+                probes += 1
+                wire.send_msg(sock, {"t": "clk", "t0": msg.get("t0"),
+                                     "ts": time.time() + SKEW})
+        wire.send_msg(sock, {"t": "ev", "k": "turn", "turn": 3,
+                             "ts": time.time() + SKEW})
+        wire.send_msg(sock, {"t": "bye"})
+        done.set()
+        sock.close()
+
+    threading.Thread(target=fake_server, daemon=True).start()
+    lat = obs.registry().histogram("gol_tpu_client_turn_latency_seconds")
+    gauge = obs.registry().gauge("gol_tpu_client_clock_offset_seconds")
+    n0, s0 = lat.count, lat.sum
+    ctl = Controller(*addr, want_flips=False, reconnect=False)
+    try:
+        assert done.wait(30)
+        for _ in ctl.events:
+            pass  # drain to the bye
+        assert ctl.clock_offset == pytest.approx(SKEW, abs=0.5)
+        assert gauge.value == pytest.approx(SKEW, abs=0.5)
+        grew = lat.count - n0
+        assert grew == 1
+        # Uncorrected this reading is ~5s; corrected it is ~0.
+        assert lat.sum - s0 < 1.0
+    finally:
+        ctl.close()
+        lis.close()
+
+
+# --- acceptance: one merged timeline across a forced reconnect ----------
+
+
+def test_merged_timeline_orders_every_turn_across_reconnect(
+        golden_root, tmp_path):
+    """The tentpole acceptance: server + client, PR 3 fault injector
+    forcing one mid-run reconnect; `report merge` joins the two sides'
+    dumps into one Chrome trace where every matched turn's client-apply
+    starts after its server-emit on the offset-corrected timebase, for
+    at least 50 consecutive turns; the reconnect gap shows as lifecycle
+    events and costs no spans outside itself."""
+    from gol_tpu.distributed import Controller, EngineServer
+    from gol_tpu.events import FinalTurnComplete
+    from gol_tpu.params import Params
+    from gol_tpu.testing import FaultPlan, faults
+
+    tracing.TRACER.clear()
+    faults.install(FaultPlan.parse("client:reset@recv:50"))
+    p = Params(turns=200, threads=2, image_width=64, image_height=64,
+               image_dir=str(golden_root / "images"),
+               out_dir=str(tmp_path / "out"), tick_seconds=60.0, chunk=1)
+    server = EngineServer(p, port=0, heartbeat_secs=0.5).start()
+    ctl = Controller(*server.address, want_flips=True, batch=True,
+                     reconnect_seed=7, backoff_base=0.02,
+                     backoff_cap=0.25, reconnect_window=30.0)
+    try:
+        saw_final = False
+        for ev in ctl.events:
+            if isinstance(ev, FinalTurnComplete):
+                saw_final = True
+        assert saw_final
+        assert ctl.reconnects >= 1, "the injected reset never fired"
+        assert ctl.clock_offset is not None, "clock probe never completed"
+        assert abs(ctl.clock_offset) < 0.25  # same host: near-zero skew
+    finally:
+        faults.clear()
+        ctl.close()
+        server.wait(60)
+        server.shutdown()
+
+    # Split the in-process ring into the two dumps a real deployment
+    # would save from each side's /trace endpoint, then merge them.
+    full = tracing.TRACER.chrome_trace()
+    client_names = ("turn.apply", "client.apply", "client.link_down",
+                    "client.reconnected", "client.board_sync",
+                    "client.clock_sync", "client.lost")
+    server_events, client_events = [], []
+    for ev in full["traceEvents"]:
+        if ev.get("ph") == "M":
+            continue
+        (client_events if ev["name"].startswith(client_names)
+         else server_events).append(ev)
+    sp = _trace_file(tmp_path / "server.json", server_events,
+                     pid=101, label="serve")
+    cp = _trace_file(tmp_path / "client.json", client_events,
+                     pid=202, label="connect", offset=ctl.clock_offset)
+    out = tmp_path / "merged.json"
+    assert report.main(["merge", sp, cp, "-o", str(out)]) == 0
+    merged = json.loads(out.read_text())
+
+    # The reconnect gap is visible as lifecycle events on the one
+    # timeline.
+    names = [e["name"] for e in merged["traceEvents"]]
+    assert "client.link_down" in names
+    assert "client.reconnected" in names
+
+    pairs = report.turn_pairs(merged)
+    matched = sorted(t for t, v in pairs.items()
+                     if "emit" in v and "apply" in v)
+    # Ordering on the corrected timebase, every matched turn.
+    for t in matched:
+        assert pairs[t]["apply"] > pairs[t]["emit"], (
+            f"turn {t}: client apply at {pairs[t]['apply']} µs precedes "
+            f"server emit at {pairs[t]['emit']} µs on the corrected "
+            "timebase"
+        )
+    # ≥ 50 CONSECUTIVE turns pinned.
+    best = run = 0
+    for a, b in zip(matched, matched[1:]):
+        run = run + 1 if b == a + 1 else 0
+        best = max(best, run)
+    assert best + 1 >= 50, (
+        f"only {best + 1} consecutive matched turns ({len(matched)} "
+        f"total of {len(pairs)})"
+    )
+    # No span loss outside the gap: every emitted-but-unapplied turn
+    # forms ONE contiguous block (the frames in flight when the
+    # injected reset killed the link).
+    emitted = sorted(t for t, v in pairs.items() if "emit" in v)
+    missing = [t for t in emitted if "apply" not in pairs[t]]
+    if missing:
+        lo, hi = min(missing), max(missing)
+        in_window = [t for t in emitted if lo <= t <= hi]
+        assert in_window == missing, (
+            f"apply spans lost outside the reconnect gap: "
+            f"{sorted(set(in_window) - set(missing))}"
+        )
+
+
+# --- acceptance: crash dumps pin the committed turn ---------------------
+
+
+def test_fatal_engine_exception_leaves_flight_dump(golden_root, tmp_path):
+    """An injected mid-run stepper explosion must leave a crash-atomic
+    dump whose last recorded turn is within one dispatch chunk of the
+    engine's committed turn."""
+    import dataclasses
+
+    from gol_tpu.engine.distributor import Engine
+    from gol_tpu.params import Params
+    from gol_tpu.parallel.stepper import make_stepper
+
+    CHUNK = 8
+    p = Params(turns=10_000, threads=1, image_width=64, image_height=64,
+               image_dir=str(golden_root / "images"),
+               out_dir=str(tmp_path / "out"), tick_seconds=60.0,
+               chunk=CHUNK)
+    base = make_stepper(threads=1, height=64, width=64)
+    calls = {"n": 0}
+
+    def exploding_step_n(world, k):
+        calls["n"] += 1
+        if calls["n"] > 3:
+            raise RuntimeError("injected device fault")
+        return base.step_n(world, k)
+
+    stepper = dataclasses.replace(base, step_n=exploding_step_n)
+    engine = Engine(p, emit_flips=False, stepper=stepper)
+    flight.FLIGHT.clear()  # this process's ring carries earlier tests
+    flight.FLIGHT.configure(str(tmp_path / "black"), state=engine.health)
+    engine.start()
+    engine.join(timeout=120)
+    assert isinstance(engine.error, RuntimeError)
+
+    dumps = [f for f in os.listdir(tmp_path / "black")
+             if f.startswith("flightrecorder-")]
+    assert len(dumps) == 1
+    dump = json.loads((tmp_path / "black" / dumps[0]).read_text())
+    assert dump["reason"] == "engine-exception"
+    assert any(e["kind"] == "engine.fatal" for e in dump["entries"])
+    commits = [e["turn"] for e in dump["entries"]
+               if e["kind"] == "engine.commit"]
+    assert commits, "dump carries no dispatch history"
+    committed = dump["state"]["completed_turns"]
+    assert abs(committed - max(commits)) <= CHUNK
+    assert committed == engine.completed_turns
+
+
+def test_sigterm_leaves_flight_dump_with_committed_turn(
+        golden_root, tmp_path):
+    """SIGTERM on a real `--serve` run: the signal-time dump exists, is
+    readable, records the sigterm reason, and its last recorded turn is
+    within one dispatch chunk of the state it captured."""
+    CHUNK = 16
+    out_dir = tmp_path / "out"
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gol_tpu", "-noVis", "-t", "1",
+         "-w", "64", "-h", "64", "-turns", "1000000000",
+         "--platform", "cpu", "--chunk", str(CHUNK),
+         "--images", str(golden_root / "images"), "--out", str(out_dir),
+         "--serve", "127.0.0.1:0", "--metrics-port", "0"],
+        env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        # Parse the metrics address, then wait for committed turns so
+        # the dump has dispatch history to record.
+        base = None
+        deadline = time.monotonic() + 240
+        line = ""
+        while time.monotonic() < deadline and base is None:
+            line = proc.stdout.readline()
+            if not line and proc.poll() is not None:
+                pytest.fail("server died during startup")
+            if line.startswith("metrics serving on "):
+                base = line.split()[-1].rsplit("/metrics", 1)[0]
+        assert base, "no metrics address printed"
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(base + "/healthz",
+                                            timeout=5) as resp:
+                    health = json.loads(resp.read().decode())
+                if health.get("completed_turns", 0) >= 3 * CHUNK:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        else:
+            pytest.fail("engine committed no turns within the deadline")
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=30)
+
+    dumps = [f for f in os.listdir(out_dir)
+             if f.startswith("flightrecorder-")]
+    assert len(dumps) == 1, f"expected one dump, found {dumps}"
+    dump = json.loads((out_dir / dumps[0]).read_text())
+    assert dump["reason"] == "sigterm"
+    commits = [e["turn"] for e in dump["entries"]
+               if e["kind"] == "engine.commit"]
+    assert commits, "dump carries no dispatch history"
+    committed = (dump.get("state") or {}).get("completed_turns")
+    assert committed is not None
+    assert abs(committed - max(commits)) <= CHUNK
+    # And the post-mortem renderer accepts the artifact as-is.
+    assert report.main(["render", str(out_dir / dumps[0])]) == 0
