@@ -691,6 +691,73 @@ def measure_wire_watched(binary: bool = True, delta: bool = True) -> dict:
             "link_bytes_per_turn": round(nbytes / turns, 1)}
 
 
+def measure_sessions_lane(sessions: int = 64, side: int = 256,
+                          k: int = 16, rounds: int = 4) -> dict:
+    """The multi-session lane (ROADMAP open item 3 / ISSUE 7
+    acceptance): aggregate turns/s of `sessions` concurrent side²
+    boards stepped as ONE bucket (a single vmapped/jitted dispatch +
+    ONE count realization per chunk) vs the same boards stepped as
+    `sessions` SEQUENTIAL single-board engines (one dispatch + one
+    realization EACH per chunk — the per-tenant service pattern a
+    session layer replaces; the engine's marginal cost is its
+    dispatch, see engine_512x512.marginal_turns_per_sec). Both sides
+    run identical arithmetic on identical boards; the delta is the
+    amortized fixed dispatch overhead. Best-of-2 chains damp link
+    jitter. Keys are `*_turns_per_sec` / `*speedup*` so
+    scripts/bench_compare.py's direction table gates them."""
+    import jax
+    import numpy as np
+
+    from gol_tpu.parallel.stepper import make_batch_stepper, make_stepper
+
+    rng = np.random.default_rng(1234)
+    boards = [
+        ((rng.random((side, side)) < 0.25) * 255).astype(np.uint8)
+        for _ in range(sessions)
+    ]
+    dev = jax.devices()[0]
+
+    bs = make_batch_stepper(sessions, side, side, device=dev)
+    stack0 = bs.put_all(boards)
+    s2, c = bs.step_n(stack0, k)
+    np.asarray(c)  # warm (compile + first dispatch)
+    best_b = float("inf")
+    for _ in range(2):
+        stack = stack0
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            stack, c = bs.step_n(stack, k)
+            np.asarray(c)
+        best_b = min(best_b, time.perf_counter() - t0)
+    batched = sessions * k * rounds / best_b
+
+    st = make_stepper(threads=1, height=side, width=side, devices=[dev])
+    worlds0 = [st.put(b) for b in boards]
+    w, c = st.step_n(worlds0[0], k)
+    int(c)  # warm
+    best_s = float("inf")
+    for _ in range(2):
+        worlds = list(worlds0)
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            for i in range(sessions):
+                worlds[i], c = st.step_n(worlds[i], k)
+                int(c)
+        best_s = min(best_s, time.perf_counter() - t0)
+    sequential = sessions * k * rounds / best_s
+
+    return {
+        "sessions": sessions,
+        "board": f"{side}x{side}",
+        "chunk": k,
+        "backend": bs.name,
+        "platform": dev.platform,
+        "aggregate_turns_per_sec": round(batched, 1),
+        "sequential_turns_per_sec": round(sequential, 1),
+        "speedup_vs_sequential": round(batched / sequential, 3),
+    }
+
+
 def metrics_capture() -> dict:
     """The gol_tpu.obs registry as a BENCH_DETAIL payload: the full
     snapshot plus a compact per-phase breakdown — device dispatch vs
@@ -912,6 +979,13 @@ def main() -> None:
         detail["ring_uneven_parity_cpu"] = json.loads(line)
     except Exception as e:
         detail["ring_uneven_parity_cpu"] = {"error": repr(e)}
+    # Multi-session bucket lane (gol_tpu.sessions, ISSUE 7): 64
+    # concurrent 256² sessions as one vmapped dispatch vs 64 sequential
+    # single-board engines.
+    try:
+        detail["sessions_64x256"] = measure_sessions_lane()
+    except Exception as e:
+        detail["sessions_64x256"] = {"error": repr(e)}
     detail["first_alive_report_s"] = first_report
     # The pallas-packed vs XLA-packed-fori_loop ratio the README quotes.
     try:

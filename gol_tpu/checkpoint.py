@@ -41,6 +41,39 @@ def snapshot_turn(path: str | os.PathLike) -> int:
     return int(m.group(3))
 
 
+def session_checkpoint_dir(out_dir: str | os.PathLike) -> str:
+    """Root of the per-session checkpoint tree: each session owns
+    `<out>/sessions/<id>/` holding its `<W>x<H>x<T>.pgm` snapshots and
+    a `session.json` sidecar (rule + geometry — the PGM filename alone
+    cannot carry the ruleset). Layout: docs/SESSIONS.md."""
+    return os.path.join(os.fspath(out_dir), "sessions")
+
+
+def latest_any_snapshot(
+    snap_dir: str | os.PathLike,
+) -> Optional[tuple[str, int, int]]:
+    """(path, width, height) of the highest-turn snapshot of ANY
+    geometry in `snap_dir`, or None. The per-session variant of
+    `latest_snapshot`: a session directory's geometry is whatever its
+    snapshots say, so discovery cannot pre-filter on W x H. Same
+    determinism contract: sorted listing, lexicographic tie-break,
+    unreadable dir = no checkpoint."""
+    best_turn, best = -1, None
+    try:
+        names = sorted(os.listdir(snap_dir))
+    except OSError:
+        return None
+    for name in names:
+        m = _SNAP.match(name)
+        if not m:
+            continue
+        w, h, turn = (int(g) for g in m.groups())
+        if turn > best_turn:
+            best_turn = turn
+            best = (os.path.join(os.fspath(snap_dir), name), w, h)
+    return best
+
+
 def latest_snapshot(
     out_dir: str | os.PathLike, width: int, height: int
 ) -> Optional[str]:

@@ -111,8 +111,27 @@ def build_parser() -> argparse.ArgumentParser:
     # controller ⇄ engine topology, ref: README.md:157-233).
     ap.add_argument("--serve", default=None, metavar="[HOST:]PORT",
                     help="run as a headless engine server on this address")
+    ap.add_argument("--sessions", action="store_true",
+                    help="with --serve: multi-tenant session mode "
+                         "(gol_tpu.sessions) — no singleton board; "
+                         "peers create/destroy/checkpoint named "
+                         "sessions over the wire and attach with "
+                         "hello.session; same-shape sessions share one "
+                         "vmapped dispatch. -w/-h set the geometry "
+                         "CAP for wire-driven creates' sanity bound "
+                         "only; see docs/SESSIONS.md")
+    ap.add_argument("--bucket-capacity", type=int, default=16,
+                    dest="bucket_capacity", metavar="S",
+                    help="with --sessions: initial slots per "
+                         "shape/rule bucket (a full bucket doubles, "
+                         "which recompiles; churn within capacity "
+                         "never does; default 16)")
     ap.add_argument("--connect", default=None, metavar="HOST:PORT",
                     help="run as a controller attached to a remote engine")
+    ap.add_argument("--session", default=None, metavar="ID",
+                    help="with --connect: watch/drive the named session "
+                         "on a --serve --sessions server instead of the "
+                         "singleton board (docs/SESSIONS.md)")
     ap.add_argument("--observe", action="store_true",
                     help="with --connect: attach read-only (board sync "
                          "+ events; steering verbs rejected) — any "
@@ -294,6 +313,20 @@ def main(argv: Optional[list[str]] = None) -> int:
             "error: --resume applies to the engine (local or --serve), "
             "not to a --connect controller"
         )
+    if args.session is not None and args.connect is None:
+        raise SystemExit("error: --session requires --connect")
+    if args.sessions:
+        # Multi-tenant serve mode: state lives per session under
+        # out/sessions/, so the singleton snapshot discovery below
+        # does not apply — resume means "restore every session".
+        if args.serve is None:
+            raise SystemExit("error: --sessions requires --serve")
+        if resume_path not in (None, "latest"):
+            raise SystemExit(
+                "error: --sessions resumes per-session checkpoints; "
+                "use --resume latest (or none)"
+            )
+        return _serve_sessions(args, params, resume_path == "latest")
     if resume_path == "latest":
         from gol_tpu.checkpoint import latest_snapshot
 
@@ -460,6 +493,49 @@ def _serve(args, params: Params, resume_path: Optional[str] = None) -> int:
     return 0
 
 
+def _serve_sessions(args, params: Params, resume: bool) -> int:
+    """Multi-tenant session server (gol_tpu.sessions; the
+    `--serve --sessions` mode — docs/SESSIONS.md). Same exposure rules
+    as --serve: loopback unless an explicit HOST, --secret gates every
+    attach AND every session verb."""
+    from gol_tpu.distributed import SessionServer
+
+    host, port = _addr(args.serve, default_host="127.0.0.1")
+    server = SessionServer(params, host, port, secret=args.secret,
+                           heartbeat_secs=args.hb_secs,
+                           evict_secs=args.evict_secs,
+                           resume=resume,
+                           bucket_capacity=args.bucket_capacity)
+    print(f"session engine serving on "
+          f"{server.address[0]}:{server.address[1]}")
+    if resume:
+        print(f"resumed {server.resumed} session(s) from "
+              f"{params.out_dir}/sessions/")
+    metrics = _start_metrics(args, health=server.health)
+    from gol_tpu.obs import flight as _flight
+
+    _flight.set_state_provider(server.health)
+    server.start()
+    try:
+        while not server.wait(timeout=1.0):
+            if not server.engine.running():
+                # A fatal dispatch-loop error must take the server
+                # down with it — otherwise the listener keeps
+                # accepting onto a dead engine and the error report
+                # below is unreachable.
+                server.shutdown()
+    except KeyboardInterrupt:
+        server.shutdown()
+    finally:
+        if metrics is not None:
+            metrics.close()
+    if server.engine.error is not None:
+        print(f"session engine error: {server.engine.error!r}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def _control(args, params: Params, keypresses: queue.Queue) -> int:
     """Controller attached to a remote engine (ref: README.md:177-183)."""
     from gol_tpu.distributed import Controller
@@ -477,6 +553,7 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
                      secret=args.secret, batch=not args.novis,
                      levels=vis_levels and not args.novis,
                      observe=args.observe,
+                     session=args.session,
                      reconnect=not args.no_reconnect,
                      reconnect_window=args.reconnect_secs)
 

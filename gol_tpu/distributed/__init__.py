@@ -6,9 +6,15 @@ from gol_tpu.distributed.client import (
     Controller,
     EngineClient,
     ServerBusyError,
+    SessionControl,
     UnauthorizedError,
+    UnknownSessionError,
 )
-from gol_tpu.distributed.server import EngineServer, snapshot_turn
+from gol_tpu.distributed.server import (
+    EngineServer,
+    SessionServer,
+    snapshot_turn,
+)
 
 __all__ = [
     "ConnectionLost",
@@ -16,6 +22,9 @@ __all__ = [
     "EngineClient",
     "EngineServer",
     "ServerBusyError",
+    "SessionControl",
+    "SessionServer",
     "UnauthorizedError",
+    "UnknownSessionError",
     "snapshot_turn",
 ]

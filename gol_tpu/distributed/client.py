@@ -110,6 +110,10 @@ class UnauthorizedError(ConnectionError):
     """The engine requires a shared secret this controller lacks."""
 
 
+class UnknownSessionError(ConnectionError):
+    """The named session does not exist on the session server."""
+
+
 class ConnectionLost(ConnectionError):
     """The link died and reconnection was disabled or exhausted."""
 
@@ -128,6 +132,7 @@ class Controller:
         levels: bool = False,
         delta: bool = True,
         observe: bool = False,
+        session: "str | None" = None,
         reconnect: bool = True,
         max_reconnects: Optional[int] = None,
         reconnect_window: float = 30.0,
@@ -211,6 +216,15 @@ class Controller:
             # driver slot stays free, steering verbs are rejected
             # by the server; 'q' still detaches this observer.
             hello["role"] = "observe"
+        if session is not None:
+            # Multi-tenant attach (gol_tpu.sessions): watch/drive the
+            # NAMED session on a `--serve --sessions` server. The rest
+            # of the protocol — board sync, flips, reconnect-and-resync
+            # — is unchanged; a reconnect re-handshakes with the same
+            # session id, so supervision composes. (A pre-sessions
+            # server ignores the unknown key and serves its singleton.)
+            hello["session"] = session
+        self.session = session
         if secret is not None:
             hello["secret"] = secret
         self._hello = hello
@@ -257,6 +271,8 @@ class Controller:
             reason = first.get("reason", "rejected")
             if reason == "unauthorized":
                 raise UnauthorizedError(reason)
+            if reason == "unknown-session":
+                raise UnknownSessionError(reason)
             raise ServerBusyError(reason)
         sock.settimeout(None)
         if first is not None and first.get("t") == "attach-ack":
@@ -639,8 +655,11 @@ class Controller:
                 attempt += 1
                 try:
                     sock, msg = self._dial()
-                except UnauthorizedError:
-                    return None  # policy rejection: retrying cannot help
+                except (UnauthorizedError, UnknownSessionError):
+                    # Policy rejections — and a session that no longer
+                    # exists (destroyed while we were down) — cannot be
+                    # retried into existence.
+                    return None
                 except (ConnectionError, OSError):
                     # Includes ServerBusy: our dead slot may not be
                     # released server-side yet — exactly what the
@@ -682,3 +701,111 @@ class Controller:
 
 #: The name the coursework spec uses for this half of the split.
 EngineClient = Controller
+
+
+class SessionControl:
+    """Blocking verb client for a `--serve --sessions` server
+    (gol_tpu.sessions): create / destroy / list / checkpoint over the
+    session wire protocol. One control connection, synchronous RPCs —
+    the management half; watching a session is `Controller(session=id)`.
+
+    Not thread-safe by design (one outstanding RPC at a time). The
+    control link deliberately does NOT negotiate heartbeats: with no
+    reader between verbs, answering beacons can't be guaranteed, and an
+    hb peer silent past the eviction window would be dropped mid-idle
+    — as a legacy peer (PR 3 scheme) it is never evicted, so arbitrary
+    idle gaps between verbs are safe. Beacons the server sends anyway
+    are answered inline mid-RPC and drained at the next verb."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8030, *,
+                 secret: "str | None" = None, timeout: float = 30.0):
+        from gol_tpu.testing import faults
+
+        self._timeout = timeout
+        self._sock = faults.wrap("client", socket.create_connection(
+            (host, port), timeout=timeout
+        ))
+        self._sock.settimeout(timeout)
+        hello = {"t": "hello", "sessions": True}
+        if secret is not None:
+            hello["secret"] = secret
+        try:
+            wire.send_msg(self._sock, hello)
+            first = wire.recv_msg(self._sock, allow_binary=False)
+        except (TimeoutError, wire.WireError, OSError) as e:
+            self.close()
+            raise ConnectionError(
+                f"session-control handshake with {host}:{port} "
+                f"failed: {e}"
+            ) from None
+        if first is None or first.get("t") == "error":
+            reason = (first or {}).get("reason", "rejected")
+            self.close()
+            if reason == "unauthorized":
+                raise UnauthorizedError(reason)
+            raise ConnectionError(reason)
+        if not first.get("sessions"):
+            self.close()
+            raise ConnectionError(
+                "server does not speak the session protocol "
+                "(start it with --serve --sessions)"
+            )
+
+    def _rpc(self, msg: dict) -> dict:
+        wire.send_msg(self._sock, msg)
+        deadline = time.monotonic() + self._timeout
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError("session verb timed out")
+            reply = wire.recv_msg(self._sock, allow_binary=False)
+            if reply is None:
+                raise ConnectionError("server closed the control link")
+            t = reply.get("t")
+            if t == "hb":
+                with contextlib.suppress(OSError, wire.WireError):
+                    wire.send_msg(self._sock, {"t": "hb"})
+                continue
+            if t == "session-r" and reply.get("op") == msg.get("op"):
+                return reply
+            # clk echoes / future kinds: ignorable (forward compat).
+
+    def _checked(self, msg: dict) -> dict:
+        from gol_tpu.sessions.manager import SessionError
+
+        reply = self._rpc(msg)
+        if not reply.get("ok"):
+            raise SessionError(reply.get("reason", "rejected"))
+        return reply
+
+    def create(self, sid: str, *, width: int, height: int,
+               rule: "str | None" = None, seed: "int | None" = None,
+               density: float = 0.25) -> dict:
+        msg = {"t": "session", "op": "create", "id": sid,
+               "width": width, "height": height, "density": density}
+        if rule is not None:
+            msg["rule"] = rule
+        if seed is not None:
+            msg["seed"] = seed
+        return self._checked(msg)["session"]
+
+    def destroy(self, sid: str) -> None:
+        self._checked({"t": "session", "op": "destroy", "id": sid})
+
+    def list(self) -> list:
+        return self._checked({"t": "session", "op": "list"})["sessions"]
+
+    def checkpoint(self, sid: str) -> dict:
+        r = self._checked({"t": "session", "op": "checkpoint", "id": sid})
+        return {"path": r.get("path"), "turn": r.get("turn")}
+
+    def close(self) -> None:
+        with contextlib.suppress(OSError):
+            self._sock.shutdown(socket.SHUT_RDWR)
+        with contextlib.suppress(OSError):
+            self._sock.close()
+
+    def __enter__(self) -> "SessionControl":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -62,7 +62,7 @@ from gol_tpu.events import (
 from gol_tpu.io.pgm import read_pgm
 from gol_tpu.params import Params
 
-__all__ = ["EngineServer", "snapshot_turn"]
+__all__ = ["EngineServer", "SessionServer", "snapshot_turn"]
 
 log = logging.getLogger(__name__)
 
@@ -325,6 +325,43 @@ class _Conn:
             self.sock.shutdown(socket.SHUT_RDWR)
         with contextlib.suppress(OSError):
             self.sock.close()
+
+
+def _encode_and_send_flips(conn: _Conn, turn: int, flips, flips_levels,
+                           width: int, height: int,
+                           delta_words=None) -> None:
+    """One turn's flips in `conn`'s negotiated encoding — the single
+    encode both the singleton broadcaster and the per-session sinks
+    (SessionServer) share, so the session layer feeds the PR 4 wire
+    encodings unchanged. `delta_words` is a pre-built (bitmap, words)
+    pair when the caller amortized the encode across delta peers."""
+    lv = flips_levels if conn.levels else None
+    if conn.delta and lv is None:
+        # Delta-of-sparse (r6): changed-word masks with the bitmap
+        # delta'd against this peer's previous sent turn — on a
+        # settled board the recurring active words XOR to near
+        # nothing and zlib collapses the bitmap term. Level batches
+        # keep the LFLIPS frame (levels are not XOR state).
+        bitmap, words = (delta_words if delta_words is not None
+                         else wire.coords_to_words(flips, width, height))
+        prev = conn.delta_prev
+        conn.delta_prev = bitmap
+        conn.send_raw(wire.delta_flips_to_frame(
+            turn, bitmap if prev is None else bitmap ^ prev, words
+        ))
+    elif conn.binary:
+        conn.send_raw(
+            wire.level_flips_to_frame(turn, flips, lv)
+            if lv is not None
+            else wire.flips_to_frame(turn, flips)
+        )
+    elif conn.compact:
+        conn.send(wire.flips_to_msg(turn, flips, levels=lv))
+    else:
+        # Legacy JSON peers are two-state; levels are dropped
+        # (they could not apply them anyway).
+        conn.send({"t": "flips", "turn": turn,
+                   "cells": np.asarray(flips).tolist()})
 
 
 class EngineServer:
@@ -795,39 +832,11 @@ class EngineServer:
         `delta_words` is the shared per-turn (bitmap, words) pair for
         delta peers (see _delta_words)."""
         with tracing.span("wire.encode_flips", "wire", turn=turn):
-            self._send_flips_inner(conn, turn, flips, flips_levels,
-                                   delta_words)
-
-    def _send_flips_inner(self, conn: _Conn, turn: int, flips,
-                          flips_levels, delta_words=None) -> None:
-        lv = flips_levels if conn.levels else None
-        if conn.delta and lv is None:
-            # Delta-of-sparse (r6): changed-word masks with the bitmap
-            # delta'd against this peer's previous sent turn — on a
-            # settled board the recurring active words XOR to near
-            # nothing and zlib collapses the bitmap term. Level
-            # batches keep the LFLIPS frame (levels are not XOR
-            # state).
-            bitmap, words = (delta_words if delta_words is not None
-                             else self._delta_words(flips))
-            prev = conn.delta_prev
-            conn.delta_prev = bitmap
-            conn.send_raw(wire.delta_flips_to_frame(
-                turn, bitmap if prev is None else bitmap ^ prev, words
-            ))
-        elif conn.binary:
-            conn.send_raw(
-                wire.level_flips_to_frame(turn, flips, lv)
-                if lv is not None
-                else wire.flips_to_frame(turn, flips)
+            _encode_and_send_flips(
+                conn, turn, flips, flips_levels,
+                self.params.image_width, self.params.image_height,
+                delta_words,
             )
-        elif conn.compact:
-            conn.send(wire.flips_to_msg(turn, flips, levels=lv))
-        else:
-            # Legacy JSON peers are two-state; levels are dropped
-            # (they could not apply them anyway).
-            conn.send({"t": "flips", "turn": turn,
-                       "cells": np.asarray(flips).tolist()})
 
     def _send_stream_event(self, conn: _Conn, ev) -> None:
         """One post-sync event in this connection's encoding.
@@ -987,3 +996,486 @@ class EngineServer:
             if flush:
                 flips = []
                 flips_levels = None
+
+
+class _SessionSink:
+    """gol_tpu.sessions.Sink feeding one attached connection: board
+    syncs, per-turn flips in the connection's negotiated encoding, and
+    ts-stamped TurnComplete messages — the per-session twin of the
+    singleton broadcaster. Callbacks run on the SessionEngine thread
+    and only ever ENQUEUE to the connection's writer (never block);
+    a dead peer raises out of the callback, which detaches this sink
+    from the manager, and the server drops the connection."""
+
+    def __init__(self, server: "SessionServer", conn: _Conn, sid: str,
+                 width: int, height: int):
+        self._server = server
+        self._conn = conn
+        self.sid = sid
+        self._width = width
+        self._height = height
+
+    @property
+    def want_flips(self) -> bool:
+        return self._conn.want_flips
+
+    def on_sync(self, sid: str, turn: int, board) -> None:
+        conn = self._conn
+        try:
+            if conn.binary:
+                conn.send_raw(wire.board_to_frame(turn, board, conn.token))
+            else:
+                conn.send(wire.board_to_msg(turn, board, conn.token))
+        except (wire.WireError, OSError):
+            self._server._drop_conn(conn, detach_sink=False)
+            raise
+        conn.synced = True
+        conn.synced_turn = turn
+        conn.delta_prev = None
+
+    def on_flips(self, sid: str, turn: int, coords) -> None:
+        conn = self._conn
+        if not conn.synced or turn <= conn.synced_turn:
+            return
+        try:
+            with tracing.span("wire.encode_flips", "wire", turn=turn,
+                              session=sid):
+                _encode_and_send_flips(conn, turn, coords, None,
+                                       self._width, self._height)
+        except (wire.WireError, OSError):
+            self._server._drop_conn(conn, detach_sink=False)
+            raise
+
+    def on_turn(self, sid: str, turn: int) -> None:
+        conn = self._conn
+        if not conn.synced or turn <= conn.synced_turn:
+            return
+        tracing.event("turn.emit", "wire", turn=turn, session=sid)
+        try:
+            conn.send({"t": "ev", "k": "turn", "turn": turn,
+                       "ts": time.time()})
+        except (wire.WireError, OSError):
+            self._server._drop_conn(conn, detach_sink=False)
+            raise
+
+    def on_close(self, sid: str, reason: str) -> None:
+        conn = self._conn
+        with contextlib.suppress(Exception):
+            conn.send({"t": "bye"})
+        # Drain (bounded) BEFORE closing the socket: the bye must reach
+        # the peer so a destroy-while-attached ends its stream cleanly
+        # instead of looking like a crashed server and triggering the
+        # client's reconnect storm against a session that is gone.
+        conn.finish(timeout=2.0)
+        self._server._drop_conn(conn, detach_sink=False)
+
+
+class SessionServer:
+    """The multi-tenant serving surface (gol_tpu.sessions; CLI
+    `--serve --sessions`): a SessionManager + SessionEngine behind the
+    same wire protocol as EngineServer, with the one-board singleton
+    replaced by session multiplexing —
+
+    - hello gains a `session` field: peers attach to a NAMED session
+      (driver slot exclusive per session, observers fan out); a hello
+      without one is a CONTROL peer that only speaks session verbs;
+    - `{"t":"session","op":...}` verbs (create / destroy / list /
+      checkpoint) from any authenticated peer, answered with
+      `{"t":"session-r", ...}`;
+    - per-session checkpoints under out/sessions/<id>/ compose with
+      `--resume latest` (resume=True restores every session);
+    - heartbeats/eviction, the clock probe, binary/delta flip frames
+      and the shared-secret gate work exactly as on EngineServer —
+      the peer-side protocol is unchanged above the hello."""
+
+    HELLO_TIMEOUT = EngineServer.HELLO_TIMEOUT
+    DRAIN_TIMEOUT = EngineServer.DRAIN_TIMEOUT
+    HB_MISS_LIMIT = EngineServer.HB_MISS_LIMIT
+
+    def __init__(
+        self,
+        params: Params,
+        host: str = "127.0.0.1",
+        port: int = 8030,
+        *,
+        secret: Optional[str] = None,
+        heartbeat_secs: float = 2.0,
+        evict_secs: Optional[float] = None,
+        resume: bool = False,
+        bucket_capacity: int = 16,
+        watched_chunk: Optional[int] = None,
+        idle_chunk: Optional[int] = None,
+    ):
+        from gol_tpu.sessions import SessionEngine, SessionManager
+
+        self.params = params
+        self.heartbeat_secs = max(0.0, heartbeat_secs)
+        self.evict_secs = (
+            evict_secs if evict_secs is not None
+            else 3.0 * self.heartbeat_secs
+        )
+        self._secret = secret
+        self.manager = SessionManager(
+            out_dir=params.out_dir,
+            default_rule=params.rule,
+            bucket_capacity=bucket_capacity,
+            autosave_turns=params.autosave_turns,
+        )
+        #: Sessions restored from out/sessions/ at boot (PR 3's
+        #: `--resume latest`, composed per session).
+        self.resumed = self.manager.resume_all() if resume else 0
+        self.engine = SessionEngine(self.manager,
+                                    watched_chunk=watched_chunk,
+                                    idle_chunk=idle_chunk)
+        self._listener = socket.create_server((host, port))
+        self.address = self._listener.getsockname()
+        self._conn_lock = threading.Lock()
+        self._conns: "list[_Conn]" = []
+        #: sid -> driving connection (one driver per session).
+        self._drivers: "dict[str, _Conn]" = {}
+        #: conn -> (sid, sink) for session-attached peers.
+        self._sinks: "dict[_Conn, tuple[str, _SessionSink]]" = {}
+        self._shutdown = threading.Event()
+        self.done = threading.Event()
+        self._threads: "list[threading.Thread]" = []
+
+    # --- lifecycle ---
+
+    def start(self) -> "SessionServer":
+        self.engine.start()
+        loops = [(self._accept_loop, "gol-sess-accept")]
+        if self.heartbeat_secs > 0:
+            loops.append((self._heartbeat_loop, "gol-sess-heartbeat"))
+        for fn, name in loops:
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def shutdown(self) -> None:
+        if self._shutdown.is_set():
+            self.done.wait(timeout=1.0)
+            return
+        self._shutdown.set()
+        with contextlib.suppress(OSError):
+            self._listener.close()
+        # Close sinks through the manager first (each attached peer
+        # gets its bye in-stream), then stop the dispatch loop.
+        with contextlib.suppress(Exception):
+            self.manager.close()
+        self.engine.stop()
+        self.engine.join(timeout=30)
+        with self._conn_lock:
+            conns, self._conns = list(self._conns), []
+            self._drivers.clear()
+            self._sinks.clear()
+        for conn in conns:
+            with contextlib.suppress(Exception):
+                conn.send({"t": "bye"})
+            conn.request_finish()
+        deadline = time.monotonic() + self.DRAIN_TIMEOUT
+        for conn in conns:
+            conn.join_writer(max(0.1, deadline - time.monotonic()))
+            conn.close()
+        self.done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+    def health(self) -> dict:
+        info = self.engine.health()
+        with self._conn_lock:
+            info["peers"] = len(self._conns)
+        info["address"] = list(self.address)
+        if self._shutdown.is_set() and info.get("status") == "ok":
+            info["status"] = "shutting-down"
+        return info
+
+    # --- accept path ---
+
+    def _accept_loop(self) -> None:
+        from gol_tpu.testing import faults
+
+        while not self._shutdown.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            sock = faults.wrap("server", sock)
+            _METRICS.accepts.inc()
+            try:
+                sock.settimeout(self.HELLO_TIMEOUT)
+                hello = wire.recv_msg(sock, allow_binary=False)
+                if not hello or hello.get("t") != "hello":
+                    raise wire.WireError(f"bad hello: {hello!r}")
+            except (wire.WireError, OSError, ValueError) as e:
+                log.warning("rejecting connection from %s: %s", addr, e)
+                _METRICS.rejects["bad-hello"].inc()
+                sock.close()
+                continue
+            if self._secret is not None and not hmac.compare_digest(
+                str(hello.get("secret", "")).encode("utf-8", "replace"),
+                self._secret.encode("utf-8", "replace"),
+            ):
+                log.warning("rejecting unauthenticated attach from %s",
+                            addr)
+                _METRICS.rejects["unauthorized"].inc()
+                with contextlib.suppress(Exception):
+                    wire.send_msg(
+                        sock, {"t": "error", "reason": "unauthorized"}
+                    )
+                sock.close()
+                continue
+            self._admit(sock, hello)
+
+    def _admit(self, sock: socket.socket, hello: dict) -> None:
+        from gol_tpu.sessions import SessionError, valid_session_id
+
+        role = ("observe" if hello.get("role") == "observe" else "drive")
+        sid = hello.get("session")
+        if sid is not None and (
+            not valid_session_id(sid) or self.manager.get(sid) is None
+        ):
+            with contextlib.suppress(Exception):
+                wire.send_msg(
+                    sock, {"t": "error", "reason": "unknown-session"}
+                )
+            sock.close()
+            return
+        hb = bool(hello.get("hb", False)) and self.heartbeat_secs > 0
+        conn = _Conn(sock, bool(hello.get("want_flips", False)),
+                     compact=bool(hello.get("compact", False)),
+                     binary=bool(hello.get("binary", False)),
+                     levels=bool(hello.get("levels", False)),
+                     role=role, hb=hb,
+                     delta=bool(hello.get("delta", False)))
+        if sid is not None and role == "drive":
+            with self._conn_lock:
+                busy = sid in self._drivers
+                if not busy:
+                    self._drivers[sid] = conn
+            if busy:
+                _METRICS.rejects["busy"].inc()
+                with contextlib.suppress(Exception):
+                    wire.send_msg(sock, {"t": "error", "reason": "busy"})
+                sock.close()
+                return
+        with self._conn_lock:
+            self._conns.append(conn)
+            _METRICS.peers.set(len(self._conns))
+        _METRICS.attaches[role].inc()
+        ack = {"t": "attach-ack", "clock": True, "sessions": True}
+        if sid is not None:
+            ack["session"] = sid
+        if hb:
+            ack["hb_secs"] = self.heartbeat_secs
+        try:
+            conn.send(ack)
+        except (wire.WireError, OSError):
+            self._drop_conn(conn)
+            return
+        conn.start_writer(self._drop_conn)
+        tracing.event("server.attach", "lifecycle", role=role,
+                      token=conn.token, session=sid)
+        flight.note("server.attach", role=role, token=conn.token,
+                    session=sid)
+        if sid is not None:
+            s = self.manager.get(sid)
+            b = s.bucket if s is not None else None
+            sink = _SessionSink(self, conn, sid,
+                                b.width if b else 0,
+                                b.height if b else 0)
+            try:
+                self.manager.attach(sid, sink)
+            except (wire.WireError, OSError):
+                # The peer died during its own board sync: its slot is
+                # already released (on_sync drops the conn); the accept
+                # thread must survive.
+                self._drop_conn(conn)
+                return
+            except (SessionError, TimeoutError):
+                # Destroyed between the hello check and the attach.
+                with contextlib.suppress(Exception):
+                    conn.send({"t": "error", "reason": "unknown-session"})
+                self._drop_conn(conn)
+                return
+            with self._conn_lock:
+                self._sinks[conn] = (sid, sink)
+        threading.Thread(
+            target=self._reader_loop, args=(conn,),
+            name="gol-sess-reader", daemon=True,
+        ).start()
+
+    def _drop_conn(self, conn: _Conn, detach_sink: bool = True) -> None:
+        """Remove one peer everywhere (idempotent; any thread). With
+        `detach_sink` the manager-side sink is detached too — callbacks
+        already running inside the manager pass False (the manager is
+        removing the sink itself)."""
+        with self._conn_lock:
+            removed = conn in self._conns
+            if removed:
+                self._conns.remove(conn)
+            entry = self._sinks.pop(conn, None)
+            for sid, c in list(self._drivers.items()):
+                if c is conn:
+                    del self._drivers[sid]
+            _METRICS.peers.set(len(self._conns))
+        if removed:
+            _METRICS.detaches.inc()
+            tracing.event("server.detach", "lifecycle", role=conn.role,
+                          token=conn.token)
+        if entry is not None and detach_sink and not self._shutdown.is_set():
+            sid, sink = entry
+            with contextlib.suppress(Exception):
+                self.manager.detach(sid, sink)
+        conn.close()
+
+    # --- peer → server ---
+
+    def _reader_loop(self, conn: _Conn) -> None:
+        while True:
+            try:
+                msg = wire.recv_msg(conn.sock, allow_binary=False)
+            except TimeoutError:
+                if conn._dead.is_set():
+                    self._drop_conn(conn)
+                    return
+                continue
+            except (wire.WireError, OSError):
+                msg = None
+            if msg is None:
+                self._drop_conn(conn)
+                return
+            conn.last_rx = time.monotonic()
+            conn.hb_unanswered = 0
+            t = msg.get("t")
+            if t == "clk":
+                with contextlib.suppress(wire.WireError, OSError):
+                    conn.send_direct({"t": "clk", "t0": msg.get("t0"),
+                                      "ts": time.time()})
+                continue
+            if t == "session":
+                self._handle_session_op(conn, msg)
+                continue
+            if t != "key":
+                continue
+            if not self._handle_key(conn, msg.get("key")):
+                return
+
+    def _handle_key(self, conn: _Conn, key) -> bool:
+        """Session-mode verb routing; False ends the reader loop."""
+        with self._conn_lock:
+            entry = self._sinks.get(conn)
+        if key == "q":
+            if entry is not None:
+                sid, sink = entry
+                with contextlib.suppress(Exception):
+                    self.manager.detach(sid, sink)
+            self._release_slot(conn)
+            with contextlib.suppress(Exception):
+                conn.send({"t": "detached"})
+            conn.finish()
+            self._drop_conn(conn, detach_sink=False)
+            return False
+        if key == "s" and entry is not None and conn.role == "drive":
+            # The snapshot verb, scoped to this peer's session.
+            from gol_tpu.sessions import SessionError
+
+            with contextlib.suppress(SessionError, TimeoutError):
+                self.manager.checkpoint(entry[0])
+            return True
+        with contextlib.suppress(Exception):
+            conn.send({"t": "error",
+                       "reason": ("observer" if conn.role == "observe"
+                                  else "unsupported")})
+        return True
+
+    def _release_slot(self, conn: _Conn) -> None:
+        with self._conn_lock:
+            self._sinks.pop(conn, None)
+            for sid, c in list(self._drivers.items()):
+                if c is conn:
+                    del self._drivers[sid]
+
+    def _handle_session_op(self, conn: _Conn, msg: dict) -> None:
+        """One `{"t":"session"}` verb; every outcome is an in-stream
+        `session-r` reply — a malformed request must never kill the
+        reader or wedge the peer waiting."""
+        from gol_tpu.sessions import SessionError
+
+        op = msg.get("op")
+        reply = {"t": "session-r", "op": op}
+        try:
+            if op == "create":
+                density = msg.get("density", 0.25)
+                info = self.manager.create(
+                    msg.get("id"),
+                    width=msg.get("width"), height=msg.get("height"),
+                    rule=msg.get("rule"), seed=msg.get("seed"),
+                    density=float(density),
+                )
+                reply.update(ok=True, session=info)
+            elif op == "destroy":
+                self.manager.destroy(msg.get("id"))
+                reply.update(ok=True, id=msg.get("id"))
+            elif op == "list":
+                reply.update(ok=True,
+                             sessions=self.manager.list_sessions())
+            elif op == "checkpoint":
+                r = self.manager.checkpoint(msg.get("id"))
+                reply.update(ok=True, id=msg.get("id"), **r)
+            else:
+                reply.update(ok=False, reason="unknown-op")
+        except SessionError as e:
+            reply.update(ok=False, reason=str(e))
+        except (TypeError, ValueError, KeyError):
+            reply.update(ok=False, reason="bad-request")
+        except TimeoutError:
+            reply.update(ok=False, reason="busy")
+        with contextlib.suppress(wire.WireError, OSError):
+            conn.send(reply)
+
+    # --- liveness (the EngineServer discipline, per session) ---
+
+    def _heartbeat_loop(self) -> None:
+        interval = max(0.05, self.heartbeat_secs / 2.0)
+        while not self._shutdown.wait(interval):
+            now = time.monotonic()
+            with self._conn_lock:
+                conns = list(self._conns)
+                sids = dict((c, s[0]) for c, s in self._sinks.items())
+            for conn in conns:
+                if conn._writer is None:
+                    continue
+                if (conn.hb and conn.hb_unanswered >= self.HB_MISS_LIMIT
+                        and now - conn.last_rx > self.evict_secs):
+                    log.warning(
+                        "evicting unresponsive session peer (silent "
+                        "%.1fs)", now - conn.last_rx,
+                    )
+                    _METRICS.evicted.inc()
+                    tracing.event("server.evict", "lifecycle",
+                                  role=conn.role, token=conn.token)
+                    flight.note("server.evict", role=conn.role,
+                                token=conn.token)
+                    self._drop_conn(conn)
+                    flight.dump("peer-eviction")
+                    continue
+                if now - conn.last_tx >= self.heartbeat_secs:
+                    # peek_turn, NOT manager.get: the manager lock is
+                    # held across whole bucket dispatches (cold
+                    # compiles included) and a beacon that waits on it
+                    # defeats its own purpose — liveness must stay
+                    # engine-loop independent (docs/RESILIENCE.md).
+                    turn = self.manager.peek_turn(sids.get(conn, ""))
+                    try:
+                        if conn.binary:
+                            conn.send_raw(wire.heartbeat_to_frame(turn))
+                        else:
+                            conn.send({"t": "hb", "turn": turn})
+                    except (wire.WireError, OSError):
+                        self._drop_conn(conn)
+                        continue
+                    _METRICS.heartbeats.inc()
+                    if conn.hb:
+                        conn.hb_unanswered += 1

@@ -23,14 +23,32 @@ wire_watched ran at the device-link bound, ~10-12 MB/s).
 Message catalog:
   controller → engine:
     {"t":"hello","want_flips":bool[,"secret":s][,"compact":bool]
-                 [,"binary":bool]}
+                 [,"binary":bool][,"session":id][,"sessions":true]}
         attach + subscription (the secret authenticates when the server
         was started with one — the reference's :8030 listener was open
         to any peer, ref: gol/distributor.go:49-52; that is a flaw to
         beat. "compact" advertises the zlib'd flips encoding; "binary"
         the raw tag+header+zlib frames; servers send legacy JSON to
-        peers that advertise neither.)
+        peers that advertise neither. "session" targets a NAMED session
+        on a multi-tenant `--serve --sessions` server — unknown ids are
+        rejected with {"t":"error","reason":"unknown-session"}; a hello
+        with neither "session" nor a singleton board behind it is a
+        CONTROL peer that only speaks the session verbs below.)
     {"t":"key","key":"p|s|q|k"}       keyboard verb (ref: sdl/loop.go:18-27)
+  session verbs (gol_tpu.sessions; either direction is JSON-only —
+  docs/SESSIONS.md):
+    {"t":"session","op":"create","id":s,"width":W,"height":H
+                   [,"rule":r][,"seed":n][,"density":f]}
+    {"t":"session","op":"destroy"|"checkpoint","id":s}
+    {"t":"session","op":"list"}
+        any authenticated peer may manage sessions; every request is
+        answered in-stream by
+    {"t":"session-r","op":...,"ok":bool[,"reason":s][,"session":{...}]
+                     [,"sessions":[...]][,"path":p][,"turn":N]}
+        failure reasons are single tokens ("exists", "unknown-session",
+        "bad-dimensions", "bad-rule", "bad-request", ...) — the fuzz
+        suite pins that a malformed verb gets a reasoned rejection,
+        never a dead reader thread.
   engine → controller:
     {"t":"board","turn":N,"width":W,"height":H,"data":b64}  attach sync
     {"t":"flips","turn":N,"cells_z":b64}                    per-turn diff

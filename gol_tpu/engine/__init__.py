@@ -1,3 +1,8 @@
-from gol_tpu.engine.distributor import Engine, EventQueue, run
+from gol_tpu.engine.distributor import (
+    Engine,
+    EventQueue,
+    register_live_engine,
+    run,
+)
 
-__all__ = ["Engine", "EventQueue", "run"]
+__all__ = ["Engine", "EventQueue", "register_live_engine", "run"]

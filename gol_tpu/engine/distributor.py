@@ -95,7 +95,15 @@ DIFF_SPARSE_MIN_CAP = 64
 # threading._register_atexit, which runs at the start of
 # threading._shutdown (the hook concurrent.futures relies on for the
 # same problem).
-_live_engines: "weakref.WeakSet[Engine]" = weakref.WeakSet()
+_live_engines: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_live_engine(engine) -> None:
+    """Enroll any device-owning loop (Engine, sessions.SessionEngine)
+    in the interpreter-exit stop discipline above. Duck-typed: the
+    object needs `stop()` and `join(timeout)`; weakly held, so
+    enrollment never extends a loop's lifetime."""
+    _live_engines.add(engine)
 
 
 def _stop_live_engines() -> None:
@@ -404,7 +412,7 @@ class Engine:
         `run()`'s finally closes the stream — so waiting for it at exit
         is bounded once the run finishes or is told to stop."""
         self._thread = threading.Thread(target=self.run, name="gol-engine")
-        _live_engines.add(self)
+        register_live_engine(self)
         self._thread.start()
         return self
 

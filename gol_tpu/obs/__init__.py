@@ -49,6 +49,7 @@ from gol_tpu.obs.registry import (
     gauge,
     histogram,
     registry,
+    remove,
     set_enabled,
 )
 
@@ -66,6 +67,7 @@ __all__ = [
     "gauge",
     "histogram",
     "registry",
+    "remove",
     "set_enabled",
 ]
 

@@ -60,6 +60,7 @@ __all__ = [
     "gauge",
     "histogram",
     "registry",
+    "remove",
     "set_enabled",
 ]
 
@@ -324,6 +325,18 @@ class Registry:
         return self._get_or_create(Histogram, name, help, labels,
                                    buckets=buckets)
 
+    def remove(self, name: str, labels: Optional[dict] = None) -> bool:
+        """Evict one labeled series (e.g. a destroyed session's child
+        metrics — gol_tpu.sessions). Bounded-cardinality discipline:
+        per-ENTITY labels are legal only if the entity's teardown calls
+        this, otherwise the registry grows without bound under churn.
+        Returns False when the series was never registered. A handle
+        obtained earlier keeps working but lands nowhere visible; the
+        next get-or-create under the same identity starts fresh."""
+        key = (name, _labels_key(labels))
+        with self._lock:
+            return self._metrics.pop(key, None) is not None
+
     def metrics(self) -> list:
         with self._lock:
             return list(self._metrics.values())
@@ -381,3 +394,7 @@ def gauge(name: str, help: str = "", labels: Optional[dict] = None) -> Gauge:
 def histogram(name: str, help: str = "", labels: Optional[dict] = None,
               buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
     return REGISTRY.histogram(name, help, labels, buckets)
+
+
+def remove(name: str, labels: Optional[dict] = None) -> bool:
+    return REGISTRY.remove(name, labels)

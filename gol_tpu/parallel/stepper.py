@@ -146,22 +146,30 @@ class Stepper:
         return int(self.alive_count_async(world))
 
 
+def _diff_scan(step_fn, diff_fn, count_fn, state, k):
+    """The un-jitted k-turn diff scan both `scan_diffs` (single board)
+    and the vmapped session-bucket builder trace — one body so the two
+    paths cannot drift."""
+    from jax import lax as _lax
+
+    def body(q, _):
+        new = step_fn(q)
+        return new, diff_fn(q, new)
+
+    new, diffs = _lax.scan(body, state, None, length=max(int(k), 0))
+    return new, diffs, count_fn(new)
+
+
 def scan_diffs(step_fn, diff_fn, count_fn, post=None):
     """Build a `step_n_with_diffs` by scanning a single-turn step: the
     carry is the world, the per-turn output is `diff_fn(old, new)`, and
     the alive count is computed once on the final state — all one device
     program. `post` (optional) wraps the scanned (state, diffs, count)
     triple, e.g. to psum a sharded count."""
-    from jax import lax as _lax
 
     @functools.partial(jax.jit, static_argnames=("k",))
     def step_n_with_diffs(state, k):
-        def body(q, _):
-            new = step_fn(q)
-            return new, diff_fn(q, new)
-
-        new, diffs = _lax.scan(body, state, None, length=max(int(k), 0))
-        out = (new, diffs, count_fn(new))
+        out = _diff_scan(step_fn, diff_fn, count_fn, state, k)
         return post(*out) if post is not None else out
 
     return step_n_with_diffs
@@ -265,39 +273,47 @@ def compact_scan_diffs(step_fn, diff_fn, count_fn, post=None):
     runs under plain jit over the sharded diff, the value buffer stays
     unsharded, and `post` pins headers + values replicated so any
     process can materialize them."""
-    import jax.numpy as jnp
-    from jax import lax as _lax
 
     @functools.partial(jax.jit, static_argnames=("k", "total_cap"))
     def step_n_with_diffs_compact(state, k, total_cap):
-        def body(carry, _):
-            q, off, buf = carry
-            new = step_fn(q)
-            d = diff_fn(q, new).reshape(-1)
-            nb = sparse_bitmap_words(d.shape[0])
-            changed = d != 0
-            padded = jnp.pad(changed, (0, nb * 32 - d.shape[0]))
-            m = jnp.sum(changed, dtype=jnp.int32)
-            bits = padded.astype(jnp.uint32).reshape(nb, 32)
-            weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
-            bitmap = jnp.sum(bits * weights, axis=1, dtype=jnp.uint32)
-            rank = jnp.cumsum(changed, dtype=jnp.int32) - 1
-            target = jnp.where(changed, off + rank, jnp.int32(total_cap))
-            buf = buf.at[target].set(d, mode="drop")
-            header = jnp.concatenate([m[None].astype(jnp.uint32), bitmap])
-            return (new, off + m, buf), _lax.bitcast_convert_type(
-                header, jnp.int32
-            )
-
-        buf0 = jnp.zeros((total_cap,), jnp.uint32)
-        (new, _total, buf), headers = _lax.scan(
-            body, (state, jnp.int32(0), buf0), None, length=max(int(k), 0)
-        )
-        out = (new, headers, _lax.bitcast_convert_type(buf, jnp.int32),
-               count_fn(new))
+        out = _compact_scan(step_fn, diff_fn, count_fn, state, k, total_cap)
         return post(*out) if post is not None else out
 
     return step_n_with_diffs_compact
+
+
+def _compact_scan(step_fn, diff_fn, count_fn, state, k, total_cap):
+    """The un-jitted compact-diff scan (layout contract documented on
+    `compact_scan_diffs`) shared by the single-board builder above and
+    the vmapped session-bucket builder — one body, one layout."""
+    import jax.numpy as jnp
+    from jax import lax as _lax
+
+    def body(carry, _):
+        q, off, buf = carry
+        new = step_fn(q)
+        d = diff_fn(q, new).reshape(-1)
+        nb = sparse_bitmap_words(d.shape[0])
+        changed = d != 0
+        padded = jnp.pad(changed, (0, nb * 32 - d.shape[0]))
+        m = jnp.sum(changed, dtype=jnp.int32)
+        bits = padded.astype(jnp.uint32).reshape(nb, 32)
+        weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+        bitmap = jnp.sum(bits * weights, axis=1, dtype=jnp.uint32)
+        rank = jnp.cumsum(changed, dtype=jnp.int32) - 1
+        target = jnp.where(changed, off + rank, jnp.int32(total_cap))
+        buf = buf.at[target].set(d, mode="drop")
+        header = jnp.concatenate([m[None].astype(jnp.uint32), bitmap])
+        return (new, off + m, buf), _lax.bitcast_convert_type(
+            header, jnp.int32
+        )
+
+    buf0 = jnp.zeros((total_cap,), jnp.uint32)
+    (new, _total, buf), headers = _lax.scan(
+        body, (state, jnp.int32(0), buf0), None, length=max(int(k), 0)
+    )
+    return (new, headers, _lax.bitcast_convert_type(buf, jnp.int32),
+            count_fn(new))
 
 
 def compact_decode_rows(headers, values, total_words: int):
@@ -365,6 +381,217 @@ def compact_value_prefix(values, total: int):
         return _np.zeros(0, _np.uint32)
     n = min(int(values.shape[0]), compact_value_bucket(total))
     return _np.ascontiguousarray(_np.asarray(values[:n])).view(_np.uint32)
+
+
+@dataclasses.dataclass
+class BatchStepper:
+    """Vmapped execution backend for one session BUCKET
+    (gol_tpu.sessions): `capacity` same-shape/same-rule boards stacked
+    on a leading axis — uint32 (S, H/32, W) packed words when the grid
+    packs, uint8 (S, H, W) otherwise — and stepped by ONE jitted
+    dispatch, so S tenants share a single device program and its fixed
+    dispatch overhead (ROADMAP open item 3: the measured ~0.333 s fixed
+    cost of `engine_512x512` amortizes across the bucket).
+
+    Recompile discipline (the PR 1 recompile lint's dynamic twin,
+    pinned by tests/test_sessions.py): `capacity` and the chunk size
+    are the ONLY shape-bearing statics. Slot indices are TRACED
+    arguments everywhere (`dynamic_index_in_dim` / `.at[i].set`), so
+    session create/destroy/checkpoint inside a warm bucket — including
+    against padding slots — never builds a new executable. Growing a
+    bucket past its capacity is a new BatchStepper and a recompile, by
+    design.
+
+    Padding: free slots hold all-zero boards and are stepped like any
+    tenant (one program for the whole stack — masking individual slots
+    would put a per-slot branch inside the kernel). A zero board stays
+    zero under any rule without birth-on-0, which is why the factory
+    rejects B0 rules: their padding slots would seethe and saturate the
+    shared compact value buffer."""
+
+    name: str
+    capacity: int
+    height: int
+    width: int
+    rule: Rule
+    packed: bool
+    #: packed words per board (0 on the dense fallback) — the decode
+    #: space `compact_decode_rows`/`sparse_decode_rows` need.
+    total_words: int
+    #: list of `capacity` host (H, W) uint8 boards -> device stack
+    put_all: Callable
+    #: (stack, slot) -> host (H, W) {0,255} uint8 board (slot TRACED)
+    fetch_one: Callable
+    #: (stack, slot, host (H, W) board) -> stack (slot TRACED)
+    set_one: Callable
+    #: (stack, slot) -> stack with that slot zeroed (slot TRACED)
+    clear_one: Callable
+    #: (stack, k) -> (stack, (S,) int32 per-session alive counts)
+    step_n: Callable
+    #: (stack, k) -> (stack, per-session diff stacks, counts): uint32
+    #: (S, k, H/32, W) packed XOR rows when packed, bool (S, k, H, W)
+    #: dense masks otherwise — row t of session s is exactly what the
+    #: single-board `step_n_with_diffs` would have produced for that
+    #: board (same scan body; pinned by bit-equality tests).
+    step_n_with_diffs: Callable
+    #: (stack, k, total_cap) -> (stack, (S, k, 1+nb) int32 headers,
+    #: (S, total_cap) int32 values, counts): the PR 4 variable-length
+    #: compact encoding vmapped per session — each session gets its own
+    #: [count, bitmap] headers and its own stream-compacted value
+    #: buffer, decodable by the existing `compact_decode_rows`, so
+    #: per-session chunks feed the wire encoding unchanged. None on the
+    #: dense fallback.
+    step_n_with_diffs_compact: Optional[Callable] = None
+    #: () -> {entry: compiled-executable count}: the jit-cache census
+    #: the zero-recompile acceptance test pins (create/step/destroy in
+    #: a warm bucket must not move any of these).
+    cache_sizes: Optional[Callable] = None
+
+
+def make_batch_stepper(capacity: int, height: int, width: int,
+                       rule: Rule | str = LIFE, device=None) -> BatchStepper:
+    """Build the vmapped bucket backend: packed SWAR per session when
+    the grid packs (the same `bitlife.step_packed` arithmetic as the
+    single-board packed stepper, vmapped over the session axis), the
+    dense kernel otherwise. Two-state rules only — multi-state
+    Generations sessions would need per-bucket plane stacks and belong
+    to a later round."""
+    import jax.numpy as jnp
+    import numpy as _np
+    from jax import lax as _lax
+
+    rule = get_rule(rule) if isinstance(rule, str) else rule
+    if isinstance(rule, GenRule):
+        raise ValueError(
+            "session buckets are two-state only (multi-state rules "
+            "need per-bucket plane stacks — not yet offered)"
+        )
+    if 0 in rule.birth:
+        raise ValueError(
+            f"rule {rule} births on 0 neighbours — empty padding slots "
+            "would seethe, so B0 rules cannot share a padded bucket"
+        )
+    if capacity < 1:
+        raise ValueError("bucket capacity must be >= 1")
+    dev = device or jax.devices()[0]
+
+    from gol_tpu.ops import bitlife
+
+    if bitlife.packable(height, width):
+        step1 = lambda p: bitlife.step_packed(p, rule)  # noqa: E731
+        diff1 = lambda old, new: old ^ new              # noqa: E731
+        count1 = bitlife.count_packed
+        vstep = jax.vmap(step1)
+
+        def put_all(boards):
+            if len(boards) != capacity:
+                raise ValueError(
+                    f"put_all needs {capacity} boards, got {len(boards)}"
+                )
+            return jax.device_put(
+                _np.stack([bitlife.pack_np(b) for b in boards]), dev
+            )
+
+        def _host_one(board):
+            return bitlife.pack_np(board)
+
+        def _to_host(one):
+            return bitlife.unpack_np(_np.asarray(one), height)
+    else:
+        from gol_tpu.ops import life as _life
+
+        step1 = lambda w: _life.step(w, rule=rule)      # noqa: E731
+        diff1 = lambda old, new: old != new             # noqa: E731
+        count1 = _life.alive_count
+        vstep = jax.vmap(step1)
+
+        def put_all(boards):
+            if len(boards) != capacity:
+                raise ValueError(
+                    f"put_all needs {capacity} boards, got {len(boards)}"
+                )
+            return jax.device_put(
+                _np.stack([_np.asarray(b, _np.uint8) for b in boards]),
+                dev,
+            )
+
+        def _host_one(board):
+            return _np.asarray(board, _np.uint8)
+
+        def _to_host(one):
+            return _np.asarray(one)
+
+    @jax.jit
+    def _take(stack, slot):
+        return _lax.dynamic_index_in_dim(stack, slot, keepdims=False)
+
+    @jax.jit
+    def _set(stack, slot, one):
+        return stack.at[slot].set(one)
+
+    @jax.jit
+    def _clear(stack, slot):
+        return stack.at[slot].set(jnp.zeros_like(stack[0]))
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def step_n(stack, k):
+        out = _lax.fori_loop(0, max(int(k), 0), lambda _, q: vstep(q),
+                             stack)
+        return out, jax.vmap(count1)(out)
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def step_n_with_diffs(stack, k):
+        return jax.vmap(
+            lambda s: _diff_scan(step1, diff1, count1, s, k)
+        )(stack)
+
+    packed = bitlife.packable(height, width)
+    if packed:
+        @functools.partial(jax.jit, static_argnames=("k", "total_cap"))
+        def step_n_with_diffs_compact(stack, k, total_cap):
+            return jax.vmap(
+                lambda s: _compact_scan(step1, diff1, count1, s, k,
+                                        total_cap)
+            )(stack)
+    else:
+        step_n_with_diffs_compact = None
+
+    def fetch_one(stack, slot):
+        return _to_host(_take(stack, slot))
+
+    def set_one(stack, slot, board):
+        b = _np.asarray(board)
+        if b.shape != (height, width):
+            raise ValueError(f"board shape {b.shape} != {(height, width)}")
+        return _set(stack, slot, jax.device_put(_host_one(b), dev))
+
+    jits = {"take": _take, "set": _set, "clear": _clear,
+            "step_n": step_n, "diffs": step_n_with_diffs}
+    if step_n_with_diffs_compact is not None:
+        jits["compact"] = step_n_with_diffs_compact
+
+    def cache_sizes():
+        return {name: fn._cache_size() for name, fn in jits.items()
+                if hasattr(fn, "_cache_size")}
+
+    return BatchStepper(
+        name=("bucket-packed" if packed else "bucket-dense")
+        + f"-{capacity}",
+        capacity=capacity,
+        height=height,
+        width=width,
+        rule=rule,
+        packed=packed,
+        total_words=(height // 32) * width if packed else 0,
+        put_all=put_all,
+        fetch_one=fetch_one,
+        set_one=set_one,
+        clear_one=lambda stack, slot: _clear(stack, slot),
+        step_n=step_n,
+        step_n_with_diffs=step_n_with_diffs,
+        step_n_with_diffs_compact=step_n_with_diffs_compact,
+        cache_sizes=cache_sizes,
+    )
 
 
 def _single_device(rule: Rule, device=None) -> Stepper:

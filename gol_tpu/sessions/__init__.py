@@ -1,0 +1,50 @@
+"""gol_tpu.sessions — the multi-tenant session layer: S boards, one jit.
+
+Every layer below this one (engine, wire, obs, resilience) assumed
+exactly one board per process; production traffic is many SMALL boards
+(ROADMAP open item 3). This package turns the engine into a service:
+
+- **buckets** — sessions with the same (height, width, rule) stack
+  into one `(S, H/32, W)` packed device array stepped by a single
+  vmapped/jitted dispatch (`parallel.stepper.make_batch_stepper`), so
+  S tenants amortize one dispatch's fixed overhead instead of paying
+  it S times;
+- **padding / slot reuse** — free slots are zero boards stepped along
+  with the tenants; create/destroy inside a warm bucket only touch
+  TRACED slot indices, so joins and leaves never recompile (the PR 1
+  recompile discipline, pinned by the jit-cache census test);
+- **per-session diff streams** — watched buckets ride the PR 4
+  variable-length compact encoding vmapped per session; each session's
+  decoded flip rows feed the existing wire encodings unchanged;
+- **lifecycle verbs** — create / destroy / checkpoint / list, exposed
+  over the wire by `distributed.server.SessionServer` (CLI:
+  `--serve --sessions`) and driven by `distributed.client.SessionControl`;
+  watching peers attach with `Controller(session="id")`;
+- **checkpoint/resume** — per-session PGM snapshots under
+  `out/sessions/<id>/` with a `session.json` sidecar; `--resume latest`
+  restores every session (composing with the PR 3 crash-restart story);
+- **bounded observability** — per-session metric labels
+  (`gol_tpu_session_turns_total{session=...}`) are EVICTED at destroy
+  (`obs.Registry.remove`), so the registry cannot grow without bound
+  under churn; lifecycle and dispatch land on the PR 2/PR 5 planes.
+
+Model: docs/SESSIONS.md.
+"""
+
+from gol_tpu.sessions.manager import (
+    Session,
+    SessionError,
+    SessionManager,
+    Sink,
+    valid_session_id,
+)
+from gol_tpu.sessions.engine import SessionEngine
+
+__all__ = [
+    "Session",
+    "SessionEngine",
+    "SessionError",
+    "SessionManager",
+    "Sink",
+    "valid_session_id",
+]

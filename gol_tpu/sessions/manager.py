@@ -1,0 +1,752 @@
+"""SessionManager — bucketed multi-tenant board ownership.
+
+Threading contract (the engine-thread discipline of
+`engine.distributor`, applied to buckets): when a `SessionEngine` is
+running, ITS thread is the only one that touches device arrays —
+public verbs from other threads post requests the engine services
+between dispatches. Without an engine (tests, the bench), the calling
+thread owns the device and verbs execute inline. Bookkeeping dicts are
+guarded by one lock either way, so `list_sessions` is safe from any
+thread and never touches the device.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import re
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from gol_tpu import obs
+from gol_tpu.models.rules import GenRule, LIFE, Rule, get_rule
+from gol_tpu.obs import flight, tracing
+
+#: Session ids are path components (checkpoints live under
+#: out/sessions/<id>/) and metric label values — one conservative
+#: charset serves both, and rejects traversal outright.
+SESSION_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]{0,63}$")
+
+#: Per-session registry series — the exact set `destroy` evicts
+#: (tests pin that the registry shrinks back under churn).
+PER_SESSION_SERIES = (
+    "gol_tpu_session_turns_total",
+    "gol_tpu_session_watchers",
+)
+
+#: Board-dimension sanity bound for wire-driven creates: a hostile
+#: create must not make the server allocate an arbitrary raster.
+MAX_SESSION_CELLS = 4096 * 4096
+
+#: Minimum per-turn changed-words cap once the compact encoding
+#: engages (the engine's DIFF_SPARSE_MIN_CAP, same rationale).
+COMPACT_MIN_CAP = 64
+
+
+def valid_session_id(sid) -> bool:
+    return isinstance(sid, str) and bool(SESSION_ID_RE.match(sid))
+
+
+class SessionError(ValueError):
+    """A session verb failed for a caller-visible reason (unknown id,
+    duplicate create, invalid geometry/rule). The message is the wire
+    `reason` — keep it one short token-ish phrase."""
+
+
+class _SessionMetrics:
+    """Registry handles for the session plane (gol_tpu.obs). Bucket-
+    and process-level series are unbounded-lifetime; per-SESSION
+    children are created at `create` and evicted at `destroy` (see
+    PER_SESSION_SERIES)."""
+
+    def __init__(self):
+        self.active = obs.gauge(
+            "gol_tpu_sessions_active", "Currently live sessions"
+        )
+        self.buckets = obs.gauge(
+            "gol_tpu_session_buckets", "Shape/rule buckets currently held"
+        )
+        self.creates = obs.counter(
+            "gol_tpu_session_creates_total", "Sessions created"
+        )
+        self.destroys = obs.counter(
+            "gol_tpu_session_destroys_total", "Sessions destroyed"
+        )
+        self.checkpoints = obs.counter(
+            "gol_tpu_session_checkpoints_total",
+            "Per-session PGM checkpoints written",
+        )
+        self.resumes = obs.counter(
+            "gol_tpu_session_resumes_total",
+            "Sessions restored from per-session checkpoints",
+        )
+        paths = ("fused", "diffs", "compact")
+        self.dispatches = {
+            p: obs.counter(
+                "gol_tpu_session_dispatches_total",
+                "Bucket dispatches by path", {"path": p},
+            ) for p in paths
+        }
+        self.dispatch_seconds = {
+            p: obs.histogram(
+                "gol_tpu_session_dispatch_seconds",
+                "Host-blocking seconds per bucket dispatch", {"path": p},
+            ) for p in paths
+        }
+        self.compact_redos = obs.counter(
+            "gol_tpu_session_compact_redos_total",
+            "Bucket chunks redone densely after a value-buffer overflow",
+        )
+        self.bucket_grows = obs.counter(
+            "gol_tpu_session_bucket_grows_total",
+            "Bucket capacity doublings (each is one recompile)",
+        )
+
+
+_METRICS = _SessionMetrics()
+
+
+class Sink:
+    """Per-session event consumer protocol. All callbacks run on the
+    dispatching thread (the SessionEngine's, or the caller's in inline
+    mode) — implementations must be non-blocking (the server sink
+    enqueues to per-connection writer queues). Exceptions raised by a
+    sink detach it."""
+
+    #: Sinks that don't want per-turn flip payloads still get
+    #: `on_sync`/`on_turn`/`on_close`.
+    want_flips = True
+
+    def on_sync(self, sid: str, turn: int, board: np.ndarray) -> None:
+        """Full board state at attach (and after any resync)."""
+
+    def on_flips(self, sid: str, turn: int, coords: np.ndarray) -> None:
+        """One turn's flipped cells as an (N, 2) int32 x,y array —
+        exactly the single-board engine's FlipBatch payload."""
+
+    def on_turn(self, sid: str, turn: int) -> None:
+        """A turn committed for this session."""
+
+    def on_close(self, sid: str, reason: str) -> None:
+        """The session is gone (destroyed / manager shutdown)."""
+
+
+class Session:
+    """One tenant: a slot in a bucket plus its own turn clock."""
+
+    def __init__(self, sid: str, bucket: "_Bucket", slot: int,
+                 start_turn: int):
+        self.id = sid
+        self.bucket = bucket
+        self.slot = slot
+        self.start_turn = start_turn
+        self.birth_ticks = bucket.ticks
+        self.created_at = time.time()
+        # Per-session labeled children — evicted at destroy.
+        self.turns_metric = obs.counter(
+            "gol_tpu_session_turns_total",
+            "Turns committed per live session (evicted at destroy)",
+            {"session": sid},
+        )
+        self.watchers_metric = obs.gauge(
+            "gol_tpu_session_watchers",
+            "Sinks attached per live session (evicted at destroy)",
+            {"session": sid},
+        )
+
+    @property
+    def turn(self) -> int:
+        """Completed turns: sessions in a bucket step in lockstep, so a
+        session's clock is its resume offset plus the bucket ticks
+        since it joined."""
+        return self.start_turn + (self.bucket.ticks - self.birth_ticks)
+
+    def info(self) -> dict:
+        b = self.bucket
+        return {
+            "id": self.id,
+            "width": b.width,
+            "height": b.height,
+            "rule": str(b.rule),
+            "turn": self.turn,
+            "watchers": len(b.sinks.get(self.id, ())),
+            "bucket": b.key,
+        }
+
+
+class _Bucket:
+    """One (height, width, rule) shape class: a BatchStepper, its
+    stacked device state, and the slot bookkeeping."""
+
+    def __init__(self, height: int, width: int, rule: Rule,
+                 capacity: int, device=None):
+        from gol_tpu.parallel.stepper import make_batch_stepper
+
+        self.height, self.width, self.rule = height, width, rule
+        self.key = f"{width}x{height}/{rule}"
+        self.device = device
+        self.bs = make_batch_stepper(capacity, height, width, rule,
+                                     device)
+        zero = np.zeros((height, width), np.uint8)
+        self.stack = self.bs.put_all([zero] * capacity)
+        #: Free slots, lowest first (pop from the end).
+        self.free = list(range(capacity - 1, -1, -1))
+        self.sessions: "dict[int, Session]" = {}   # slot -> Session
+        self.sinks: "dict[str, list[Sink]]" = {}   # sid -> sinks
+        #: Total turns this bucket has stepped since creation — every
+        #: occupied slot advances by exactly this clock.
+        self.ticks = 0
+        #: Adaptive per-turn changed-words cap for the compact path
+        #: (None = not yet enabled; next watched chunk runs plain
+        #: diffs to observe activity). Pow2 with 2x headroom, exactly
+        #: the engine's `_adapt_sparse_cap` hysteresis.
+        self.compact_cap: Optional[int] = None
+        self.last_save_tick = 0
+
+    @property
+    def live(self) -> int:
+        return len(self.sessions)
+
+    def watched(self) -> bool:
+        return any(self.sinks.get(s.id) for s in self.sessions.values())
+
+    def flip_watched(self) -> bool:
+        return any(
+            sink.want_flips
+            for s in self.sessions.values()
+            for sink in self.sinks.get(s.id, ())
+        )
+
+    def adapt_cap(self, peak_words: int) -> None:
+        ceiling = self.bs.total_words // 2
+        if (self.bs.step_n_with_diffs_compact is None
+                or ceiling < COMPACT_MIN_CAP or 2 * peak_words > ceiling):
+            new = None
+        else:
+            want = (
+                max(COMPACT_MIN_CAP, 1 << (2 * peak_words - 1).bit_length())
+                if peak_words else COMPACT_MIN_CAP
+            )
+            new = min(want, 1 << (ceiling.bit_length() - 1))
+        if new != self.compact_cap:
+            # Each distinct cap is one recompile of the k-turn scan —
+            # timeline-worthy, exactly like the engine's sparse cap.
+            tracing.event("session.compact_cap", "engine",
+                          bucket=self.key, cap=new, peak=peak_words)
+        self.compact_cap = new
+
+
+class SessionManager:
+    def __init__(self, *, out_dir: str = "out",
+                 default_rule: "Rule | str" = LIFE,
+                 bucket_capacity: int = 16,
+                 autosave_turns: int = 0,
+                 device=None):
+        if bucket_capacity < 1:
+            raise ValueError("bucket_capacity must be >= 1")
+        self.out_dir = out_dir
+        self.default_rule = (get_rule(default_rule)
+                             if isinstance(default_rule, str)
+                             else default_rule)
+        self.bucket_capacity = bucket_capacity
+        self.autosave_turns = max(0, int(autosave_turns))
+        self.device = device
+        self._buckets: "dict[tuple, _Bucket]" = {}
+        self._by_id: "dict[str, Session]" = {}
+        self._lock = threading.RLock()
+        #: Cross-thread verb requests: (fn, event, box) serviced by the
+        #: engine thread between dispatches (see `_exec`).
+        self._requests: list = []
+        #: The SessionEngine driving this manager, if any (set by the
+        #: engine itself); its kick event wakes an idle loop when a
+        #: request lands.
+        self._engine = None
+        self._kick = threading.Event()
+        self._closed = False
+
+    # --- public verbs (any thread) ---
+
+    def create(self, sid: str, *, width: int, height: int,
+               rule: "Rule | str | None" = None,
+               board: Optional[np.ndarray] = None,
+               seed: Optional[int] = None, density: float = 0.25,
+               start_turn: int = 0) -> dict:
+        """Create a session; returns its info dict. `board` wins over
+        `seed` (a deterministic random soup); neither means an empty
+        board. Raises SessionError on invalid ids/geometry/rules or a
+        duplicate id."""
+        if not valid_session_id(sid):
+            raise SessionError("bad-session-id")
+        if (not isinstance(width, int) or not isinstance(height, int)
+                or width <= 0 or height <= 0
+                or width * height > MAX_SESSION_CELLS):
+            raise SessionError("bad-dimensions")
+        try:
+            rule_obj = (self.default_rule if rule is None
+                        else get_rule(rule) if isinstance(rule, str)
+                        else rule)
+        except ValueError:
+            raise SessionError("bad-rule") from None
+        if isinstance(rule_obj, GenRule) or 0 in rule_obj.birth:
+            # Two-state only; B0 padding slots would seethe (see
+            # BatchStepper's docstring).
+            raise SessionError("unsupported-rule")
+        if board is None and seed is not None:
+            rng = np.random.default_rng(int(seed))
+            board = (rng.random((height, width)) < float(density)).astype(
+                np.uint8
+            ) * np.uint8(255)
+        if board is not None:
+            board = np.asarray(board, np.uint8)
+            if board.shape != (height, width):
+                raise SessionError("bad-board")
+        return self._exec(lambda: self._create(
+            sid, width, height, rule_obj, board, int(start_turn)
+        ))
+
+    def destroy(self, sid: str) -> None:
+        self._exec(lambda: self._destroy(sid, "destroyed"))
+
+    def checkpoint(self, sid: str) -> dict:
+        """Write out/sessions/<sid>/<W>x<H>x<T>.pgm (crash-atomic) plus
+        the session.json sidecar; returns {"path", "turn"}."""
+        return self._exec(lambda: self._checkpoint(sid))
+
+    def attach(self, sid: str, sink: Sink) -> dict:
+        """Register a sink: it receives `on_sync` with the current
+        board at the next dispatch boundary, then per-turn callbacks.
+        Returns the session info."""
+        return self._exec(lambda: self._attach(sid, sink))
+
+    def detach(self, sid: str, sink: Sink) -> None:
+        self._exec(lambda: self._detach(sid, sink))
+
+    def fetch_board(self, sid: str) -> np.ndarray:
+        """Current (H, W) {0,255} board of a session."""
+        return self._exec(lambda: self._fetch_board(sid))
+
+    def list_sessions(self) -> list:
+        with self._lock:
+            return [s.info() for s in
+                    sorted(self._by_id.values(), key=lambda s: s.id)]
+
+    def get(self, sid: str) -> Optional[Session]:
+        with self._lock:
+            return self._by_id.get(sid)
+
+    def peek_turn(self, sid: str) -> int:
+        """Lock-free turn hint for liveness paths (the server's
+        heartbeat beacons): plain GIL-atomic dict/attribute reads,
+        never the manager lock — that lock is held across whole bucket
+        dispatches, and a beacon that waits on a cold compile defeats
+        its own purpose. May be one dispatch stale; 0 for unknown ids."""
+        s = self._by_id.get(sid)
+        return s.turn if s is not None else 0
+
+    def resume_all(self) -> int:
+        """Recreate every session checkpointed under out/sessions/ from
+        its latest snapshot (PR 3's `--resume latest`, per session).
+        Unreadable entries are skipped — resume discovery runs on
+        freshly crashed trees. Returns the number restored."""
+        from gol_tpu.checkpoint import (
+            latest_any_snapshot,
+            session_checkpoint_dir,
+            snapshot_turn,
+        )
+        from gol_tpu.io.pgm import read_pgm
+
+        root = session_checkpoint_dir(self.out_dir)
+        try:
+            names = sorted(os.listdir(root))
+        except OSError:
+            return 0
+        restored = 0
+        for sid in names:
+            if not valid_session_id(sid) or sid in self._by_id:
+                continue
+            found = latest_any_snapshot(os.path.join(root, sid))
+            if found is None:
+                continue
+            path, w, h = found
+            rule = None
+            with contextlib.suppress(OSError, ValueError, KeyError):
+                meta = json.loads(open(
+                    os.path.join(root, sid, "session.json")
+                ).read())
+                rule = meta.get("rule")
+            try:
+                self.create(sid, width=w, height=h, rule=rule,
+                            board=read_pgm(path),
+                            start_turn=snapshot_turn(path))
+                restored += 1
+            except (SessionError, OSError, ValueError):
+                continue
+        if restored:
+            flight.note("sessions.resume", count=restored)
+        return restored
+
+    def close(self) -> None:
+        """Close every sink and drop all sessions (process teardown)."""
+
+        def _do():
+            self._closed = True
+            for sid in [s.id for s in self._by_id.values()]:
+                self._destroy(sid, "shutdown")
+
+        with contextlib.suppress(TimeoutError):
+            self._exec(_do)
+
+    def health(self) -> dict:
+        with self._lock:
+            return {
+                "status": "ok",
+                "sessions": len(self._by_id),
+                "buckets": len(self._buckets),
+                "ticks": {b.key: b.ticks for b in self._buckets.values()},
+            }
+
+    # --- request plumbing ---
+
+    def _exec(self, fn: Callable, timeout: float = 60.0):
+        eng = self._engine
+        if eng is None or not eng.running() or eng.is_engine_thread():
+            with self._lock:
+                return fn()
+        ev = threading.Event()
+        box: dict = {}
+        with self._lock:
+            self._requests.append((fn, ev, box))
+        self._kick.set()
+        if not ev.wait(timeout):
+            raise TimeoutError("session engine did not service the verb")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _service_requests(self) -> None:
+        """Owner thread: run all pending verbs."""
+        with self._lock:
+            reqs, self._requests = self._requests, []
+        for fn, ev, box in reqs:
+            try:
+                with self._lock:
+                    box["result"] = fn()
+            except BaseException as e:  # delivered to the caller
+                box["error"] = e
+            finally:
+                ev.set()
+
+    # --- verb implementations (owner thread, lock held via _exec) ---
+
+    def _bucket_for(self, height: int, width: int, rule: Rule,
+                    min_free: int = 1) -> _Bucket:
+        key = (height, width, str(rule))
+        b = self._buckets.get(key)
+        if b is None:
+            b = _Bucket(height, width, rule, self.bucket_capacity,
+                        self.device)
+            self._buckets[key] = b
+            _METRICS.buckets.set(len(self._buckets))
+            tracing.event("session.bucket", "lifecycle", bucket=b.key,
+                          capacity=b.bs.capacity)
+        while len(b.free) < min_free:
+            self._grow(b)
+        return b
+
+    def _grow(self, b: _Bucket) -> None:
+        """Double a full bucket's capacity: a new BatchStepper (one
+        recompile — the documented cost of outgrowing a bucket; slot
+        churn within capacity stays compile-free)."""
+        from gol_tpu.parallel.stepper import make_batch_stepper
+
+        old_cap = b.bs.capacity
+        new_cap = old_cap * 2
+        boards = [b.bs.fetch_one(b.stack, i) for i in range(old_cap)]
+        boards += [np.zeros((b.height, b.width), np.uint8)] * old_cap
+        b.bs = make_batch_stepper(new_cap, b.height, b.width, b.rule,
+                                  b.device)
+        b.stack = b.bs.put_all(boards)
+        b.free = list(range(new_cap - 1, old_cap - 1, -1)) + b.free
+        _METRICS.bucket_grows.inc()
+        tracing.event("session.bucket_grow", "lifecycle", bucket=b.key,
+                      capacity=new_cap)
+        flight.note("session.bucket_grow", bucket=b.key, capacity=new_cap)
+
+    def _create(self, sid: str, width: int, height: int, rule: Rule,
+                board: Optional[np.ndarray], start_turn: int) -> dict:
+        if sid in self._by_id:
+            raise SessionError("exists")
+        b = self._bucket_for(height, width, rule)
+        slot = b.free.pop()
+        if board is not None:
+            b.stack = b.bs.set_one(b.stack, slot, board)
+        else:
+            b.stack = b.bs.clear_one(b.stack, slot)
+        s = Session(sid, b, slot, start_turn)
+        b.sessions[slot] = s
+        self._by_id[sid] = s
+        _METRICS.creates.inc()
+        _METRICS.active.set(len(self._by_id))
+        tracing.event("session.create", "lifecycle", session=sid,
+                      bucket=b.key, slot=slot, turn=start_turn)
+        flight.note("session.create", session=sid, bucket=b.key)
+        return s.info()
+
+    def _require(self, sid: str) -> Session:
+        s = self._by_id.get(sid)
+        if s is None:
+            raise SessionError("unknown-session")
+        return s
+
+    def _destroy(self, sid: str, reason: str) -> None:
+        s = self._require(sid)
+        b = s.bucket
+        for sink in b.sinks.pop(sid, []):
+            with contextlib.suppress(Exception):
+                sink.on_close(sid, reason)
+        b.stack = b.bs.clear_one(b.stack, s.slot)
+        del b.sessions[s.slot]
+        b.free.append(s.slot)
+        del self._by_id[sid]
+        # Bounded-cardinality contract: the per-session children leave
+        # the registry WITH the session (pinned by test_sessions).
+        for name in PER_SESSION_SERIES:
+            obs.registry().remove(name, {"session": sid})
+        _METRICS.destroys.inc()
+        _METRICS.active.set(len(self._by_id))
+        tracing.event("session.destroy", "lifecycle", session=sid,
+                      reason=reason)
+        flight.note("session.destroy", session=sid, reason=reason)
+
+    def _fetch_board(self, sid: str) -> np.ndarray:
+        s = self._require(sid)
+        return s.bucket.bs.fetch_one(s.bucket.stack, s.slot)
+
+    def _checkpoint(self, sid: str) -> dict:
+        from gol_tpu.checkpoint import session_checkpoint_dir
+        from gol_tpu.io.pgm import write_pgm
+
+        s = self._require(sid)
+        b = s.bucket
+        d = os.path.join(session_checkpoint_dir(self.out_dir), sid)
+        os.makedirs(d, exist_ok=True)
+        turn = s.turn
+        path = os.path.join(d, f"{b.width}x{b.height}x{turn}.pgm")
+        write_pgm(path, self._fetch_board(sid))
+        obs.atomic_write_text(
+            os.path.join(d, "session.json"),
+            json.dumps({"id": sid, "width": b.width, "height": b.height,
+                        "rule": str(b.rule), "turn": turn}),
+        )
+        _METRICS.checkpoints.inc()
+        tracing.event("session.checkpoint", "lifecycle", session=sid,
+                      turn=turn)
+        return {"path": path, "turn": turn}
+
+    def _attach(self, sid: str, sink: Sink) -> dict:
+        s = self._require(sid)
+        b = s.bucket
+        board = self._fetch_board(sid)
+        sink.on_sync(sid, s.turn, board)
+        b.sinks.setdefault(sid, []).append(sink)
+        s.watchers_metric.set(len(b.sinks[sid]))
+        tracing.event("session.attach", "lifecycle", session=sid)
+        return s.info()
+
+    def _detach(self, sid: str, sink: Sink) -> None:
+        s = self._by_id.get(sid)
+        if s is None:
+            return
+        sinks = s.bucket.sinks.get(sid, [])
+        with contextlib.suppress(ValueError):
+            sinks.remove(sink)
+        if not sinks:
+            s.bucket.sinks.pop(sid, None)
+        s.watchers_metric.set(len(sinks))
+        tracing.event("session.detach", "lifecycle", session=sid)
+
+    # --- the bucketed dispatch loop (owner thread) ---
+
+    def pump(self, turns: int, chunk: Optional[int] = None) -> None:
+        """Inline stepping (no engine thread): advance every occupied
+        bucket by exactly `turns` turns in up-to-`chunk`-sized
+        dispatches (dispatches may come back cadence-capped — see
+        `_dispatch_bucket`)."""
+
+        def _do():
+            for b in list(self._buckets.values()):
+                if not b.live:
+                    continue
+                left = turns
+                while left > 0:
+                    left -= self._dispatch_bucket(
+                        b, min(left, chunk or turns)
+                    )
+
+        self._exec(_do)
+
+    def _dispatch_bucket(self, b: _Bucket, k: int) -> int:
+        """One dispatch of up to `k` turns for one bucket; returns the
+        turns actually stepped (the autosave cadence may cap k so a
+        kill loses at most one cadence interval — the engine's
+        bounded-loss contract, per bucket)."""
+        if self.autosave_turns > 0:
+            k = max(1, min(
+                k, b.last_save_tick + self.autosave_turns - b.ticks
+            ))
+        t0 = time.perf_counter()
+        wall0 = time.time()
+        if b.flip_watched():
+            path = self._dispatch_diffs(b, k)
+        else:
+            b.stack, _counts = b.bs.step_n(b.stack, k)
+            path = "fused"
+            self._commit(b, k)
+            if b.watched():
+                # Sinks that declined flip payloads still get their
+                # per-turn on_turn callbacks (the singleton engine
+                # emits TurnComplete to every synced peer regardless
+                # of want_flips — same contract here).
+                self._emit(b, k, {})
+        dt = time.perf_counter() - t0
+        _METRICS.dispatches[path].inc()
+        _METRICS.dispatch_seconds[path].observe(dt)
+        tracing.add_span(
+            "session.dispatch", "engine", wall0, dt,
+            {"bucket": b.key, "path": path, "turns": k,
+             "sessions": b.live},
+        )
+        if (self.autosave_turns > 0
+                and b.ticks - b.last_save_tick >= self.autosave_turns):
+            b.last_save_tick = b.ticks
+            for s in list(b.sessions.values()):
+                with contextlib.suppress(OSError):
+                    self._checkpoint(s.id)
+        return k
+
+    def _dispatch_diffs(self, b: _Bucket, k: int) -> str:
+        """One watched dispatch: compact when the adaptive cap is live
+        (overflow -> dense redo, never trust a dropped-write buffer),
+        plain per-session diff stacks otherwise. Demuxes the decoded
+        per-turn rows to each watched session's sinks — the identical
+        flip stream the single-board engine would have produced for
+        that board (pinned by bit-equality tests)."""
+        from gol_tpu.parallel.stepper import (
+            compact_decode_rows,
+            compact_value_bucket,
+        )
+
+        path = "diffs"
+        rows_by_slot = None
+        if b.compact_cap is not None:
+            path = "compact"
+            total_cap = k * b.compact_cap
+            stack, headers, values, counts = (
+                b.bs.step_n_with_diffs_compact(b.stack, k, total_cap)
+            )
+            hdr = np.ascontiguousarray(np.asarray(headers)).view(np.uint32)
+            totals = hdr[:, :, 0].sum(axis=1)
+            if totals.size and int(totals.max()) > total_cap:
+                # Activity burst past the shared buffer in at least one
+                # session: redo the whole bucket chunk densely from the
+                # pre-dispatch stack (bit-identical result).
+                b.compact_cap = None
+                _METRICS.compact_redos.inc()
+                tracing.event("session.compact_redo", "engine",
+                              bucket=b.key, total_cap=total_cap)
+                flight.note("session.compact_redo", bucket=b.key)
+                return self._dispatch_diffs(b, k)
+            # One bounded-shape slice fetches every session's used
+            # prefix (bucketed, so the per-chunk slice compiles a
+            # bounded set of shapes — compact_value_bucket).
+            n = min(int(values.shape[1]),
+                    compact_value_bucket(int(totals.max()) if totals.size
+                                         else 0))
+            vals = np.ascontiguousarray(
+                np.asarray(values[:, :n])
+            ).view(np.uint32)
+            b.stack = stack
+            self._commit(b, k)
+            rows_by_slot = {}
+            peak = 0
+            for slot, s in b.sessions.items():
+                hs = hdr[slot]
+                peak = max(peak, int(hs[:, 0].max()) if hs.size else 0)
+                if b.sinks.get(s.id):
+                    rows_by_slot[slot] = list(compact_decode_rows(
+                        hs, vals[slot], b.bs.total_words
+                    ))
+            b.adapt_cap(peak)
+        else:
+            stack, diffs, counts = b.bs.step_n_with_diffs(b.stack, k)
+            host = np.asarray(diffs)
+            b.stack = stack
+            self._commit(b, k)
+            rows_by_slot = {}
+            peak = 0
+            for slot, s in b.sessions.items():
+                d = host[slot]
+                if b.bs.packed:
+                    peak = max(
+                        peak,
+                        max((int(np.count_nonzero(d[t]))
+                             for t in range(k)), default=0),
+                    )
+                if b.sinks.get(s.id):
+                    rows_by_slot[slot] = [
+                        d[t].reshape(-1) for t in range(k)
+                    ]
+            if b.bs.packed:
+                b.adapt_cap(peak)
+        self._emit(b, k, rows_by_slot)
+        return path
+
+    def _commit(self, b: _Bucket, k: int) -> None:
+        b.ticks += k
+        for s in b.sessions.values():
+            s.turns_metric.inc(k)
+        flight.note("sessions.commit", bucket=b.key, ticks=b.ticks)
+
+    def _emit(self, b: _Bucket, k: int, rows_by_slot: dict) -> None:
+        """Fan one dispatched chunk out to the attached sinks, per
+        session, in turn order."""
+        from gol_tpu.ops.bitlife import unpack_np
+        from gol_tpu.utils.cell import xy_from_mask
+
+        hw = b.height // 32 if b.bs.packed else None
+        for slot, s in list(b.sessions.items()):
+            sinks = b.sinks.get(s.id)
+            if not sinks:
+                continue
+            rows = rows_by_slot.get(slot)
+            base = s.turn - k
+            for t in range(k):
+                turn = base + t + 1
+                coords = None
+                if rows is not None:
+                    row = rows[t]
+                    if b.bs.packed:
+                        mask = unpack_np(
+                            np.asarray(row).reshape(hw, b.width), b.height
+                        ) != 0
+                    else:
+                        mask = np.asarray(row).reshape(b.height, b.width)
+                    coords = xy_from_mask(mask)
+                dead = []
+                for sink in sinks:
+                    try:
+                        if coords is not None and sink.want_flips \
+                                and len(coords):
+                            sink.on_flips(s.id, turn, coords)
+                        sink.on_turn(s.id, turn)
+                    except Exception:
+                        dead.append(sink)
+                for sink in dead:
+                    self._detach(s.id, sink)
+                sinks = b.sinks.get(s.id)
+                if not sinks:
+                    break

@@ -36,7 +36,8 @@ import sys
 from typing import Dict, Optional
 
 HIGHER_BETTER = re.compile(
-    r"(per_sec|per_s$|throughput|rate$|gcells|speedup)", re.I
+    r"(per_sec|per_s$|throughput|rate$|gcells|speedup|vs_sequential)",
+    re.I,
 )
 LOWER_BETTER = re.compile(
     r"(seconds|_secs?$|_s$|_ms$|bytes|latency|overhead|stalls|redos"

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Run ONLY the multi-session bench lane and merge it into
+BENCH_DETAIL.json (preserving every other key).
+
+The full `bench.py` run assumes an attached accelerator and takes tens
+of minutes; this lane is meaningful on any backend (the comparison is
+batched-vs-sequential dispatch on the SAME device, and the entry
+records its `platform`), so the session layer's acceptance number —
+64 concurrent 256² sessions sustain strictly more aggregate turns/s
+than 64 sequential single-board engines — can be captured/refreshed
+standalone:
+
+    JAX_PLATFORMS=cpu python scripts/sessions_bench.py
+    python scripts/sessions_bench.py --no-merge   # print only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="sessions_bench")
+    ap.add_argument("--sessions", type=int, default=64)
+    ap.add_argument("--side", type=int, default=256)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--no-merge", action="store_true",
+                    help="print the lane JSON without touching "
+                         "BENCH_DETAIL.json")
+    args = ap.parse_args(argv)
+
+    from bench import measure_sessions_lane
+
+    lane = measure_sessions_lane(args.sessions, args.side, args.chunk,
+                                 args.rounds)
+    print(json.dumps(lane, indent=2))
+    if lane["speedup_vs_sequential"] <= 1.0:
+        print("WARNING: batched bucket did not beat sequential engines",
+              file=sys.stderr)
+    if not args.no_merge:
+        bd = REPO / "BENCH_DETAIL.json"
+        detail = json.loads(bd.read_text()) if bd.exists() else {}
+        detail[f"sessions_{args.sessions}x{args.side}"] = lane
+        bd.write_text(json.dumps(detail, indent=2))
+        print(f"merged into {bd}", file=sys.stderr)
+    return 0 if lane["speedup_vs_sequential"] > 1.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

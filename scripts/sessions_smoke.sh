@@ -1,0 +1,150 @@
+#!/usr/bin/env bash
+# Session-layer smoke test (gol_tpu.sessions, ISSUE 7): boot a real
+# `--serve --sessions` server with the metrics sidecar, drive it from
+# TWO CONCURRENT control clients (create / list / checkpoint /
+# destroy racing each other), attach a watcher to a named session, and
+# assert on /metrics that
+#   - per-session labeled series appear for LIVE sessions, and
+#   - a destroyed session's labels are EVICTED (bounded cardinality),
+#   - the bucket dispatch counters are moving.
+# No pytest, no mocks — the operator's view of the session layer.
+#
+# Usage: scripts/sessions_smoke.sh   (CPU-safe; ~30s)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+LOG=$(mktemp)
+OUT=$(mktemp -d)
+cleanup() {
+    kill "$PID" 2>/dev/null || true
+    wait "$PID" 2>/dev/null || true
+    rm -rf "$LOG" "$OUT"
+}
+
+python -m gol_tpu -noVis -w 64 -h 64 --platform cpu \
+    --serve 127.0.0.1:0 --sessions --out "$OUT" \
+    --metrics-port 0 >"$LOG" 2>&1 &
+PID=$!
+trap cleanup EXIT
+
+# The CLI prints both bound ephemeral addresses once up.
+BASE=""
+ADDR=""
+for _ in $(seq 1 240); do
+    BASE=$(sed -n 's#^metrics serving on \(http://[^/]*\)/metrics$#\1#p' "$LOG" | head -1)
+    ADDR=$(sed -n 's#^session engine serving on \(.*\)$#\1#p' "$LOG" | head -1)
+    [ -n "$BASE" ] && [ -n "$ADDR" ] && break
+    if ! kill -0 "$PID" 2>/dev/null; then
+        echo "sessions smoke: FAILED — server died during startup:" >&2
+        cat "$LOG" >&2
+        exit 1
+    fi
+    sleep 0.5
+done
+if [ -z "$BASE" ] || [ -z "$ADDR" ]; then
+    echo "sessions smoke: FAILED — addresses not printed:" >&2
+    cat "$LOG" >&2
+    exit 1
+fi
+HOST=${ADDR%:*}
+PORT=${ADDR##*:}
+
+# Two concurrent control clients + one watcher, from one driver
+# process (threads): each client manages its own sessions; "keeper"
+# stays live, "victim" is destroyed — the /metrics assertions below
+# check the label lifecycles diverge accordingly.
+JAX_PLATFORMS=cpu python - "$HOST" "$PORT" <<'PYEOF'
+import sys, threading, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from gol_tpu.distributed import Controller, SessionControl
+from gol_tpu.events import TurnComplete
+
+host, port = sys.argv[1], int(sys.argv[2])
+errs = []
+
+def client_a():
+    try:
+        ctl = SessionControl(host, port)
+        ctl.create("keeper", width=64, height=64, seed=1)
+        w = Controller(host, port, want_flips=True, batch=True,
+                       session="keeper")
+        assert w.wait_sync(60), "no board sync for keeper"
+        seen = 0
+        deadline = time.monotonic() + 60
+        for ev in w.events:
+            if isinstance(ev, TurnComplete):
+                seen = ev.completed_turns
+                if seen >= 12:
+                    break
+            assert time.monotonic() < deadline, "keeper stream stalled"
+        ctl.checkpoint("keeper")
+        assert any(s["id"] == "keeper" for s in ctl.list())
+        w.detach(20)
+        w.close()
+        ctl.close()
+    except BaseException as e:
+        errs.append(("a", e))
+
+def client_b():
+    try:
+        ctl = SessionControl(host, port)
+        ctl.create("victim", width=64, height=64, seed=2)
+        time.sleep(1.0)  # let it accrue turns (and labeled series)
+        assert any(s["id"] == "victim" for s in ctl.list())
+        ctl.destroy("victim")
+        assert not any(s["id"] == "victim" for s in ctl.list())
+        ctl.close()
+    except BaseException as e:
+        errs.append(("b", e))
+
+ts = [threading.Thread(target=client_a), threading.Thread(target=client_b)]
+for t in ts: t.start()
+for t in ts: t.join(timeout=120)
+assert not any(t.is_alive() for t in ts), "client thread hung"
+assert not errs, errs
+print("CLIENTS_OK")
+PYEOF
+
+fetch() {
+    python -c 'import sys, urllib.request
+sys.stdout.write(urllib.request.urlopen(sys.argv[1], timeout=15).read().decode())' "$1"
+}
+
+METRICS=$(fetch "$BASE/metrics")
+
+# Live session: its labeled children are present and moving.
+echo "$METRICS" | grep -q 'gol_tpu_session_turns_total{session="keeper"}' || {
+    echo "sessions smoke: FAILED — no per-session series for keeper" >&2
+    echo "$METRICS" | grep gol_tpu_session || true
+    exit 1
+}
+# Destroyed session: its labels are EVICTED (bounded cardinality).
+if echo "$METRICS" | grep -q 'session="victim"'; then
+    echo "sessions smoke: FAILED — destroyed session's labels leaked:" >&2
+    echo "$METRICS" | grep 'session="victim"' >&2
+    exit 1
+fi
+# The session plane itself is alive.
+for series in \
+    gol_tpu_session_dispatches_total \
+    gol_tpu_session_creates_total \
+    gol_tpu_session_destroys_total \
+    gol_tpu_sessions_active; do
+    echo "$METRICS" | grep -q "^$series" || {
+        echo "sessions smoke: FAILED — missing series $series" >&2
+        exit 1
+    }
+done
+CREATES=$(echo "$METRICS" | sed -n 's/^gol_tpu_session_creates_total \([0-9.]*\)$/\1/p')
+DESTROYS=$(echo "$METRICS" | sed -n 's/^gol_tpu_session_destroys_total \([0-9.]*\)$/\1/p')
+[ "${CREATES%.*}" -ge 2 ] || { echo "FAILED — creates=$CREATES" >&2; exit 1; }
+[ "${DESTROYS%.*}" -ge 1 ] || { echo "FAILED — destroys=$DESTROYS" >&2; exit 1; }
+
+kill -INT "$PID"
+for _ in $(seq 1 60); do
+    kill -0 "$PID" 2>/dev/null || break
+    sleep 0.5
+done
+
+echo "sessions smoke: OK (creates=$CREATES destroys=$DESTROYS, victim evicted, keeper live)"

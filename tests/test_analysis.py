@@ -113,6 +113,51 @@ def test_detects_recompile_hazards(tmp_path):
     assert any("dict literal bound to static 'k'" in m for m in msgs)
 
 
+def test_recompile_flags_per_slot_bucket_padding(tmp_path):
+    """The bucket-padding anti-patterns (ISSUE 7): a session layer
+    that builds a jit PER SLOT in its create loop, or keys a static
+    on a per-slot f-string, compiles once per tenant — exactly what
+    traced slot indices exist to avoid. Both shapes must be flagged."""
+    findings = _lint_snippet(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("tag",))
+        def step_slot(stack, tag):
+            return stack
+
+        def fill_bucket(stack, boards):
+            for slot, board in enumerate(boards):
+                # One compiled setter per slot: the padding path that
+                # recompiles on every join.
+                setter = jax.jit(lambda s: s.at[slot].set(board))
+                stack = setter(stack)
+                # Per-slot cache key: every tenant is a new compile.
+                stack = step_slot(stack, f"slot-{slot}")
+            return stack
+    """)
+    msgs = [f.message for f in findings if f.check == "recompile"]
+    assert any("inside a loop" in m for m in msgs)
+    assert any("f-string bound to static 'tag'" in m for m in msgs)
+
+
+def test_recompile_clean_on_real_bucket_padding_path(tmp_path):
+    """The NEGATIVE twin: the shipped session-bucket code (vmapped
+    BatchStepper builders + the sessions package) carries zero
+    recompile findings — slot churn is traced-index data. (The strict
+    gate enforces this too; pinning it here keeps the property named
+    next to its '+' case.)"""
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    paths = [repo / "gol_tpu" / "parallel" / "stepper.py",
+             repo / "gol_tpu" / "sessions"]
+    findings = [
+        f for f in lint_paths(paths, repo) if f.check == "recompile"
+    ]
+    assert findings == [], [f.message for f in findings]
+
+
 def test_detects_dtype_drift_in_kernel_module(tmp_path):
     findings = _lint_snippet(tmp_path, """
         import jax.numpy as jnp

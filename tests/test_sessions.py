@@ -1,0 +1,538 @@
+"""gol_tpu.sessions — the multi-tenant session layer (ISSUE 7).
+
+Pins the tentpole contracts:
+
+- BUCKET BIT-EQUALITY: every board in a 16-session bucket, stepped by
+  the single vmapped dispatch (compact diff path included), matches its
+  single-board dense oracle exactly — with runtime invariants forced ON
+  for the whole module.
+- ZERO RECOMPILES: a session create/step/destroy cycle inside a warm
+  bucket moves no jit cache (the acceptance criterion; slot indices are
+  traced, padding slots are data).
+- BOUNDED LABELS: per-session metric children are evicted at destroy,
+  so the registry cannot grow without bound under churn.
+- WIRE VERBS: create/destroy/list/checkpoint over TCP, concurrent
+  control clients, watchers on named sessions, per-session resume.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import obs
+from gol_tpu.ops import life
+from gol_tpu.sessions import (
+    SessionEngine,
+    SessionError,
+    SessionManager,
+    Sink,
+    valid_session_id,
+)
+
+
+@pytest.fixture(autouse=True)
+def _invariants_on(monkeypatch):
+    """Runtime invariants forced ON for every session test; any
+    violation — even one swallowed by a daemon thread — fails the test
+    through the violations counter (the test_distributed guard)."""
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.analysis.invariants import violations_total
+
+    before = violations_total()
+    yield
+    grew = violations_total() - before
+    assert grew == 0, (
+        f"gol_tpu_invariant_violations_total grew by {grew} during a "
+        "session test"
+    )
+
+
+def _soup(seed: int, side: int = 64, density: float = 0.3) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return ((rng.random((side, side)) < density) * 255).astype(np.uint8)
+
+
+class RecordingSink(Sink):
+    """Shadow-raster consumer: applies the flip stream exactly as the
+    visualiser would (XOR), so final equality proves the per-session
+    stream is the single-board stream."""
+
+    def __init__(self):
+        self.board = None
+        self.sync_turn = None
+        self.turns = []
+        self.closed = None
+
+    def on_sync(self, sid, turn, board):
+        self.board = np.array(board)
+        self.sync_turn = turn
+
+    def on_flips(self, sid, turn, coords):
+        xy = np.asarray(coords).reshape(-1, 2)
+        self.board[xy[:, 1], xy[:, 0]] ^= np.uint8(255)
+
+    def on_turn(self, sid, turn):
+        self.turns.append(turn)
+
+    def on_close(self, sid, reason):
+        self.closed = reason
+
+
+# --- bucket bit-equality (the acceptance pin) ---
+
+
+def test_sixteen_session_bucket_matches_dense_oracle(tmp_path):
+    """Every board in a 16-session bucket — stepped by ONE vmapped
+    dispatch through the compact diff path — is bit-identical to its
+    own single-board dense oracle, and every session's delivered flip
+    stream reconstructs the same board."""
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=16)
+    sinks = {}
+    for i in range(16):
+        sid = f"s{i:02d}"
+        # Low density: the boards settle within the first chunk, so
+        # the bucket's adaptive cap engages and later chunks ride the
+        # compact encoding (a seething soup would stay on plain diffs
+        # — correct, but not the path this test pins).
+        m.create(sid, width=64, height=64,
+                 board=_soup(100 + i, density=0.04))
+        sinks[sid] = RecordingSink()
+        m.attach(sid, sinks[sid])
+    turns = 48
+    # Short chunks force several dispatches: plain-diffs first (cap
+    # observation), compact after.
+    m.pump(turns, chunk=8)
+    assert m._buckets and len(m._buckets) == 1
+    compact_dispatches = obs.registry().counter(
+        "gol_tpu_session_dispatches_total", labels={"path": "compact"}
+    ).value
+    assert compact_dispatches > 0, (
+        "the compact path never engaged — the bucket must ride the "
+        "PR 4 encoding once activity is observed"
+    )
+    for i in range(16):
+        sid = f"s{i:02d}"
+        want = np.asarray(life.step_n(_soup(100 + i, density=0.04),
+                                      turns))
+        got = m.fetch_board(sid)
+        assert np.array_equal(got, want), f"{sid} diverged from oracle"
+        # The delivered stream reconstructs the same board, turn by turn.
+        assert np.array_equal(sinks[sid].board, want), (
+            f"{sid} flip stream diverged"
+        )
+        assert sinks[sid].turns == list(range(1, turns + 1))
+
+
+def test_compact_overflow_redoes_densely(tmp_path):
+    """An activity burst past the shared value buffer redoes the chunk
+    densely — the stream stays bit-identical (never trust dropped
+    writes)."""
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    m.create("a", width=64, height=64, board=_soup(1, density=0.05))
+    sink = RecordingSink()
+    m.attach("a", sink)
+    m.pump(16, chunk=8)  # quiet board: small cap locks in
+    b = next(iter(m._buckets.values()))
+    assert b.compact_cap is not None
+    # Burst: swap in a dense soup mid-run (same session, same slot).
+    burst = _soup(2, density=0.45)
+    redos0 = obs.registry().counter(
+        "gol_tpu_session_compact_redos_total").value
+    m._exec(lambda: b.__setattr__(
+        "stack", b.bs.set_one(b.stack, m.get("a").slot, burst)))
+    sink.board = np.array(burst)  # resync the shadow to the swap
+    m.pump(8, chunk=8)
+    assert obs.registry().counter(
+        "gol_tpu_session_compact_redos_total").value > redos0
+    want = np.asarray(life.step_n(burst, 8))
+    assert np.array_equal(m.fetch_board("a"), want)
+    assert np.array_equal(sink.board, want), "redo stream diverged"
+
+
+# --- zero recompiles in a warm bucket (the acceptance pin) ---
+
+
+def test_warm_bucket_create_step_destroy_zero_recompiles(tmp_path):
+    """After one warm-up cycle has compiled every entry, session
+    create/step/destroy cycles move NO jit cache — joins and leaves are
+    traced-index data, not program shapes."""
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=8)
+    # Warm every dispatch shape once: fused (no watcher), then plain
+    # diffs + compact (watcher attached; low density so the adaptive
+    # cap locks at its floor and stays there), then one full
+    # create/checkpoint/destroy cycle for the slot programs.
+    m.create("warm", width=64, height=64, board=_soup(5, density=0.04))
+    m.pump(8, chunk=8)
+    sink = RecordingSink()
+    m.attach("warm", sink)
+    m.pump(24, chunk=8)
+    m.create("w2", width=64, height=64, board=_soup(6, density=0.04))
+    m.pump(8, chunk=8)
+    m.checkpoint("w2")
+    m.destroy("w2")
+    b = next(iter(m._buckets.values()))
+    warm = b.bs.cache_sizes()
+    for entry in ("step_n", "diffs", "compact", "set", "clear", "take"):
+        assert warm[entry] >= 1, (entry, warm)
+
+    for i in range(4):
+        m.create(f"churn{i}", width=64, height=64,
+                 board=_soup(10 + i, density=0.04))
+        m.pump(16, chunk=8)
+        m.checkpoint(f"churn{i}")
+        m.destroy(f"churn{i}")
+    m.pump(8, chunk=8)
+    assert b.bs.cache_sizes() == warm, (
+        "create/step/checkpoint/destroy inside a warm bucket recompiled: "
+        f"{warm} -> {b.bs.cache_sizes()}"
+    )
+
+
+def test_bucket_growth_is_the_only_recompile(tmp_path):
+    """Outgrowing a bucket doubles capacity (a new BatchStepper — the
+    one documented recompile) and preserves every tenant bit-exactly."""
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=2)
+    for i in range(5):  # 2 -> 4 -> 8: two grows
+        m.create(f"g{i}", width=64, height=64, board=_soup(20 + i))
+    grows = obs.registry().counter(
+        "gol_tpu_session_bucket_grows_total").value
+    assert grows >= 2
+    m.pump(12, chunk=4)
+    for i in range(5):
+        want = np.asarray(life.step_n(_soup(20 + i), 12))
+        assert np.array_equal(m.fetch_board(f"g{i}"), want), f"g{i}"
+
+
+# --- bounded per-session labels (the pinned small fix) ---
+
+
+def test_destroy_evicts_per_session_metric_children(tmp_path):
+    m = SessionManager(out_dir=str(tmp_path))
+    m.create("ev1", width=64, height=64, seed=3)
+    m.pump(4, chunk=4)
+    snap = obs.registry().snapshot()
+    assert any('session="ev1"' in k for k in snap), "children never born"
+    m.destroy("ev1")
+    snap = obs.registry().snapshot()
+    leaked = [k for k in snap if 'session="ev1"' in k]
+    assert not leaked, f"per-session series leaked: {leaked}"
+
+
+def test_registry_bounded_under_session_churn(tmp_path):
+    """The registry's series count after heavy create/destroy churn
+    equals its count after ONE session's lifecycle — per-session
+    cardinality is O(live sessions), never O(ever-created)."""
+    m = SessionManager(out_dir=str(tmp_path))
+    m.create("churn-base", width=64, height=64, seed=1)
+    m.pump(4, chunk=4)
+    m.destroy("churn-base")
+    baseline = len(obs.registry().metrics())
+    for i in range(25):
+        m.create(f"churner-{i}", width=64, height=64, seed=i)
+        m.pump(4, chunk=4)
+        m.destroy(f"churner-{i}")
+    assert len(obs.registry().metrics()) == baseline, (
+        "registry grew under session churn"
+    )
+
+
+# --- lifecycle, validation, checkpoint/resume ---
+
+
+def test_create_validation_and_duplicates(tmp_path):
+    m = SessionManager(out_dir=str(tmp_path))
+    with pytest.raises(SessionError, match="bad-session-id"):
+        m.create("../escape", width=64, height=64)
+    with pytest.raises(SessionError, match="bad-session-id"):
+        m.create("", width=64, height=64)
+    with pytest.raises(SessionError, match="bad-dimensions"):
+        m.create("x", width=0, height=64)
+    with pytest.raises(SessionError, match="bad-dimensions"):
+        m.create("x", width=10**6, height=10**6)
+    with pytest.raises(SessionError, match="bad-rule"):
+        m.create("x", width=64, height=64, rule="Bnope")
+    with pytest.raises(SessionError, match="unsupported-rule"):
+        m.create("x", width=64, height=64, rule="B0/S23")  # B0 padding
+    with pytest.raises(SessionError, match="unsupported-rule"):
+        m.create("x", width=64, height=64, rule="B2/S345/C4")  # gens
+    m.create("x", width=64, height=64)
+    with pytest.raises(SessionError, match="exists"):
+        m.create("x", width=64, height=64)
+    with pytest.raises(SessionError, match="unknown-session"):
+        m.destroy("never-was")
+    assert not valid_session_id("a/b") and valid_session_id("a.b-c_9")
+
+
+def test_rule_and_shape_bucketing(tmp_path):
+    """Different shapes or rules land in different buckets; same shape
+    AND rule shares one vmapped dispatch."""
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    m.create("a", width=64, height=64, seed=1)
+    m.create("b", width=64, height=64, seed=2)
+    m.create("c", width=128, height=64, seed=3)
+    m.create("d", width=64, height=64, rule="B36/S23", seed=4)  # highlife
+    assert len(m._buckets) == 3
+    m.pump(10, chunk=5)
+    rng = np.random.default_rng(4)
+    b0 = ((rng.random((64, 64)) < 0.25) * 255).astype(np.uint8)
+    want = np.asarray(life.step_n(b0, 10, rule="B36/S23"))
+    assert np.array_equal(m.fetch_board("d"), want)
+
+
+def test_checkpoint_resume_roundtrip(tmp_path):
+    """Per-session checkpoints under out/sessions/<id>/ restore every
+    session — board, turn clock, AND rule (the sidecar) — in a fresh
+    manager (the `--serve --sessions --resume latest` story)."""
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    m.create("r1", width=64, height=64, board=_soup(31))
+    m.create("r2", width=64, height=64, rule="B36/S23", board=_soup(32))
+    m.pump(20, chunk=5)
+    boards = {sid: m.fetch_board(sid) for sid in ("r1", "r2")}
+    for sid in ("r1", "r2"):
+        m.checkpoint(sid)
+    m.pump(7, chunk=7)  # post-checkpoint turns are lost on resume
+
+    m2 = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    assert m2.resume_all() == 2
+    infos = {s["id"]: s for s in m2.list_sessions()}
+    assert infos["r1"]["turn"] == 20 and infos["r2"]["turn"] == 20
+    assert infos["r2"]["rule"] == "B36/S23"
+    for sid in ("r1", "r2"):
+        assert np.array_equal(m2.fetch_board(sid), boards[sid])
+    # Resumed sessions keep evolving on their own rule.
+    m2.pump(5, chunk=5)
+    want = np.asarray(life.step_n(boards["r2"], 5, rule="B36/S23"))
+    assert np.array_equal(m2.fetch_board("r2"), want)
+    assert infos["r2"]["turn"] + 5 == m2.get("r2").turn == 25
+
+
+def test_autosave_cadence_checkpoints_sessions(tmp_path):
+    m = SessionManager(out_dir=str(tmp_path), autosave_turns=10)
+    m.create("auto", width=64, height=64, seed=9)
+    m.pump(25, chunk=25)  # dispatches are capped at the cadence
+    snaps = sorted(
+        p.name for p in (tmp_path / "sessions" / "auto").glob("*.pgm")
+    )
+    assert "64x64x10.pgm" in snaps and "64x64x20.pgm" in snaps
+
+
+# --- the engine thread ---
+
+
+def test_engine_thread_services_verbs_and_streams(tmp_path):
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    eng = SessionEngine(m, watched_chunk=4, idle_chunk=16).start()
+    try:
+        m.create("live", width=64, height=64, board=_soup(40))
+        sink = RecordingSink()
+        m.attach("live", sink)
+        deadline = time.monotonic() + 30
+        while len(sink.turns) < 20 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(sink.turns) >= 20, "engine never streamed turns"
+        # Verbs interleave with dispatches without stopping the loop.
+        info = m.checkpoint("live")
+        assert info["turn"] >= 20
+        m.destroy("live")
+        assert sink.closed == "destroyed"
+        # The shadow raster tracked the stream up to its last turn.
+        want = np.asarray(life.step_n(_soup(40), sink.turns[-1]))
+        assert np.array_equal(sink.board, want)
+    finally:
+        eng.stop()
+        eng.join(timeout=30)
+
+
+# --- wire surface (SessionServer / SessionControl / Controller) ---
+
+
+def _session_server(tmp_path, **kw):
+    from gol_tpu.distributed import SessionServer
+    from gol_tpu.params import Params
+
+    p = Params(turns=10**9, threads=1, image_width=64, image_height=64,
+               out_dir=str(tmp_path / "out"))
+    kw.setdefault("watched_chunk", 4)
+    kw.setdefault("idle_chunk", 32)
+    return SessionServer(p, port=0, **kw)
+
+
+def test_wire_create_watch_destroy_roundtrip(tmp_path):
+    from gol_tpu.distributed import Controller, SessionControl
+    from gol_tpu.events import TurnComplete
+
+    srv = _session_server(tmp_path).start()
+    try:
+        ctl = SessionControl(*srv.address)
+        ctl.create("w1", width=64, height=64, seed=77)
+        w = Controller(*srv.address, want_flips=True, batch=True,
+                       session="w1")
+        assert w.wait_sync(30) and w.board is not None
+        last = 0
+        deadline = time.monotonic() + 60
+        for ev in w.events:
+            if isinstance(ev, TurnComplete):
+                last = ev.completed_turns
+                if last >= 24:
+                    break
+            assert time.monotonic() < deadline, "no stream progress"
+        rng = np.random.default_rng(77)
+        b0 = ((rng.random((64, 64)) < 0.25) * 255).astype(np.uint8)
+        want = np.asarray(life.step_n(b0, last))
+        assert np.array_equal(np.asarray(w.board) != 0, want != 0), (
+            "wire flip stream diverged from the dense oracle"
+        )
+        cp = ctl.checkpoint("w1")
+        assert cp["turn"] >= last
+        # destroy-while-attached: the watcher's stream ends CLEANLY.
+        ctl.destroy("w1")
+        deadline = time.monotonic() + 20
+        while w.state not in ("closed", "lost") \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert w.state == "closed"
+        assert ctl.list() == []
+        w.close()
+        ctl.close()
+    finally:
+        srv.shutdown()
+
+
+def test_turn_events_without_flip_payloads(tmp_path):
+    """A sink that declines flip payloads still gets per-turn on_turn
+    callbacks: the bucket rides the cheap fused path (no diff scan is
+    built) yet emits the turn cadence — the singleton engine emits
+    TurnComplete to every synced peer regardless of want_flips, and the
+    session layer keeps that contract."""
+    m = SessionManager(out_dir=str(tmp_path))
+    m.create("quiet", width=64, height=64, board=_soup(7))
+    sink = RecordingSink()
+    sink.want_flips = False
+    m.attach("quiet", sink)
+    fused0 = obs.registry().counter(
+        "gol_tpu_session_dispatches_total", labels={"path": "fused"}
+    ).value
+    m.pump(12, chunk=4)
+    assert sink.turns == list(range(1, 13)), (
+        "flip-less watcher missed its turn cadence"
+    )
+    # on_flips never fired: the sync shadow is untouched.
+    assert np.array_equal(sink.board, _soup(7))
+    assert obs.registry().counter(
+        "gol_tpu_session_dispatches_total", labels={"path": "fused"}
+    ).value > fused0, "a flip-less watcher must not force the diff path"
+
+
+def test_control_link_survives_idle_past_eviction_window(tmp_path):
+    """The control link is a legacy (no-heartbeat) peer by design: a
+    SessionControl sitting idle far past the server's eviction window
+    is never evicted — there is no reader between verbs to answer
+    beacons — and its next verb still works."""
+    from gol_tpu.distributed import SessionControl
+
+    srv = _session_server(tmp_path, heartbeat_secs=0.1,
+                          evict_secs=0.3).start()
+    try:
+        ctl = SessionControl(*srv.address)
+        ctl.create("idle", width=64, height=64)
+        time.sleep(1.5)  # >> evict window; beacons pile up unanswered
+        assert [s["id"] for s in ctl.list()] == ["idle"]
+        ctl.close()
+    finally:
+        srv.shutdown()
+
+
+def test_wire_two_concurrent_clients_distinct_sessions(tmp_path):
+    """Two control clients manage their own sessions concurrently; the
+    per-session driver slots are independent."""
+    from gol_tpu.distributed import Controller, SessionControl
+
+    srv = _session_server(tmp_path).start()
+    try:
+        errs = []
+
+        def client(tag):
+            try:
+                ctl = SessionControl(*srv.address)
+                ctl.create(f"c-{tag}", width=64, height=64, seed=tag)
+                w = Controller(*srv.address, want_flips=True, batch=True,
+                               session=f"c-{tag}")
+                assert w.wait_sync(30)
+                deadline = time.monotonic() + 30
+                while m_turn(ctl, f"c-{tag}") < 8 \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                assert m_turn(ctl, f"c-{tag}") >= 8
+                w.detach(10)
+                ctl.destroy(f"c-{tag}")
+                ctl.close()
+                w.close()
+            except BaseException as e:  # surfaced in the main thread
+                errs.append((tag, e))
+
+        def m_turn(ctl, sid):
+            return next(
+                (s["turn"] for s in ctl.list() if s["id"] == sid), -1
+            )
+
+        ts = [threading.Thread(target=client, args=(i,)) for i in (1, 2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=90)
+        assert not errs, errs
+        assert srv.manager.list_sessions() == []
+    finally:
+        srv.shutdown()
+
+
+def test_wire_driver_slot_per_session(tmp_path):
+    from gol_tpu.distributed import Controller, ServerBusyError
+
+    srv = _session_server(tmp_path).start()
+    try:
+        srv.manager.create("solo", width=64, height=64, seed=5)
+        d1 = Controller(*srv.address, want_flips=False, session="solo")
+        assert d1.wait_sync(30)
+        with pytest.raises(ServerBusyError):
+            Controller(*srv.address, want_flips=False, session="solo",
+                       reconnect=False)
+        # Observers fan out freely on the same session.
+        ob = Controller(*srv.address, want_flips=False, session="solo",
+                        observe=True)
+        assert ob.wait_sync(30)
+        # 'q' frees the driver slot for a successor.
+        assert d1.detach(20)
+        d2 = Controller(*srv.address, want_flips=False, session="solo")
+        assert d2.wait_sync(30)
+        for c in (ob, d2):
+            c.close()
+        d1.close()
+    finally:
+        srv.shutdown()
+
+
+def test_wire_resume_restores_sessions(tmp_path):
+    """SessionServer(resume=True) restores checkpointed sessions — the
+    crash-restart composition (`--serve --sessions --resume latest`)."""
+    from gol_tpu.distributed import SessionControl
+
+    srv = _session_server(tmp_path).start()
+    ctl = SessionControl(*srv.address)
+    ctl.create("boot", width=64, height=64, seed=11)
+    time.sleep(0.3)
+    cp = ctl.checkpoint("boot")
+    ctl.close()
+    srv.shutdown()
+
+    srv2 = _session_server(tmp_path, resume=True)
+    try:
+        assert srv2.resumed == 1
+        infos = srv2.manager.list_sessions()
+        assert infos[0]["id"] == "boot"
+        assert infos[0]["turn"] == cp["turn"]
+    finally:
+        srv2.start()
+        srv2.shutdown()

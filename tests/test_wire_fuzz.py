@@ -369,3 +369,128 @@ def test_delta_flips_truncated_mid_frame_rejected():
     for cut in (wire._DFLIPS_HDR.size + 1, len(frame) - 3):
         with pytest.raises(wire.WireError):
             wire._parse_frame(frame[:cut])
+
+
+# --- session handshake / verb fuzz (gol_tpu.sessions, ISSUE 7) ---
+
+
+@pytest.fixture(scope="module")
+def session_server(tmp_path_factory):
+    """One real SessionServer for the whole fuzz section (boot is the
+    expensive part; the attack surface under test is per-connection)."""
+    from gol_tpu.distributed import SessionServer
+    from gol_tpu.params import Params
+
+    out = tmp_path_factory.mktemp("sess-fuzz")
+    p = Params(turns=10**9, threads=1, image_width=64, image_height=64,
+               out_dir=str(out))
+    srv = SessionServer(p, port=0, watched_chunk=4, idle_chunk=32).start()
+    yield srv
+    srv.shutdown()
+
+
+def _hello(addr, **extra) -> socket.socket:
+    s = socket.create_connection(addr, timeout=10)
+    s.settimeout(10)
+    wire.send_msg(s, {"t": "hello", **extra})
+    return s
+
+
+def test_session_hello_unknown_id_rejected(session_server):
+    """A hello naming a session that does not exist is a clean
+    reasoned rejection — never a hang, never a half-attach."""
+    for sid in ("never-created", "../traversal", "", 42):
+        s = _hello(session_server.address, session=sid)
+        reply = wire.recv_msg(s)
+        assert reply == {"t": "error", "reason": "unknown-session"}, sid
+        # The server closed its side; the stream ends cleanly.
+        assert wire.recv_msg(s) is None
+        s.close()
+
+
+def test_session_duplicate_create_rejected_in_stream(session_server):
+    """Duplicate creates answer ok:false reason:"exists" in-stream —
+    the first create stays live and undamaged."""
+    s = _hello(session_server.address, sessions=True)
+    assert wire.recv_msg(s)["t"] == "attach-ack"
+    wire.send_msg(s, {"t": "session", "op": "create", "id": "dup",
+                      "width": 64, "height": 64})
+    r1 = wire.recv_msg(s)
+    assert r1["t"] == "session-r" and r1["ok"], r1
+    wire.send_msg(s, {"t": "session", "op": "create", "id": "dup",
+                      "width": 64, "height": 64})
+    r2 = wire.recv_msg(s)
+    assert r2 == {"t": "session-r", "op": "create", "ok": False,
+                  "reason": "exists"}
+    assert session_server.manager.get("dup") is not None
+    wire.send_msg(s, {"t": "session", "op": "destroy", "id": "dup"})
+    assert wire.recv_msg(s)["ok"]
+    s.close()
+
+
+def test_session_destroy_while_attached_ends_stream_cleanly(
+        session_server):
+    """Destroying a session out from under an attached watcher ends
+    the watcher's stream with a goodbye (bye), not a reset — its
+    client must see a clean close, not a crash to reconnect against."""
+    import time as _time
+
+    from gol_tpu.distributed import Controller, SessionControl
+
+    ctl = SessionControl(*session_server.address)
+    ctl.create("doomed", width=64, height=64, seed=3)
+    w = Controller(*session_server.address, want_flips=True, batch=True,
+                   session="doomed")
+    assert w.wait_sync(30)
+    ctl.destroy("doomed")
+    deadline = _time.monotonic() + 20
+    while w.state not in ("closed", "lost") and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    assert w.state == "closed", w.state  # bye delivered, no reconnect
+    w.close()
+    ctl.close()
+
+
+def test_session_verb_fuzz_never_kills_the_reader(session_server):
+    """A sweep of malformed session verbs on ONE connection: every
+    request gets an in-stream reasoned rejection and the connection
+    keeps working — a bad verb must not kill the reader thread or
+    wedge the peer."""
+    s = _hello(session_server.address, sessions=True)
+    assert wire.recv_msg(s)["t"] == "attach-ack"
+    attacks = [
+        {"t": "session", "op": "create"},                     # no id
+        {"t": "session", "op": "create", "id": "x", "width": "w",
+         "height": 64},                                       # bad dims
+        {"t": "session", "op": "create", "id": "x", "width": -1,
+         "height": 64},
+        {"t": "session", "op": "create", "id": "x", "width": 1 << 20,
+         "height": 1 << 20},                                  # too big
+        {"t": "session", "op": "create", "id": "x", "width": 64,
+         "height": 64, "rule": "Bnope"},
+        {"t": "session", "op": "create", "id": "x", "width": 64,
+         "height": 64, "rule": "B0/S23"},                     # B0 padding
+        {"t": "session", "op": "create", "id": "x", "width": 64,
+         "height": 64, "seed": "notanint"},
+        {"t": "session", "op": "create", "id": "x", "width": 64,
+         "height": 64, "density": "soup"},
+        {"t": "session", "op": "destroy", "id": "never"},
+        {"t": "session", "op": "checkpoint", "id": "never"},
+        {"t": "session", "op": "frobnicate"},
+        {"t": "session"},                                     # no op
+        {"t": "session", "op": ["create"]},                   # non-str op
+    ]
+    for msg in attacks:
+        wire.send_msg(s, msg)
+        reply = wire.recv_msg(s)
+        while reply is not None and reply.get("t") == "hb":
+            reply = wire.recv_msg(s)
+        assert reply is not None and reply["t"] == "session-r", msg
+        assert reply["ok"] is False and reply.get("reason"), (msg, reply)
+    # The connection is still fully functional after the sweep.
+    wire.send_msg(s, {"t": "session", "op": "list"})
+    reply = wire.recv_msg(s)
+    while reply is not None and reply.get("t") == "hb":
+        reply = wire.recv_msg(s)
+    assert reply["ok"] is True
+    s.close()
