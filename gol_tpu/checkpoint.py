@@ -12,11 +12,19 @@ complete checkpoint to resume from.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 from typing import Optional
 
 _SNAP = re.compile(r"^(\d+)x(\d+)x(\d+)\.pgm$")
+
+#: Basename of the per-session-tree tombstone a destroy leaves behind
+#: (docs/SESSIONS.md "Crash-consistent resume"): resume discovery
+#: treats a tombstoned session directory as destroyed even when the
+#: manifest rewrite that normally records the destroy never landed
+#: (SIGKILL between the two writes).
+TOMBSTONE = ".tombstone"
 
 
 def record_resume_turn(turn: int) -> None:
@@ -47,6 +55,49 @@ def session_checkpoint_dir(out_dir: str | os.PathLike) -> str:
     a `session.json` sidecar (rule + geometry — the PGM filename alone
     cannot carry the ruleset). Layout: docs/SESSIONS.md."""
     return os.path.join(os.fspath(out_dir), "sessions")
+
+
+def session_manifest_path(out_dir: str | os.PathLike) -> str:
+    """The session set's commit record: `<out>/sessions/manifest.json`,
+    rewritten crash-atomically (temp + rename) at every create/destroy.
+    Resume trusts the manifest over the directory listing — a crashed
+    process may leave half-written session trees, but the manifest names
+    exactly the set that was live at the last completed verb."""
+    return os.path.join(session_checkpoint_dir(out_dir), "manifest.json")
+
+
+def read_session_manifest(out_dir: str | os.PathLike) -> Optional[dict]:
+    """The manifest's `sessions` mapping (sid -> {width, height, rule,
+    seed?, density?}), or None when it is missing, torn, or not the
+    expected shape — a truncated manifest on a freshly crashed tree is
+    "no manifest" (fall back to the directory scan), never an
+    exception."""
+    try:
+        with open(session_manifest_path(out_dir)) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    sessions = data.get("sessions") if isinstance(data, dict) else None
+    if not isinstance(sessions, dict):
+        return None
+    return {
+        sid: meta for sid, meta in sessions.items()
+        if isinstance(sid, str) and isinstance(meta, dict)
+    }
+
+
+def tombstone_path(out_dir: str | os.PathLike, sid: str) -> str:
+    """Per-session destroy marker `<out>/sessions/<sid>/.tombstone` —
+    written BEFORE the manifest rewrite, so every crash window between
+    the two leaves the session provably destroyed, never resurrected."""
+    return os.path.join(session_checkpoint_dir(out_dir), sid, TOMBSTONE)
+
+
+def is_tombstoned(out_dir: str | os.PathLike, sid: str) -> bool:
+    """True when `sid` carries a destroy tombstone. Only existence
+    matters: a truncated (even empty) tombstone still records the
+    destroy — the content is operator forensics, not protocol."""
+    return os.path.exists(tombstone_path(out_dir, sid))
 
 
 def latest_any_snapshot(

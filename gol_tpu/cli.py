@@ -159,6 +159,31 @@ def build_parser() -> argparse.ArgumentParser:
                     help="with --serve: idle-eviction deadline for "
                          "peers that stop answering heartbeats "
                          "(default 3x --hb-secs)")
+    # Overload knobs (docs/RESILIENCE.md "Overload & degradation").
+    ap.add_argument("--max-peers", type=int, default=None,
+                    dest="max_peers", metavar="N",
+                    help="with --serve: admission budget — attaches "
+                         "past N live peers are rejected "
+                         "'at-capacity' with a retry_after hint "
+                         "(default: unbounded)")
+    ap.add_argument("--max-sessions", type=int, default=None,
+                    dest="max_sessions", metavar="N",
+                    help="with --serve --sessions: creates past N "
+                         "live sessions are rejected 'max-sessions' "
+                         "with a retry_after hint (default: "
+                         "unbounded)")
+    ap.add_argument("--high-water", type=int, default=None,
+                    dest="high_water", metavar="FRAMES",
+                    help="with --serve: writer-queue depth at which a "
+                         "slow peer is DEGRADED (stream frames shed, "
+                         "coalesced BoardSync on drain) instead of "
+                         "evicted (default 256)")
+    ap.add_argument("--drain-secs", type=float, default=None,
+                    dest="drain_secs", metavar="SEC",
+                    help="with --serve: how long a degraded peer may "
+                         "stay wedged before eviction — peers that "
+                         "drain inside the deadline are resynced and "
+                         "keep watching (default 10)")
     ap.add_argument("--no-reconnect", action="store_true",
                     dest="no_reconnect",
                     help="with --connect: die on the first link "
@@ -465,7 +490,10 @@ def _serve(args, params: Params, resume_path: Optional[str] = None) -> int:
     server = EngineServer(params, host, port, resume_from=resume_path,
                           secret=args.secret,
                           heartbeat_secs=args.hb_secs,
-                          evict_secs=args.evict_secs)
+                          evict_secs=args.evict_secs,
+                          max_peers=args.max_peers,
+                          high_water=args.high_water,
+                          drain_secs=args.drain_secs)
     print(f"engine serving on {server.address[0]}:{server.address[1]}")
     # Sidecar BEFORE the engine/broadcast threads: a failed port bind
     # aborts while nothing needing teardown is running (a bind failure
@@ -505,7 +533,11 @@ def _serve_sessions(args, params: Params, resume: bool) -> int:
                            heartbeat_secs=args.hb_secs,
                            evict_secs=args.evict_secs,
                            resume=resume,
-                           bucket_capacity=args.bucket_capacity)
+                           bucket_capacity=args.bucket_capacity,
+                           max_peers=args.max_peers,
+                           max_sessions=args.max_sessions,
+                           high_water=args.high_water,
+                           drain_secs=args.drain_secs)
     print(f"session engine serving on "
           f"{server.address[0]}:{server.address[1]}")
     if resume:

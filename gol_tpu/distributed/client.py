@@ -43,6 +43,7 @@ import random
 import socket
 import threading
 import time
+import uuid
 from typing import Optional
 
 import numpy as np
@@ -102,8 +103,33 @@ class _ClientMetrics:
 _METRICS = _ClientMetrics()
 
 
+#: Ceiling on any server-supplied retry_after hint, seconds. A
+#: malformed or hostile hint (negative, NaN, "a year") must never be
+#: able to park a client forever — absurd values clamp into this range
+#: and non-numeric ones are ignored (plain backoff applies).
+RETRY_AFTER_CAP = 30.0
+
+
+def sanitize_retry_after(value) -> "float | None":
+    """The server's when-to-come-back hint, made safe to sleep on:
+    a finite number clamped to [0, RETRY_AFTER_CAP], else None."""
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    v = float(value)
+    if v != v or v in (float("inf"), float("-inf")):
+        return None
+    return min(max(v, 0.0), RETRY_AFTER_CAP)
+
+
 class ServerBusyError(ConnectionError):
-    """The engine already has a controller attached."""
+    """The engine already has a controller attached (or admission
+    control shed this attach). `retry_after` carries the server's
+    sanitized when-to-come-back hint in seconds, or None when the
+    rejection had no (usable) hint."""
+
+    def __init__(self, reason: str, retry_after: "float | None" = None):
+        super().__init__(reason)
+        self.retry_after = retry_after
 
 
 class UnauthorizedError(ConnectionError):
@@ -273,7 +299,12 @@ class Controller:
                 raise UnauthorizedError(reason)
             if reason == "unknown-session":
                 raise UnknownSessionError(reason)
-            raise ServerBusyError(reason)
+            # Load rejections ("busy", "at-capacity") carry the
+            # server's retry_after hint — sanitized here once, so
+            # every consumer sleeps on a bounded number or not at all.
+            raise ServerBusyError(
+                reason, sanitize_retry_after(first.get("retry_after"))
+            )
         sock.settimeout(None)
         if first is not None and first.get("t") == "attach-ack":
             self._hb_secs = float(first.get("hb_secs", 0) or 0)
@@ -643,11 +674,21 @@ class Controller:
         try:
             deadline = time.monotonic() + self._window
             attempt = 0
+            hint: "float | None" = None
             while (self._max_reconnects is None
                    or attempt < self._max_reconnects):
-                delay = min(self._backoff_cap,
-                            self._backoff_base * (2 ** min(attempt, 20)))
-                delay *= 0.5 + self._rng.random()  # jitter: [0.5x, 1.5x)
+                if hint is not None:
+                    # Admission control told us WHEN to come back
+                    # (busy / at-capacity retry_after): honor the
+                    # server's number instead of blind exponential
+                    # guessing — light jitter only, so a shed fleet
+                    # still doesn't re-dial in lockstep.
+                    delay = hint * (0.9 + 0.2 * self._rng.random())
+                    hint = None
+                else:
+                    delay = min(self._backoff_cap,
+                                self._backoff_base * (2 ** min(attempt, 20)))
+                    delay *= 0.5 + self._rng.random()  # jitter: [0.5x, 1.5x)
                 if time.monotonic() + delay >= deadline:
                     return None
                 if self._closing.wait(delay):
@@ -660,10 +701,14 @@ class Controller:
                     # exists (destroyed while we were down) — cannot be
                     # retried into existence.
                     return None
+                except ServerBusyError as e:
+                    # Our dead slot may not be released server-side
+                    # yet (or the house is full) — exactly what the
+                    # backoff exists to wait out; a retry_after hint
+                    # replaces the next guess.
+                    hint = e.retry_after
+                    continue
                 except (ConnectionError, OSError):
-                    # Includes ServerBusy: our dead slot may not be
-                    # released server-side yet — exactly what the
-                    # backoff exists to wait out.
                     continue
                 if msg is None:
                     with contextlib.suppress(OSError):
@@ -709,6 +754,19 @@ class SessionControl:
     session wire protocol. One control connection, synchronous RPCs —
     the management half; watching a session is `Controller(session=id)`.
 
+    Verbs are IDEMPOTENT and supervised (docs/SESSIONS.md "Idempotent
+    verbs"): every create/destroy/checkpoint is stamped with a
+    client-generated request id (`rid`) and retried with
+    deadline+backoff across link failures — the control link is
+    re-dialed and re-handshaken, and the SAME rid rides every retry,
+    so the server's replay window (plus its state-based fallbacks)
+    makes an at-least-once verb exactly-once in effect: a retried
+    create never double-creates, a retried destroy never errors. Load
+    rejections (`busy`, `max-sessions`) carry a `retry_after` hint the
+    retry loop honors instead of blind exponential backoff. `list` is
+    read-only and simply re-executed. `retry_window=0` restores
+    one-shot fail-fast semantics.
+
     Not thread-safe by design (one outstanding RPC at a time). The
     control link deliberately does NOT negotiate heartbeats: with no
     reader between verbs, answering beacons can't be guaranteed, and an
@@ -718,31 +776,52 @@ class SessionControl:
     are answered inline mid-RPC and drained at the next verb."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8030, *,
-                 secret: "str | None" = None, timeout: float = 30.0):
+                 secret: "str | None" = None, timeout: float = 30.0,
+                 retry_window: float = 30.0,
+                 retry_seed: "int | None" = None):
+        self._host, self._port = host, port
+        self._secret = secret
+        self._timeout = timeout
+        self._window = max(0.0, retry_window)
+        #: Seeded jitter: a chaos scenario replays its retry schedule.
+        self._rng = random.Random(retry_seed)
+        #: rid prefix unique across processes AND restarts — a client
+        #: that crashed mid-verb and restarted must never collide with
+        #: its previous incarnation's window entries.
+        self._rid_prefix = uuid.uuid4().hex[:12]
+        self._rid_n = 0
+        self._sock: "socket.socket | None" = None
+        self._connect()
+
+    def _connect(self) -> None:
         from gol_tpu.testing import faults
 
-        self._timeout = timeout
         self._sock = faults.wrap("client", socket.create_connection(
-            (host, port), timeout=timeout
+            (self._host, self._port), timeout=self._timeout
         ))
-        self._sock.settimeout(timeout)
+        self._sock.settimeout(self._timeout)
         hello = {"t": "hello", "sessions": True}
-        if secret is not None:
-            hello["secret"] = secret
+        if self._secret is not None:
+            hello["secret"] = self._secret
         try:
             wire.send_msg(self._sock, hello)
             first = wire.recv_msg(self._sock, allow_binary=False)
         except (TimeoutError, wire.WireError, OSError) as e:
             self.close()
             raise ConnectionError(
-                f"session-control handshake with {host}:{port} "
-                f"failed: {e}"
+                f"session-control handshake with {self._host}:"
+                f"{self._port} failed: {e}"
             ) from None
         if first is None or first.get("t") == "error":
             reason = (first or {}).get("reason", "rejected")
             self.close()
             if reason == "unauthorized":
                 raise UnauthorizedError(reason)
+            if reason in ("busy", "at-capacity"):
+                raise ServerBusyError(
+                    reason,
+                    sanitize_retry_after(first.get("retry_after")),
+                )
             raise ConnectionError(reason)
         if not first.get("sessions"):
             self.close()
@@ -750,6 +829,10 @@ class SessionControl:
                 "server does not speak the session protocol "
                 "(start it with --serve --sessions)"
             )
+
+    def _next_rid(self) -> str:
+        self._rid_n += 1
+        return f"{self._rid_prefix}-{self._rid_n}"
 
     def _rpc(self, msg: dict) -> dict:
         wire.send_msg(self._sock, msg)
@@ -766,16 +849,66 @@ class SessionControl:
                     wire.send_msg(self._sock, {"t": "hb"})
                 continue
             if t == "session-r" and reply.get("op") == msg.get("op"):
+                if ("rid" in msg and reply.get("rid") is not None
+                        and reply["rid"] != msg["rid"]):
+                    continue  # a predecessor's late reply, not ours
                 return reply
             # clk echoes / future kinds: ignorable (forward compat).
 
-    def _checked(self, msg: dict) -> dict:
+    #: Transient reply reasons the retry loop waits out (everything
+    #: else — unknown-session, bad-rule, exists — is a real answer).
+    _TRANSIENT = ("busy", "max-sessions", "at-capacity")
+
+    def _checked(self, msg: dict, idempotent: bool = False) -> dict:
+        """One verb, supervised: re-dial + resend (same rid) on link
+        failures, wait out transient rejections honoring retry_after,
+        raise the first durable error. With `idempotent=False` (list)
+        the verb is still retried — re-executing a read is safe."""
         from gol_tpu.sessions.manager import SessionError
 
-        reply = self._rpc(msg)
-        if not reply.get("ok"):
-            raise SessionError(reply.get("reason", "rejected"))
-        return reply
+        if idempotent and self._window > 0:
+            msg = {**msg, "rid": self._next_rid()}
+        deadline = time.monotonic() + self._window
+        attempt = 0
+        hint: "float | None" = None
+        while True:
+            try:
+                if self._sock is None:
+                    self._connect()
+                reply = self._rpc(msg)
+            except UnauthorizedError:
+                raise
+            except (TimeoutError, ConnectionError, wire.WireError,
+                    OSError) as e:
+                # Link-level failure: the verb may or may not have
+                # landed — exactly what the rid exists for. Tear the
+                # link down and retry the SAME message.
+                if isinstance(e, ServerBusyError):
+                    hint = e.retry_after
+                self.close()
+                self._sock = None
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"session verb {msg.get('op')!r} failed after "
+                        f"{self._window:.0f}s of retries: {e}"
+                    ) from None
+            else:
+                if reply.get("ok"):
+                    return reply
+                reason = reply.get("reason", "rejected")
+                if (reason not in self._TRANSIENT
+                        or time.monotonic() >= deadline):
+                    raise SessionError(reason)
+                hint = sanitize_retry_after(reply.get("retry_after"))
+            if hint is not None:
+                delay = hint * (0.9 + 0.2 * self._rng.random())
+                hint = None
+            else:
+                delay = min(1.0, 0.05 * (2 ** min(attempt, 10)))
+                delay *= 0.5 + self._rng.random()
+            attempt += 1
+            time.sleep(min(delay, max(0.0,
+                                      deadline - time.monotonic())))
 
     def create(self, sid: str, *, width: int, height: int,
                rule: "str | None" = None, seed: "int | None" = None,
@@ -786,19 +919,23 @@ class SessionControl:
             msg["rule"] = rule
         if seed is not None:
             msg["seed"] = seed
-        return self._checked(msg)["session"]
+        return self._checked(msg, idempotent=True)["session"]
 
     def destroy(self, sid: str) -> None:
-        self._checked({"t": "session", "op": "destroy", "id": sid})
+        self._checked({"t": "session", "op": "destroy", "id": sid},
+                      idempotent=True)
 
     def list(self) -> list:
         return self._checked({"t": "session", "op": "list"})["sessions"]
 
     def checkpoint(self, sid: str) -> dict:
-        r = self._checked({"t": "session", "op": "checkpoint", "id": sid})
+        r = self._checked({"t": "session", "op": "checkpoint", "id": sid},
+                          idempotent=True)
         return {"path": r.get("path"), "turn": r.get("turn")}
 
     def close(self) -> None:
+        if self._sock is None:
+            return
         with contextlib.suppress(OSError):
             self._sock.shutdown(socket.SHUT_RDWR)
         with contextlib.suppress(OSError):

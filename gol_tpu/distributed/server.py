@@ -80,7 +80,8 @@ class _ServerMetrics:
             r: obs.counter(
                 "gol_tpu_server_rejects_total",
                 "Attaches rejected by reason", {"reason": r},
-            ) for r in ("bad-hello", "unauthorized", "busy")
+            ) for r in ("bad-hello", "unauthorized", "busy",
+                        "at-capacity")
         }
         self.attaches = {
             r: obs.counter(
@@ -108,7 +109,21 @@ class _ServerMetrics:
         )
         self.overflows = obs.counter(
             "gol_tpu_server_queue_overflows_total",
-            "Peers declared dead on writer-queue overflow",
+            "Peers evicted after staying wedged past the drain deadline",
+        )
+        self.degradations = obs.counter(
+            "gol_tpu_server_degradations_total",
+            "Peers entering degraded (frame-shedding) mode at the "
+            "writer-queue high-water mark",
+        )
+        self.recoveries = obs.counter(
+            "gol_tpu_server_degraded_recoveries_total",
+            "Degraded peers resynced via a coalesced BoardSync after "
+            "their queue drained",
+        )
+        self.shed_frames = obs.counter(
+            "gol_tpu_server_shed_frames_total",
+            "Stream frames shed instead of enqueued to degraded peers",
         )
         self.peers = obs.gauge(
             "gol_tpu_server_peers", "Currently attached peers"
@@ -124,6 +139,24 @@ class _ServerMetrics:
 
 
 _METRICS = _ServerMetrics()
+
+
+def install_lag_gauge(conn: "_Conn") -> None:
+    """Per-peer backpressure visibility: how many frames behind this
+    peer's writer queue is. Bounded-cardinality discipline: the label
+    is the connection token and `remove_lag_gauge` evicts it at
+    detach, so the registry is O(attached peers), never O(ever-seen)."""
+    conn.lag_metric = obs.gauge(
+        "gol_tpu_server_peer_lag_frames",
+        "Writer-queue depth (frames behind) per attached peer "
+        "(label evicted at detach)", {"peer": str(conn.token)},
+    )
+
+
+def remove_lag_gauge(conn: "_Conn") -> None:
+    conn.lag_metric = None
+    obs.registry().remove("gol_tpu_server_peer_lag_frames",
+                          {"peer": str(conn.token)})
 
 
 class _Conn:
@@ -149,11 +182,29 @@ class _Conn:
     #: board-sync.
     IO_TIMEOUT = 30.0
 
+    #: Writer-queue depth at which a peer is DEGRADED (stream frames
+    #: shed, coalesce-to-BoardSync on drain) instead of declared dead
+    #: (docs/RESILIENCE.md "Overload & degradation"). Well under
+    #: QUEUE_DEPTH so control frames (the coalesced sync, byes) always
+    #: have room while a peer is shedding.
+    HIGH_WATER = 256
+    #: Queue depth at/below which a degraded peer counts as drained:
+    #: the broadcaster coalesces everything it missed into one fresh
+    #: BoardSync (synced_turn-gated, so nothing double-applies).
+    LOW_WATER = 8
+
+    #: Seconds a degraded peer may stay wedged (queue above LOW_WATER)
+    #: before it is evicted — the only overflow-eviction left; a peer
+    #: that drains inside the deadline is resynced instead.
+    DRAIN_SECS = 10.0
+
     def __init__(self, sock: socket.socket, want_flips: bool,
                  compact: bool = False, binary: bool = False,
                  levels: bool = False, role: str = "drive",
                  hb: bool = False, delta: bool = False,
-                 io_timeout: Optional[float] = None):
+                 io_timeout: Optional[float] = None,
+                 high_water: Optional[int] = None,
+                 drain_secs: Optional[float] = None):
         #: "drive" (exclusive slot, verbs accepted) or "observe"
         #: (read-only: BoardSync + events, verbs rejected) — r5
         #: multi-observer serving (VERDICT r4 next #7).
@@ -227,6 +278,115 @@ class _Conn:
         self._out: "queue.Queue[bytes | None]" = queue.Queue(QUEUE_DEPTH)
         self._dead = threading.Event()
         self._writer: Optional[threading.Thread] = None
+        #: Slow-consumer degradation state (docs/RESILIENCE.md
+        #: "Overload & degradation"): once the writer queue crosses
+        #: `high_water`, stream frames (flips, turn events, beacons)
+        #: are SHED wait-free instead of killing the peer; when the
+        #: queue drains to LOW_WATER the server coalesces the missed
+        #: backlog into one BoardSync, and only a peer still wedged
+        #: past the server's drain deadline is evicted.
+        # Clamped both ways: at least one frame of band above
+        # LOW_WATER (a mark at/below the drain level would re-enter
+        # degradation the instant it recovers — a permanent
+        # degrade/resync thrash loop sending a full BoardSync per
+        # turn), and 64 frames of control-plane headroom under the
+        # queue's hard cap.
+        self.high_water = max(
+            self.LOW_WATER + 1,
+            min(QUEUE_DEPTH - 64,
+                high_water if high_water is not None
+                else self.HIGH_WATER),
+        )
+        self.drain_secs = (drain_secs if drain_secs is not None
+                           else self.DRAIN_SECS)
+        self.degraded = False
+        self.degraded_since = 0.0
+        #: One drain-deadline eviction = ONE overflow count, whichever
+        #: side (broadcaster's offer_stream or the heartbeat judge)
+        #: notices first — bench_compare gates on this counter moving
+        #: off zero, so a double-counted eviction skews the gate. Own
+        #: lock: `_lock` is held across blocking socket writes, and the
+        #: tally must stay wait-free for the broadcaster.
+        self._ovf_counted = False
+        self._ovf_lock = threading.Lock()
+        #: A coalescing BoardSync has been requested/enqueued for this
+        #: peer and has not arrived yet — don't request another.
+        self.resync_pending = False
+        #: Per-peer lag gauge (label evicted at detach) — installed by
+        #: the server once the peer is attached.
+        self.lag_metric = None
+
+    def mark_degraded(self) -> None:
+        if self.degraded:
+            return
+        self.degraded = True
+        self.degraded_since = time.monotonic()
+        self.resync_pending = False
+        _METRICS.degradations.inc()
+        log.warning(
+            "peer %d writer queue crossed high-water (%d frames): "
+            "degrading (shedding stream frames, will coalesce to a "
+            "BoardSync on drain)", self.token, self.high_water,
+        )
+        tracing.event("server.degrade", "lifecycle", role=self.role,
+                      token=self.token, queued=self._out.qsize())
+        flight.note("server.degrade", role=self.role, token=self.token)
+
+    def mark_recovered(self) -> None:
+        """A coalescing BoardSync just went out: the peer's stream is
+        whole again (synced_turn gates anything still in flight)."""
+        if not self.degraded:
+            return
+        self.degraded = False
+        self.resync_pending = False
+        _METRICS.recoveries.inc()
+        tracing.event("server.degrade_recovered", "lifecycle",
+                      role=self.role, token=self.token)
+        flight.note("server.degrade_recovered", token=self.token)
+
+    def offer_stream(self) -> bool:
+        """Gate ONE stream-plane frame (flips, turn events, beacons):
+        True = send it, False = shed it (the peer is degraded — the
+        coalescing BoardSync will make it whole on drain). Called
+        BEFORE encoding, so a shed frame never advances per-peer
+        encoder state (a delta peer's chain must only move on frames
+        that actually ship). Degradation entry happens here, wait-free,
+        on the broadcaster's thread; a degraded peer still wedged
+        (queue above LOW_WATER) past `drain_secs` is the one overflow
+        case left — declared dead exactly like the old queue-full
+        death, without ever blocking the broadcaster."""
+        if self._writer is None:
+            return True  # pre-attach: nothing to shed yet
+        if not self.degraded:
+            if self._out.qsize() < self.high_water:
+                return True
+            self.mark_degraded()
+        _METRICS.shed_frames.inc()
+        if (time.monotonic() - self.degraded_since > self.drain_secs
+                and self._out.qsize() > self.LOW_WATER):
+            self._dead.set()
+            if self.count_overflow():
+                _METRICS.overflows.inc()
+            raise wire.WireError(
+                "peer wedged past the drain deadline"
+            )
+        return False
+
+    def count_overflow(self) -> bool:
+        """Test-and-set the overflow tally for this peer: True exactly
+        once, however many threads (broadcaster, heartbeat judge)
+        declare the same drain-deadline eviction."""
+        with self._ovf_lock:
+            if self._ovf_counted:
+                return False
+            self._ovf_counted = True
+            return True
+
+    def drained(self) -> bool:
+        """A degraded peer whose writer queue has drained to LOW_WATER
+        is ready for its coalescing BoardSync."""
+        return (self.degraded and not self.resync_pending
+                and self._out.qsize() <= self.LOW_WATER)
 
     def start_writer(self, on_error) -> None:
         """Begin queue-drained sending; `on_error(conn)` fires (from
@@ -251,6 +411,13 @@ class _Conn:
                 return
 
     def _enqueue(self, payload: bytes) -> None:
+        """Queue one frame for the writer. The stream plane gates
+        itself through `offer_stream` FIRST, so a degraded peer only
+        sees control frames (handshake replies, the coalescing
+        BoardSync, farewells) here — those always enqueue, and
+        high_water sits well under QUEUE_DEPTH precisely so they have
+        room. A peer so far gone that even the control plane overflows
+        the full QUEUE_DEPTH is declared dead."""
         if self._dead.is_set():
             raise wire.WireError("peer is gone")
         self.last_tx = time.monotonic()
@@ -264,10 +431,12 @@ class _Conn:
         try:
             self._out.put_nowait(payload)
         except queue.Full:
-            # The peer is QUEUE_DEPTH frames behind: declare it dead
-            # without ever blocking the broadcaster.
+            # Even the shedding headroom is gone (control frames past
+            # the full QUEUE_DEPTH): declare the peer dead without
+            # ever blocking the broadcaster.
             self._dead.set()
-            _METRICS.overflows.inc()
+            if self.count_overflow():
+                _METRICS.overflows.inc()
             raise wire.WireError("peer send queue overflow") from None
 
     def send(self, msg: dict) -> None:
@@ -377,9 +546,25 @@ class EngineServer:
         secret: Optional[str] = None,
         heartbeat_secs: float = 2.0,
         evict_secs: Optional[float] = None,
+        max_peers: Optional[int] = None,
+        high_water: Optional[int] = None,
+        drain_secs: Optional[float] = None,
+        retry_after_secs: float = 1.0,
         **engine_kwargs,
     ):
         self.params = params
+        #: Admission budget (docs/RESILIENCE.md "Overload &
+        #: degradation"): attaches past this many live peers are
+        #: rejected "at-capacity" WITH a retry_after hint, instead of
+        #: accepted into a serving plane that can no longer keep up.
+        #: None = unbounded (legacy).
+        self.max_peers = max_peers
+        self.high_water = high_water
+        self.drain_secs = drain_secs
+        #: The hint every load rejection ("busy", "at-capacity")
+        #: carries: seconds the peer should wait before re-dialing —
+        #: the PR 3 client backoff honors it instead of guessing.
+        self.retry_after_secs = max(0.0, retry_after_secs)
         #: Liveness cadence (docs/RESILIENCE.md): beacons ride idle
         #: gaps in each peer's stream every `heartbeat_secs`; an
         #: hb-capable peer silent past `evict_secs` (default 3 beacon
@@ -548,6 +733,20 @@ class EngineServer:
                 sock.close()
                 continue
 
+            if (self.max_peers is not None
+                    and self._peer_count() >= self.max_peers):
+                # Admission control: a full house sheds the attach at
+                # the door, WITH a when-to-come-back hint — an
+                # unbounded observer pile-up is how the serving plane
+                # stops keeping up for everyone already attached.
+                _METRICS.rejects["at-capacity"].inc()
+                with contextlib.suppress(Exception):
+                    wire.send_msg(sock, {
+                        "t": "error", "reason": "at-capacity",
+                        "retry_after": self.retry_after_secs,
+                    })
+                sock.close()
+                continue
             role = ("observe" if hello.get("role") == "observe"
                     else "drive")
             # Heartbeat negotiation: the peer advertises support, we
@@ -559,7 +758,9 @@ class EngineServer:
                          binary=bool(hello.get("binary", False)),
                          levels=bool(hello.get("levels", False)),
                          role=role, hb=hb,
-                         delta=bool(hello.get("delta", False)))
+                         delta=bool(hello.get("delta", False)),
+                         high_water=self.high_water,
+                         drain_secs=self.drain_secs)
             if role == "observe":
                 # Observers fan out freely — only the DRIVER slot is
                 # exclusive (its verbs steer the run).
@@ -574,14 +775,20 @@ class EngineServer:
                         self._conn, busy = conn, False
             if busy:
                 # One DRIVER at a time (the reference's controller is
-                # singular too, ref: README.md:201-207).
+                # singular too, ref: README.md:201-207). The hint lets
+                # a waiting driver back off for exactly as long as the
+                # server believes the slot needs, not a blind guess.
                 _METRICS.rejects["busy"].inc()
                 with contextlib.suppress(Exception):
-                    wire.send_msg(sock, {"t": "error", "reason": "busy"})
+                    wire.send_msg(sock, {
+                        "t": "error", "reason": "busy",
+                        "retry_after": self.retry_after_secs,
+                    })
                 sock.close()
                 continue
             _METRICS.attaches[role].inc()
             _METRICS.peers.set(self._peer_count())
+            install_lag_gauge(conn)
 
             # Immediate ack: the controller's handshake timeout covers
             # the first reply, and the BoardSync only arrives once the
@@ -652,6 +859,7 @@ class EngineServer:
             )
         if removed:  # idempotent under the detach/close double-call
             _METRICS.detaches.inc()
+            remove_lag_gauge(conn)
             tracing.event("server.detach", "lifecycle", role=conn.role,
                           token=conn.token)
             flight.note("server.detach", role=conn.role, token=conn.token)
@@ -768,6 +976,36 @@ class EngineServer:
                     # Mid-handshake: the attach-ack (which carries the
                     # hb cadence and must be the peer's FIRST message)
                     # is sent before start_writer — never overtake it.
+                    continue
+                if conn.degraded:
+                    # The degradation plane owns a degraded peer's
+                    # verdict: no beacons into a backlogged queue, and
+                    # no hb-eviction racing the drain deadline (a
+                    # stalled reader can't answer beacons precisely
+                    # while it is the peer degradation exists to keep
+                    # alive). Drained → coalescing resync (also checked
+                    # per turn by the broadcaster; this covers paused/
+                    # idle engines); wedged past drain_secs → the one
+                    # overflow-eviction left.
+                    if conn.drained():
+                        conn.resync_pending = True
+                        self.engine.request_board_sync(
+                            enable_flips=conn.want_flips,
+                            token=conn.token,
+                        )
+                    elif (now - conn.degraded_since > conn.drain_secs
+                          and conn._out.qsize() > conn.LOW_WATER):
+                        log.warning(
+                            "evicting peer %d: wedged %.1fs past the "
+                            "drain deadline (%d frames queued)",
+                            conn.token, now - conn.degraded_since,
+                            conn._out.qsize(),
+                        )
+                        if conn.count_overflow():
+                            _METRICS.overflows.inc()
+                            flight.note("server.drain_evict",
+                                        token=conn.token)
+                        self._detach(conn)
                     continue
                 if (conn.hb and conn.hb_unanswered >= self.HB_MISS_LIMIT
                         and now - conn.last_rx > self.evict_secs):
@@ -957,18 +1195,33 @@ class EngineServer:
                     # the board message, so the next flips frame must
                     # carry the full bitmap again.
                     target.delta_prev = None
+                    # If this sync was the degradation plane's
+                    # coalescing resync, the peer's stream is whole
+                    # again: everything it shed is inside this raster.
+                    target.mark_recovered()
                 except (wire.WireError, OSError):
                     self._detach(target)
                 continue
             flush = len(flips) and isinstance(ev, TurnComplete)
             if isinstance(ev, TurnComplete):
-                # Backpressure visibility: the deepest per-peer writer
-                # queue right now (one qsize sweep per turn, not per
-                # frame — a lagging peer shows up here long before its
-                # overflow detach).
-                _METRICS.queue_depth.set(
-                    max((c._out.qsize() for c in conns), default=0)
-                )
+                # Backpressure visibility: per-peer lag gauges plus the
+                # deepest writer queue (one qsize sweep per turn, not
+                # per frame — a lagging peer shows up here long before
+                # any eviction), and the drain check that turns a
+                # recovered slow consumer's backlog into ONE coalesced
+                # BoardSync at the engine's next dispatch boundary.
+                depth = 0
+                for c in conns:
+                    q = c._out.qsize()
+                    depth = max(depth, q)
+                    if c.lag_metric is not None:
+                        c.lag_metric.set(q)
+                    if c.drained():
+                        c.resync_pending = True
+                        self.engine.request_board_sync(
+                            enable_flips=c.want_flips, token=c.token
+                        )
+                _METRICS.queue_depth.set(depth)
                 # The SERVER half of the per-turn wire correlation: one
                 # instant mark per broadcast turn, carrying the turn
                 # number — `report merge` pairs it with the client's
@@ -986,6 +1239,17 @@ class EngineServer:
                 if not conn.synced:
                     continue  # pre-sync events are not this peer's
                 try:
+                    # The per-turn stream plane is SHEDDABLE: a peer
+                    # past its high-water mark silently misses flips
+                    # and turn events here and is made whole by the
+                    # coalescing BoardSync once its queue drains.
+                    # FinalTurnComplete is the run's result — once per
+                    # run, control-plane, never shed. The gate runs
+                    # BEFORE any encode, so a shed frame never
+                    # advances this peer's delta chain.
+                    if not isinstance(ev, FinalTurnComplete) \
+                            and not conn.offer_stream():
+                        continue
                     if flush and conn.want_flips \
                             and flips_turn > conn.synced_turn:
                         self._send_flips(conn, flips_turn, flips,
@@ -1032,12 +1296,20 @@ class _SessionSink:
         conn.synced = True
         conn.synced_turn = turn
         conn.delta_prev = None
+        # A degradation-coalesced resync makes the peer whole: every
+        # frame it shed is inside this raster, and synced_turn now
+        # gates anything still buffered.
+        conn.mark_recovered()
 
     def on_flips(self, sid: str, turn: int, coords) -> None:
         conn = self._conn
         if not conn.synced or turn <= conn.synced_turn:
             return
         try:
+            # Sheddable stream plane: gate BEFORE encoding so a shed
+            # frame never advances this peer's delta chain.
+            if not conn.offer_stream():
+                return
             with tracing.span("wire.encode_flips", "wire", turn=turn,
                               session=sid):
                 _encode_and_send_flips(conn, turn, coords, None,
@@ -1048,10 +1320,27 @@ class _SessionSink:
 
     def on_turn(self, sid: str, turn: int) -> None:
         conn = self._conn
+        if conn.lag_metric is not None:
+            conn.lag_metric.set(conn._out.qsize())
+        if conn.drained():
+            # Degraded peer drained inside the deadline: coalesce the
+            # missed backlog into ONE fresh BoardSync. We are on the
+            # engine thread (the device owner), after this chunk's
+            # commit — the stack and `peek_turn` agree, and stamping
+            # the sync with the POST-chunk turn gates off the rest of
+            # this chunk's already-decoded callbacks (they are inside
+            # the raster being sent; re-applying would XOR-corrupt).
+            conn.resync_pending = True
+            mgr = self._server.manager
+            self.on_sync(sid, mgr.peek_turn(sid),
+                         mgr._fetch_board(sid))
+            return
         if not conn.synced or turn <= conn.synced_turn:
             return
-        tracing.event("turn.emit", "wire", turn=turn, session=sid)
         try:
+            if not conn.offer_stream():
+                return
+            tracing.event("turn.emit", "wire", turn=turn, session=sid)
             conn.send({"t": "ev", "k": "turn", "turn": turn,
                        "ts": time.time()})
         except (wire.WireError, OSError):
@@ -1105,6 +1394,11 @@ class SessionServer:
         bucket_capacity: int = 16,
         watched_chunk: Optional[int] = None,
         idle_chunk: Optional[int] = None,
+        max_peers: Optional[int] = None,
+        max_sessions: Optional[int] = None,
+        high_water: Optional[int] = None,
+        drain_secs: Optional[float] = None,
+        retry_after_secs: float = 1.0,
     ):
         from gol_tpu.sessions import SessionEngine, SessionManager
 
@@ -1115,12 +1409,28 @@ class SessionServer:
             else 3.0 * self.heartbeat_secs
         )
         self._secret = secret
+        #: Admission budgets + rejection hint — the EngineServer
+        #: contract (docs/RESILIENCE.md "Overload & degradation"),
+        #: plus a session-count budget the manager enforces at create.
+        self.max_peers = max_peers
+        self.high_water = high_water
+        self.drain_secs = drain_secs
+        self.retry_after_secs = max(0.0, retry_after_secs)
         self.manager = SessionManager(
             out_dir=params.out_dir,
             default_rule=params.rule,
             bucket_capacity=bucket_capacity,
             autosave_turns=params.autosave_turns,
+            max_sessions=max_sessions,
         )
+        #: Idempotency replay window (docs/SESSIONS.md "Idempotent
+        #: verbs"): request-id -> the successful session-r reply it
+        #: produced, bounded FIFO. A retried verb whose first attempt
+        #: DID land (the reply was lost to a reconnect) replays the
+        #: recorded answer instead of re-executing — a retried create
+        #: never double-creates, a retried destroy never errors.
+        self._replay: "dict[str, dict]" = {}  # insertion-ordered FIFO
+        self._replay_lock = threading.Lock()
         #: Sessions restored from out/sessions/ at boot (PR 3's
         #: `--resume latest`, composed per session).
         self.resumed = self.manager.resume_all() if resume else 0
@@ -1231,6 +1541,19 @@ class SessionServer:
     def _admit(self, sock: socket.socket, hello: dict) -> None:
         from gol_tpu.sessions import SessionError, valid_session_id
 
+        if (self.max_peers is not None
+                and len(self._conns) >= self.max_peers):
+            # Admission control (docs/RESILIENCE.md): a full house
+            # sheds the attach at the door with a when-to-come-back
+            # hint the client backoff honors.
+            _METRICS.rejects["at-capacity"].inc()
+            with contextlib.suppress(Exception):
+                wire.send_msg(sock, {
+                    "t": "error", "reason": "at-capacity",
+                    "retry_after": self.retry_after_secs,
+                })
+            sock.close()
+            return
         role = ("observe" if hello.get("role") == "observe" else "drive")
         sid = hello.get("session")
         if sid is not None and (
@@ -1248,7 +1571,9 @@ class SessionServer:
                      binary=bool(hello.get("binary", False)),
                      levels=bool(hello.get("levels", False)),
                      role=role, hb=hb,
-                     delta=bool(hello.get("delta", False)))
+                     delta=bool(hello.get("delta", False)),
+                     high_water=self.high_water,
+                     drain_secs=self.drain_secs)
         if sid is not None and role == "drive":
             with self._conn_lock:
                 busy = sid in self._drivers
@@ -1257,13 +1582,17 @@ class SessionServer:
             if busy:
                 _METRICS.rejects["busy"].inc()
                 with contextlib.suppress(Exception):
-                    wire.send_msg(sock, {"t": "error", "reason": "busy"})
+                    wire.send_msg(sock, {
+                        "t": "error", "reason": "busy",
+                        "retry_after": self.retry_after_secs,
+                    })
                 sock.close()
                 return
         with self._conn_lock:
             self._conns.append(conn)
             _METRICS.peers.set(len(self._conns))
         _METRICS.attaches[role].inc()
+        install_lag_gauge(conn)
         ack = {"t": "attach-ack", "clock": True, "sessions": True}
         if sid is not None:
             ack["session"] = sid
@@ -1279,6 +1608,16 @@ class SessionServer:
                       token=conn.token, session=sid)
         flight.note("server.attach", role=role, token=conn.token,
                     session=sid)
+        # Reader BEFORE the sink attach: manager.attach blocks on the
+        # engine thread (a cold bucket compile can hold it for tens of
+        # seconds), and heartbeat pongs arriving in that window must
+        # be READ or the liveness judge evicts a perfectly live peer —
+        # beacons were already flowing (the writer is up), so the
+        # pongs are already coming back.
+        threading.Thread(
+            target=self._reader_loop, args=(conn,),
+            name="gol-sess-reader", daemon=True,
+        ).start()
         if sid is not None:
             s = self.manager.get(sid)
             b = s.bucket if s is not None else None
@@ -1300,11 +1639,13 @@ class SessionServer:
                 self._drop_conn(conn)
                 return
             with self._conn_lock:
-                self._sinks[conn] = (sid, sink)
-        threading.Thread(
-            target=self._reader_loop, args=(conn,),
-            name="gol-sess-reader", daemon=True,
-        ).start()
+                if conn not in self._conns:
+                    # The reader dropped the peer ('q', death) while
+                    # we were attaching: undo the sink registration.
+                    with contextlib.suppress(Exception):
+                        self.manager.detach(sid, sink)
+                else:
+                    self._sinks[conn] = (sid, sink)
 
     def _drop_conn(self, conn: _Conn, detach_sink: bool = True) -> None:
         """Remove one peer everywhere (idempotent; any thread). With
@@ -1322,6 +1663,7 @@ class SessionServer:
             _METRICS.peers.set(len(self._conns))
         if removed:
             _METRICS.detaches.inc()
+            remove_lag_gauge(conn)
             tracing.event("server.detach", "lifecycle", role=conn.role,
                           token=conn.token)
         if entry is not None and detach_sink and not self._shutdown.is_set():
@@ -1397,14 +1739,86 @@ class SessionServer:
                 if c is conn:
                     del self._drivers[sid]
 
+    #: Bounded replay window for idempotent verbs: enough rids for
+    #: hundreds of in-flight retries across reconnects; old entries
+    #: age out FIFO (a retry arriving after 512 newer verbs falls back
+    #: to the state-based idempotency checks, which are still exact).
+    REPLAY_WINDOW = 512
+
+    def _replay_lookup(self, rid: str) -> Optional[dict]:
+        with self._replay_lock:
+            return self._replay.get(rid)
+
+    def _replay_record(self, rid: str, reply: dict) -> None:
+        with self._replay_lock:
+            self._replay[rid] = reply
+            while len(self._replay) > self.REPLAY_WINDOW:
+                del self._replay[next(iter(self._replay))]
+
+    def _idempotent_outcome(self, op, msg: dict, reason: str,
+                            reply: dict) -> bool:
+        """State-based idempotency for RETRIED verbs (rid present):
+        when the failure reason says the operation's effect is already
+        in place, answer ok instead of erroring the retry. This is the
+        layer that survives a server restart (the replay window does
+        not): a create that committed before a SIGKILL answers
+        `exists` after `--resume latest`, and an identical-recipe
+        retry must read that as success, not a duplicate."""
+        if op == "destroy" and reason == "unknown-session":
+            # Destroyed by the first attempt (or by anyone): the
+            # desired end state — absence — holds.
+            reply.update(ok=True, id=msg.get("id"), replayed=True)
+            return True
+        if op == "create" and reason == "exists":
+            from gol_tpu.models.rules import get_rule
+
+            s = self.manager.get(msg.get("id"))
+            if s is None:
+                return False
+            b = s.bucket
+            try:
+                want_rule = (self.manager.default_rule
+                             if msg.get("rule") is None
+                             else get_rule(msg["rule"]))
+                same = (
+                    b.width == msg.get("width")
+                    and b.height == msg.get("height")
+                    and str(b.rule) == str(want_rule)
+                    and s.seed == msg.get("seed")
+                    and (s.seed is None
+                         or s.density == float(msg.get("density", 0.25)))
+                )
+            except (ValueError, TypeError):
+                return False
+            if not same:
+                return False  # a REAL duplicate id, not a retry
+            reply.update(ok=True, session=s.info(), replayed=True)
+            return True
+        return False
+
     def _handle_session_op(self, conn: _Conn, msg: dict) -> None:
         """One `{"t":"session"}` verb; every outcome is an in-stream
         `session-r` reply — a malformed request must never kill the
-        reader or wedge the peer waiting."""
+        reader or wedge the peer waiting. Verbs stamped with a client
+        request id (`rid`) are idempotent: a completed verb's reply is
+        replayed from the bounded window, and state-based checks make
+        retried creates/destroys converge even when the window (or the
+        whole process) has been lost in between."""
         from gol_tpu.sessions import SessionError
 
         op = msg.get("op")
+        rid = msg.get("rid")
+        if not (isinstance(rid, str) and 0 < len(rid) <= 128):
+            rid = None  # absent or hostile: plain one-shot semantics
+        if rid is not None:
+            cached = self._replay_lookup(rid)
+            if cached is not None:
+                with contextlib.suppress(wire.WireError, OSError):
+                    conn.send(cached)
+                return
         reply = {"t": "session-r", "op": op}
+        if rid is not None:
+            reply["rid"] = rid
         try:
             if op == "create":
                 density = msg.get("density", 0.25)
@@ -1427,11 +1841,30 @@ class SessionServer:
             else:
                 reply.update(ok=False, reason="unknown-op")
         except SessionError as e:
-            reply.update(ok=False, reason=str(e))
+            reason = str(e)
+            if not (rid is not None
+                    and self._idempotent_outcome(op, msg, reason, reply)):
+                reply.update(ok=False, reason=reason)
+                if reason == "max-sessions":
+                    # Over-budget is transient by design: tell the
+                    # storm when to come back instead of letting it
+                    # hammer a full house.
+                    reply["retry_after"] = self.retry_after_secs
         except (TypeError, ValueError, KeyError):
             reply.update(ok=False, reason="bad-request")
         except TimeoutError:
-            reply.update(ok=False, reason="busy")
+            reply.update(ok=False, reason="busy",
+                         retry_after=self.retry_after_secs)
+        except OSError:
+            # Manifest/tombstone/checkpoint writes hit the filesystem:
+            # a full or read-only disk must answer the verb (the
+            # effect may or may not have committed — the rid retry
+            # discipline handles that), never kill the reader thread
+            # and leak a conn that consumes an admission slot forever.
+            log.exception("session verb %r failed on I/O", op)
+            reply.update(ok=False, reason="io-error")
+        if rid is not None and reply.get("ok"):
+            self._replay_record(rid, reply)
         with contextlib.suppress(wire.WireError, OSError):
             conn.send(reply)
 
@@ -1446,6 +1879,26 @@ class SessionServer:
                 sids = dict((c, s[0]) for c, s in self._sinks.items())
             for conn in conns:
                 if conn._writer is None:
+                    continue
+                if conn.degraded:
+                    # Degradation owns this peer's verdict (the
+                    # EngineServer discipline): no beacons into a
+                    # backlogged queue, no hb-eviction racing the
+                    # drain deadline. Drain-resync happens on the
+                    # engine thread (the sink's on_turn — it needs the
+                    # device); this loop only enforces the deadline.
+                    if (now - conn.degraded_since > conn.drain_secs
+                            and conn._out.qsize() > conn.LOW_WATER):
+                        log.warning(
+                            "evicting session peer %d: wedged %.1fs "
+                            "past the drain deadline", conn.token,
+                            now - conn.degraded_since,
+                        )
+                        if conn.count_overflow():
+                            _METRICS.overflows.inc()
+                            flight.note("server.drain_evict",
+                                        token=conn.token)
+                        self._drop_conn(conn)
                     continue
                 if (conn.hb and conn.hb_unanswered >= self.HB_MISS_LIMIT
                         and now - conn.last_rx > self.evict_secs):

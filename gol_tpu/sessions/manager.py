@@ -51,6 +51,18 @@ def valid_session_id(sid) -> bool:
     return isinstance(sid, str) and bool(SESSION_ID_RE.match(sid))
 
 
+def seeded_board(height: int, width: int, seed: int,
+                 density: float = 0.25) -> np.ndarray:
+    """The deterministic soup a seeded create starts from — one
+    derivation shared by `create`, manifest-driven resume, and the
+    chaos harness's unfaulted oracle (`gol_tpu.testing.chaos`), so
+    "bit-identical to an unfaulted run" is checkable from the recipe
+    alone."""
+    rng = np.random.default_rng(int(seed))
+    return ((rng.random((height, width)) < float(density))
+            .astype(np.uint8) * np.uint8(255))
+
+
 class SessionError(ValueError):
     """A session verb failed for a caller-visible reason (unknown id,
     duplicate create, invalid geometry/rule). The message is the wire
@@ -139,11 +151,18 @@ class Session:
     """One tenant: a slot in a bucket plus its own turn clock."""
 
     def __init__(self, sid: str, bucket: "_Bucket", slot: int,
-                 start_turn: int):
+                 start_turn: int, seed: Optional[int] = None,
+                 density: float = 0.25):
         self.id = sid
         self.bucket = bucket
         self.slot = slot
         self.start_turn = start_turn
+        #: Creation recipe, when the board came from a seeded soup —
+        #: recorded in the session manifest so a crash BEFORE the first
+        #: checkpoint still resumes deterministically (the manifest
+        #: entry alone can rebuild the turn-0 board).
+        self.seed = seed
+        self.density = density
         self.birth_ticks = bucket.ticks
         self.created_at = time.time()
         # Per-session labeled children — evicted at destroy.
@@ -245,6 +264,7 @@ class SessionManager:
                  default_rule: "Rule | str" = LIFE,
                  bucket_capacity: int = 16,
                  autosave_turns: int = 0,
+                 max_sessions: Optional[int] = None,
                  device=None):
         if bucket_capacity < 1:
             raise ValueError("bucket_capacity must be >= 1")
@@ -254,7 +274,15 @@ class SessionManager:
                              else default_rule)
         self.bucket_capacity = bucket_capacity
         self.autosave_turns = max(0, int(autosave_turns))
+        #: Admission budget (docs/RESILIENCE.md "Overload &
+        #: degradation"): creates beyond this raise
+        #: SessionError("max-sessions") — the server turns that into an
+        #: over-budget rejection with a retry_after hint. None = no cap.
+        self.max_sessions = max_sessions
         self.device = device
+        #: True only inside `resume_all`: restoring creates defer the
+        #: manifest rewrite to one commit at the end of the resume.
+        self._restoring = False
         self._buckets: "dict[tuple, _Bucket]" = {}
         self._by_id: "dict[str, Session]" = {}
         self._lock = threading.RLock()
@@ -296,16 +324,15 @@ class SessionManager:
             # BatchStepper's docstring).
             raise SessionError("unsupported-rule")
         if board is None and seed is not None:
-            rng = np.random.default_rng(int(seed))
-            board = (rng.random((height, width)) < float(density)).astype(
-                np.uint8
-            ) * np.uint8(255)
+            board = seeded_board(height, width, int(seed), float(density))
         if board is not None:
             board = np.asarray(board, np.uint8)
             if board.shape != (height, width):
                 raise SessionError("bad-board")
         return self._exec(lambda: self._create(
-            sid, width, height, rule_obj, board, int(start_turn)
+            sid, width, height, rule_obj, board, int(start_turn),
+            seed=None if seed is None else int(seed),
+            density=float(density),
         ))
 
     def destroy(self, sid: str) -> None:
@@ -348,44 +375,101 @@ class SessionManager:
         return s.turn if s is not None else 0
 
     def resume_all(self) -> int:
-        """Recreate every session checkpointed under out/sessions/ from
-        its latest snapshot (PR 3's `--resume latest`, per session).
-        Unreadable entries are skipped — resume discovery runs on
-        freshly crashed trees. Returns the number restored."""
+        """Restore the crash-consistent session set under out/sessions/
+        (PR 3's `--resume latest`, per session; docs/SESSIONS.md
+        "Crash-consistent resume"). Manifest-first: when
+        manifest.json is readable it names EXACTLY the live set as of
+        the last completed create/destroy — each listed session resumes
+        from its latest snapshot, or, never having checkpointed, is
+        rebuilt from its manifest recipe (seeded soup at turn 0).
+        Tombstoned sessions are never resurrected in either mode (the
+        tombstone lands BEFORE the manifest rewrite, closing the
+        SIGKILL-mid-destroy window). A missing/torn manifest falls back
+        to the legacy directory scan. Unreadable entries are skipped —
+        resume discovery runs on freshly crashed trees. Returns the
+        number restored."""
         from gol_tpu.checkpoint import (
+            is_tombstoned,
             latest_any_snapshot,
+            read_session_manifest,
             session_checkpoint_dir,
             snapshot_turn,
         )
         from gol_tpu.io.pgm import read_pgm
 
         root = session_checkpoint_dir(self.out_dir)
-        try:
-            names = sorted(os.listdir(root))
-        except OSError:
-            return 0
-        restored = 0
-        for sid in names:
-            if not valid_session_id(sid) or sid in self._by_id:
-                continue
-            found = latest_any_snapshot(os.path.join(root, sid))
-            if found is None:
-                continue
-            path, w, h = found
-            rule = None
-            with contextlib.suppress(OSError, ValueError, KeyError):
-                meta = json.loads(open(
-                    os.path.join(root, sid, "session.json")
-                ).read())
-                rule = meta.get("rule")
+        manifest = read_session_manifest(self.out_dir)
+        if manifest is None:
             try:
-                self.create(sid, width=w, height=h, rule=rule,
-                            board=read_pgm(path),
-                            start_turn=snapshot_turn(path))
-                restored += 1
-            except (SessionError, OSError, ValueError):
-                continue
+                candidates = {
+                    sid: None for sid in sorted(os.listdir(root))
+                }
+            except OSError:
+                return 0
+        else:
+            candidates = {sid: manifest[sid] for sid in sorted(manifest)}
+        restored = 0
+        # Restoring creates must NOT rewrite the manifest one by one:
+        # a crash mid-resume would commit a manifest naming only the
+        # sessions restored so far, silently shrinking the
+        # authoritative live set — exactly the torn half-set resume
+        # exists to prevent. The pre-crash manifest stays authoritative
+        # until the whole set is back; ONE rewrite at the end commits
+        # it (and repairs a torn manifest after a directory scan).
+        self._restoring = True
+        try:
+            for sid, meta in candidates.items():
+                if (not valid_session_id(sid) or sid in self._by_id
+                        or is_tombstoned(self.out_dir, sid)):
+                    continue
+                found = latest_any_snapshot(os.path.join(root, sid))
+                board = turn = None
+                if found is not None:
+                    path, w, h = found
+                    with contextlib.suppress(OSError, ValueError):
+                        board = read_pgm(path)
+                        turn = snapshot_turn(path)
+                rule = (meta or {}).get("rule")
+                if rule is None:
+                    with contextlib.suppress(OSError, ValueError,
+                                             KeyError, TypeError):
+                        side = json.loads(open(
+                            os.path.join(root, sid, "session.json")
+                        ).read())
+                        rule = side.get("rule")
+                # The creation recipe rides along even on the snapshot
+                # path: a resumed session must keep answering a
+                # rid-retried identical-recipe create with ok (the
+                # state-based idempotency compares seed/density), and
+                # the next manifest rewrite must not lose the recipe.
+                seed = (meta or {}).get("seed")
+                density = (meta or {}).get("density")
+                if board is None:
+                    # Created, never checkpointed, killed: the manifest
+                    # recipe rebuilds the turn-0 board bit-exactly. A
+                    # manifest entry with neither snapshot nor seed
+                    # cannot be reconstructed and is skipped
+                    # (board-injected sessions accept bounded loss
+                    # until first checkpoint).
+                    if meta is None or seed is None:
+                        continue
+                    w, h = meta.get("width"), meta.get("height")
+                    turn = 0
+                try:
+                    self.create(
+                        sid, width=w, height=h, rule=rule,
+                        board=board, seed=seed,
+                        density=0.25 if density is None else density,
+                        start_turn=int(turn))
+                    restored += 1
+                except (SessionError, OSError, ValueError, TypeError):
+                    continue
+        finally:
+            self._restoring = False
         if restored:
+            with self._lock:
+                with contextlib.suppress(OSError):
+                    self._write_manifest()
             flight.note("sessions.resume", count=restored)
         return restored
 
@@ -477,24 +561,94 @@ class SessionManager:
         flight.note("session.bucket_grow", bucket=b.key, capacity=new_cap)
 
     def _create(self, sid: str, width: int, height: int, rule: Rule,
-                board: Optional[np.ndarray], start_turn: int) -> dict:
+                board: Optional[np.ndarray], start_turn: int,
+                seed: Optional[int] = None,
+                density: float = 0.25) -> dict:
         if sid in self._by_id:
             raise SessionError("exists")
+        if (self.max_sessions is not None
+                and len(self._by_id) >= self.max_sessions):
+            # Admission budget: the caller (SessionServer) rides a
+            # retry_after hint on this reason so a storm backs off
+            # instead of hammering a full house.
+            raise SessionError("max-sessions")
         b = self._bucket_for(height, width, rule)
         slot = b.free.pop()
         if board is not None:
             b.stack = b.bs.set_one(b.stack, slot, board)
         else:
             b.stack = b.bs.clear_one(b.stack, slot)
-        s = Session(sid, b, slot, start_turn)
+        s = Session(sid, b, slot, start_turn, seed=seed, density=density)
         b.sessions[slot] = s
         self._by_id[sid] = s
+        # The manifest rewrite is the create's durability commit: a
+        # kill before this line leaves no trace to resume (correct —
+        # the verb never acked), a kill after it resumes the session
+        # from its manifest recipe even with zero checkpoints written.
+        # During resume_all the pre-crash manifest stays authoritative
+        # instead (one rewrite at the end of the resume).
+        if not self._restoring:
+            self._write_manifest()
+        # A re-created id takes over a DESTROYED predecessor's
+        # directory: the dead incarnation's snapshots and tombstone
+        # must not survive into the new one (a later `--resume latest`
+        # would skip the live session as destroyed, or restore the dead
+        # one's board). Strictly AFTER the manifest commit, with the
+        # tombstone removed last: every kill window resumes either
+        # nothing (tombstone still present) or the new recipe — never
+        # the destroyed incarnation. Gated on the tombstone so resuming
+        # a live session never wipes its own checkpoint history.
+        self._clear_session_remnants(sid)
         _METRICS.creates.inc()
         _METRICS.active.set(len(self._by_id))
         tracing.event("session.create", "lifecycle", session=sid,
                       bucket=b.key, slot=slot, turn=start_turn)
         flight.note("session.create", session=sid, bucket=b.key)
         return s.info()
+
+    def _clear_session_remnants(self, sid: str) -> None:
+        from gol_tpu.checkpoint import (
+            is_tombstoned,
+            session_checkpoint_dir,
+            tombstone_path,
+        )
+
+        if not is_tombstoned(self.out_dir, sid):
+            return
+        d = os.path.join(session_checkpoint_dir(self.out_dir), sid)
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return
+        for name in names:
+            if name.endswith(".pgm") or name == "session.json":
+                with contextlib.suppress(OSError):
+                    os.unlink(os.path.join(d, name))
+        # Tombstone last: a kill mid-clear must leave the predecessor
+        # destroyed (tombstone intact), never half-resurrected.
+        with contextlib.suppress(OSError):
+            os.unlink(tombstone_path(self.out_dir, sid))
+
+    def _write_manifest(self) -> None:
+        """Crash-atomic rewrite of out/sessions/manifest.json — the
+        authoritative live-session set for `--resume latest`
+        (docs/SESSIONS.md "Crash-consistent resume"). Called under the
+        manager lock at every create/destroy, so the file always
+        records a verb-boundary state, never a torn half-set."""
+        from gol_tpu.checkpoint import session_manifest_path
+
+        path = session_manifest_path(self.out_dir)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        sessions = {}
+        for s in sorted(self._by_id.values(), key=lambda s: s.id):
+            b = s.bucket
+            meta = {"width": b.width, "height": b.height,
+                    "rule": str(b.rule)}
+            if s.seed is not None:
+                meta["seed"] = s.seed
+                meta["density"] = s.density
+            sessions[s.id] = meta
+        obs.atomic_write_text(path, json.dumps({"sessions": sessions}))
 
     def _require(self, sid: str) -> Session:
         s = self._by_id.get(sid)
@@ -508,10 +662,18 @@ class SessionManager:
         for sink in b.sinks.pop(sid, []):
             with contextlib.suppress(Exception):
                 sink.on_close(sid, reason)
+        # Tombstone FIRST, manifest second: every kill window between
+        # the two leaves the session destroyed on resume (the manifest
+        # may still list it; the tombstone overrules). A shutdown-close
+        # is not a destroy — those sessions must resume.
+        if reason != "shutdown":
+            self._write_tombstone(sid, reason)
         b.stack = b.bs.clear_one(b.stack, s.slot)
         del b.sessions[s.slot]
         b.free.append(s.slot)
         del self._by_id[sid]
+        if reason != "shutdown":
+            self._write_manifest()
         # Bounded-cardinality contract: the per-session children leave
         # the registry WITH the session (pinned by test_sessions).
         for name in PER_SESSION_SERIES:
@@ -521,6 +683,18 @@ class SessionManager:
         tracing.event("session.destroy", "lifecycle", session=sid,
                       reason=reason)
         flight.note("session.destroy", session=sid, reason=reason)
+
+    def _write_tombstone(self, sid: str, reason: str) -> None:
+        from gol_tpu.checkpoint import tombstone_path
+
+        path = tombstone_path(self.out_dir, sid)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # Existence IS the record (a truncated tombstone still
+        # counts); the payload is forensics for operators.
+        obs.atomic_write_text(
+            path, json.dumps({"id": sid, "reason": reason,
+                              "ts": time.time()}),
+        )
 
     def _fetch_board(self, sid: str) -> np.ndarray:
         s = self._require(sid)

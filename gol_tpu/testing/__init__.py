@@ -1,10 +1,12 @@
-"""gol_tpu.testing — deterministic fault injection for the wire plane.
+"""gol_tpu.testing — deterministic fault injection and seeded chaos.
 
 Production code imports this lazily and only consults it when
 `GOL_TPU_FAULTS` is set (or a plan was installed programmatically), so
 the package costs nothing on the happy path. See `faults.py` for the
-spec grammar and the FaultySocket wrapper.
-"""
+spec grammar and the FaultySocket wrapper, and `chaos.py` for the
+seeded multi-session chaos harness composed on top of it (imported on
+demand — it pulls in numpy/stepper machinery the fault plane does not
+need)."""
 
 from gol_tpu.testing.faults import (
     FaultPlan,

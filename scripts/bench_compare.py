@@ -41,7 +41,14 @@ HIGHER_BETTER = re.compile(
 )
 LOWER_BETTER = re.compile(
     r"(seconds|_secs?$|_s$|_ms$|bytes|latency|overhead|stalls|redos"
-    r"|dropped|_kb$)", re.I
+    r"|dropped|_kb$"
+    # Overload-plane health (ISSUE 8): shed/degraded/evicted peers and
+    # admission rejections are zero on a healthy bench box — any bench
+    # capture where they move off zero gates as an infinite regression
+    # (the serving plane started shedding under a load it used to
+    # carry). Same for invariant violations, which must never move.
+    r"|degradations|shed_frames|overflows|evicted|rejects"
+    r"|violations)", re.I
 )
 
 

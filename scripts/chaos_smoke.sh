@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Chaos smoke (ISSUE 8 acceptance): run the seeded chaos harness
+# (gol_tpu.testing.chaos) against a REAL `--serve --sessions` process —
+# seeded fault schedule on the server's sockets, concurrent idempotent
+# verb storms, stalled-reader observers, SIGKILL at a seeded verb count,
+# restart with `--resume latest` on the same port — and assert
+#   (a) every surviving session's board is bit-identical to an
+#       unfaulted run (the fused-stepper oracle), no duplicate
+#       sessions, no resurrected destroyed session (the runner raises
+#       on any of these),
+#   (b) /metrics shows gol_tpu_server_degradations_total > 0 (the
+#       stalled observers were DEGRADED, not evicted) and
+#       gol_tpu_invariant_violations_total == 0.
+# Exercises the full production path (cli -> SessionServer admission/
+# degradation -> SessionControl rid retries -> manifest/tombstone
+# resume) — no pytest, no mocks.
+#
+# Usage: scripts/chaos_smoke.sh [SEED]   (CPU-safe; ~2-4 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SEED="${1:-42}"
+WORK=$(mktemp -d)
+REPORT="$WORK/report.json"
+trap 'rm -rf "$WORK"' EXIT
+
+fail() { echo "chaos smoke: FAILED — $1" >&2; shift
+         for f in "$@"; do echo "--- $f:" >&2; tail -40 "$f" >&2; done
+         exit 1; }
+
+echo "chaos smoke: seed $SEED, workdir $WORK"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m gol_tpu.testing.chaos \
+    --seed "$SEED" --workdir "$WORK" --storms 2 --verbs 12 --kills 1 \
+    --faults "server:reset@send:50;server:reset@recv:80" \
+    > "$REPORT" 2> "$WORK/chaos.log" \
+    || fail "chaos runner reported a contract violation" \
+            "$WORK/chaos.log" "$REPORT"
+
+python - "$REPORT" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+problems = []
+if r.get("kills", 0) < 1:
+    problems.append("the SIGKILL never happened")
+if r.get("invariant_violations", 1) != 0:
+    problems.append(f"{r['invariant_violations']} invariant violations")
+if r.get("degradations", 0) <= 0:
+    problems.append("no slow-consumer degradation: the stalled "
+                    "observers were never shed (or were evicted)")
+if r.get("sessions_verified", 0) < 2:
+    problems.append("fewer than 2 sessions verified bit-identical")
+if r.get("observer_syncs", 0) < 1:
+    problems.append("observers never resynced")
+if problems:
+    print("chaos smoke report violations: " + "; ".join(problems),
+          file=sys.stderr)
+    print(json.dumps(r, indent=2, sort_keys=True), file=sys.stderr)
+    sys.exit(1)
+print("chaos smoke: OK — "
+      f"kills={r['kills']} verbs={r['verbs']} "
+      f"sessions_verified={r['sessions_verified']} "
+      f"degradations={int(r['degradations'])} "
+      f"recoveries={int(r['recoveries'])} "
+      f"observer_verified_turn={r['observer_verified_turn']} "
+      f"invariant_violations={r['invariant_violations']}")
+EOF

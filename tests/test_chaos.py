@@ -1,0 +1,183 @@
+"""Seeded chaos scenarios (gol_tpu.testing.chaos; ISSUE 8 acceptance):
+fault schedules + crash + retried control verbs + stalled observers
+over a multi-session serve, ending bit-identical to an unfaulted run
+with zero invariant violations, no duplicate sessions, and no
+resurrected destroyed sessions.
+
+The in-process test emulates the crash (hard connection/listener
+teardown, no graceful close, then a fresh server with resume=True on
+the same port); the slow test adds the real SIGKILL via the
+subprocess ChaosRunner — the same runner `scripts/chaos_smoke.sh`
+drives.
+"""
+
+import contextlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu.params import Params
+
+
+@pytest.fixture(autouse=True)
+def _invariants_on(monkeypatch):
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.analysis.invariants import violations_total
+
+    before = violations_total()
+    yield
+    assert violations_total() - before == 0, (
+        "chaos must never corrupt a protocol invariant"
+    )
+
+
+def _server(tmp_path, port=0, resume=False):
+    from gol_tpu.distributed import SessionServer
+
+    p = Params(turns=10 ** 9, threads=1, image_width=64,
+               image_height=64, out_dir=str(tmp_path / "out"),
+               tick_seconds=60.0, autosave_turns=64)
+    return SessionServer(p, port=port, resume=resume,
+                         heartbeat_secs=0.5, high_water=64,
+                         drain_secs=30.0)
+
+
+def _hard_kill(srv):
+    """Emulate SIGKILL in-process: every socket dies abruptly, the
+    listener closes, the engine stops — and NOTHING runs the graceful
+    paths (no manager.close, no farewell byes, no final checkpoints):
+    the on-disk state is whatever the manifest/tombstones/autosaves
+    already made durable."""
+    srv._shutdown.set()
+    with contextlib.suppress(OSError):
+        srv._listener.close()
+    with srv._conn_lock:
+        conns, srv._conns = list(srv._conns), []
+        srv._drivers.clear()
+        srv._sinks.clear()
+    for c in conns:
+        c.close()
+    srv.engine.stop()
+    srv.engine.join(timeout=60)
+
+
+@pytest.mark.slow
+def test_chaos_storms_crash_resume_inprocess(tmp_path):
+    """Verb storms + a stalled observer + a mid-storm crash + resume
+    on the same port: every retried verb converges, the ledger matches
+    the live set exactly, destroyed sessions stay dead, and every
+    surviving board is bit-identical to the unfaulted oracle.
+
+    Marked slow with its SIGKILL sibling: both are heavyweight
+    multi-process/multi-thread scenarios whose internal deadlines are
+    honest under load only when the box isn't also running the rest
+    of tier-1's serving tests — and tier-1's wall-clock budget is the
+    scarcer resource."""
+    from gol_tpu.distributed.client import SessionControl
+    from gol_tpu.io.pgm import read_pgm
+    from gol_tpu.testing.chaos import (
+        Recipe,
+        ShadowObserver,
+        VerbStorm,
+        oracle_board,
+    )
+
+    srv = _server(tmp_path).start()
+    port = srv.address[1]
+    address = srv.address
+    pinned = Recipe("pin", seed=77, density=0.3)
+    verb_count = [0]
+    lock = threading.Lock()
+
+    def count():
+        with lock:
+            verb_count[0] += 1
+
+    observers, storms = [], []
+    srv2 = None
+    try:
+        boot = SessionControl(*address, retry_window=30.0, retry_seed=1)
+        boot.create(pinned.sid, **pinned.create_kwargs())
+        ob = ShadowObserver(address, pinned, seed=5, stall_secs=0.5,
+                            stall_every=25)
+        ob.start()
+        observers.append(ob)
+        for i in range(2):
+            st = VerbStorm(address, seed=100 + i, prefix=f"s{i}",
+                           verbs=10, retry_window=90.0, on_verb=count)
+            st.start()
+            storms.append(st)
+        # Crash mid-storm: genuinely in-flight verbs get torn.
+        deadline = time.monotonic() + 120
+        while verb_count[0] < 6 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert verb_count[0] >= 1, "storms never started"
+        _hard_kill(srv)
+        srv2 = _server(tmp_path, port=port, resume=True).start()
+        assert srv2.resumed >= 1  # at least the pinned session
+
+        for st in storms:
+            st.join(150)
+            assert not st.is_alive(), "storm wedged through the crash"
+            assert st.error is None, f"storm failed: {st.error!r}"
+        for o in observers:
+            o.stop()
+        for o in observers:
+            o.join(15)
+
+        ctl = SessionControl(*address, retry_window=30.0, retry_seed=2)
+        live = {s["id"] for s in ctl.list()}
+        expected = {pinned.sid: pinned}
+        destroyed = set()
+        for st in storms:
+            expected.update(st.alive)
+            destroyed |= st.destroyed
+        destroyed -= set(expected)
+        assert live == set(expected), (
+            f"live {sorted(live)} != ledger {sorted(expected)}: a "
+            "retried verb double-applied or a session was lost"
+        )
+        assert not (live & destroyed), "destroyed session resurrected"
+        for sid in sorted(live):
+            cp = ctl.checkpoint(sid)
+            got = read_pgm(cp["path"])
+            want = oracle_board(expected[sid], int(cp["turn"]))
+            np.testing.assert_array_equal(
+                got != 0, want != 0,
+                err_msg=f"{sid} diverges from the unfaulted run",
+            )
+        for o in observers:
+            o.final_check()
+            assert o.errors == [], o.errors
+        assert o.syncs >= 1
+        ctl.close()
+        boot.close()
+    finally:
+        for o in observers:
+            o.stop()
+        if srv2 is not None:
+            srv2.shutdown()
+        else:
+            srv.shutdown()
+
+
+@pytest.mark.slow
+def test_chaos_sigkill_storm_resume(tmp_path):
+    """The full acceptance scenario with a REAL SIGKILL: fault
+    schedule on the server's sockets, kill at a seeded verb count,
+    restart `--resume latest` on the same port, retried control verbs
+    through the window — bit-identical boards, consistent ledger, zero
+    invariant violations (asserted inside ChaosRunner.run; the report
+    must also show the kill actually happened)."""
+    from gol_tpu.testing.chaos import ChaosRunner
+
+    report = ChaosRunner(
+        seed=1234, workdir=str(tmp_path), storms=2, verbs_per_storm=10,
+        kills=1, fault_spec="server:reset@send:40;server:reset@recv:70",
+    ).run()
+    assert report["kills"] == 1
+    assert report["invariant_violations"] == 0
+    assert report["sessions_verified"] >= 2  # the pinned pair at least
+    assert report["observer_syncs"] >= 1

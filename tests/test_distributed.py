@@ -773,9 +773,73 @@ def test_one_driver_two_observers(golden_root, tmp_path):
         c.close()
 
 
+def test_driver_slot_takeover_mid_run(golden_root, tmp_path):
+    """Driver-slot takeover (VERDICT r5 #3 / ROADMAP item 2 rider): a
+    detaching driver frees the slot mid-watched-run; a new
+    role:"drive" attach acquires it with a fresh BoardSync and can
+    steer ('s' writes a snapshot); a second SIMULTANEOUS driver still
+    bounces with "busy" carrying a retry_after hint. The takeover
+    driver's merged event stream stays consistent (monotone turns)."""
+    import os
+
+    server = make_server(golden_root, tmp_path, turns=200000, chunk=1,
+                         autosave_turns=0).start()
+    out_dir = tmp_path / "out"
+    a = Controller(*server.address, want_flips=True, batch=True)
+    assert a.wait_sync(60)
+    # Simultaneous second driver: still one slot.
+    with pytest.raises(ServerBusyError) as ei:
+        Controller(*server.address, want_flips=False, reconnect=False)
+    assert str(ei.value) == "busy"
+    assert ei.value.retry_after is not None and ei.value.retry_after > 0
+    assert a.detach(30)
+
+    b = Controller(*server.address, want_flips=True, batch=True)
+    assert b.wait_sync(60), "takeover driver got no fresh BoardSync"
+    takeover_turn = b.sync_turn
+    # B steers: 's' must land a snapshot — proof the slot (and its
+    # verb authority) transferred.
+    before = set(os.listdir(out_dir)) if out_dir.exists() else set()
+    b.send_key("s")
+    deadline = time.monotonic() + 60
+    new_snaps = set()
+    while time.monotonic() < deadline and not new_snaps:
+        now_files = set(os.listdir(out_dir)) if out_dir.exists() else set()
+        new_snaps = {f for f in now_files - before if f.endswith(".pgm")}
+        time.sleep(0.05)
+    assert new_snaps, "takeover driver's 's' verb produced no snapshot"
+    # Merged stream consistent: monotone turn numbers from the sync on.
+    last = takeover_turn
+    seen = 0
+    for ev in b.events:
+        if isinstance(ev, TurnComplete):
+            assert ev.completed_turns >= last, (
+                f"turn went backwards after takeover: {last} -> "
+                f"{ev.completed_turns}"
+            )
+            last = ev.completed_turns
+            seen += 1
+            if seen >= 10:
+                break
+    assert seen >= 10
+    b.send_key("k")  # end the run; the engine was still evolving
+    assert server.wait(120)
+    a.close()
+    b.close()
+
+
 def test_observer_detach_leaves_run_untouched(golden_root, tmp_path):
     """An observer's 'q' detaches only itself: the driver keeps
-    streaming and the engine keeps evolving."""
+    streaming and the engine keeps evolving.
+
+    Deflaked (ISSUE 8): the old `assert not server.done.is_set()`
+    raced the run's natural end on a loaded host — with the fast
+    engine ahead of the wire, all 400 turns can complete during the
+    observer's detach handshake. The observable contract is judged
+    from the DRIVER's event stream instead: an observer detach that
+    wrongly ended the run would cut the stream short of turn 400 (a
+    'k'-style stop snapshots and closes at the current turn), so a
+    FinalTurnComplete at exactly 400 proves the run was untouched."""
     server = make_server(golden_root, tmp_path, turns=400, chunk=1).start()
     driver = Controller(*server.address, want_flips=False)
     ob = Controller(*server.address, want_flips=False, observe=True)
@@ -783,7 +847,6 @@ def test_observer_detach_leaves_run_untouched(golden_root, tmp_path):
         if isinstance(ev, TurnComplete) and ev.completed_turns >= 3:
             break
     assert ob.detach(30)
-    assert not server.done.is_set()
     final = None
     for ev in driver.events:
         if isinstance(ev, FinalTurnComplete):

@@ -1,0 +1,641 @@
+"""Overload-safe serving plane (ISSUE 8, docs/RESILIENCE.md "Overload
+& degradation"):
+
+- DEGRADATION: a stalled observer on a live multi-session serve is
+  degraded (stream frames shed, `gol_tpu_server_degradations_total`
+  grows) instead of evicted, the driver's cadence is untouched, and
+  once the observer unstalls it is made whole by ONE coalescing
+  BoardSync and resumes watching bit-exactly.
+- DRAIN DEADLINE: overflow-eviction fires only for peers still wedged
+  past `drain_secs` — never at the moment the queue crosses high
+  water.
+- ADMISSION: `max_peers` / `max_sessions` budgets reject with a
+  `retry_after` hint; the client backoff honors the hint instead of
+  blind exponential guessing.
+- IDEMPOTENT VERBS: request-id-stamped create/destroy replay from the
+  server's bounded window and converge by state when the window (or
+  process) is gone — a retried create never double-creates, a retried
+  destroy never errors.
+- CRASH-CONSISTENT RESUME: the atomic session manifest + destroy
+  tombstones mean `--resume latest` after SIGKILL never resumes a
+  torn half-set and never resurrects a destroyed session.
+"""
+
+import contextlib
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import obs
+from gol_tpu.distributed import wire
+from gol_tpu.params import Params
+
+
+@pytest.fixture(autouse=True)
+def _invariants_on(monkeypatch):
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.analysis.invariants import violations_total
+
+    before = violations_total()
+    yield
+    assert violations_total() - before == 0, (
+        "a runtime invariant broke during an overload scenario"
+    )
+
+
+def _series(name, **labels):
+    return obs.registry().counter(name, labels=labels or None)
+
+
+def _session_server(tmp_path, **kw):
+    from gol_tpu.distributed import SessionServer
+
+    p = Params(turns=10 ** 9, threads=1, image_width=64, image_height=64,
+               out_dir=str(tmp_path / "out"), tick_seconds=60.0)
+    kw.setdefault("heartbeat_secs", 0.2)
+    return SessionServer(p, port=0, **kw)
+
+
+def _raw_attach(address, sid, want_flips=True, rcvbuf=4096):
+    """Hand-rolled observer socket (legacy JSON encoding — the fattest
+    frames, so a stalled reader pressures the writer queue fast). The
+    small receive buffer keeps the kernel from absorbing the backlog."""
+    s = socket.create_connection(address, timeout=30)
+    with contextlib.suppress(OSError):
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+    s.settimeout(30)
+    wire.send_msg(s, {"t": "hello", "want_flips": want_flips,
+                      "role": "observe", "session": sid})
+    ack = wire.recv_msg(s, allow_binary=False)
+    assert ack and ack.get("t") == "attach-ack", ack
+    return s
+
+
+def _read_to_sync(sock):
+    """Drain messages until a board sync; returns (turn, raster)."""
+    while True:
+        m = wire.recv_msg(sock, allow_binary=False)
+        assert m is not None, "stream ended before a board sync"
+        if m.get("t") == "board":
+            turn, board = wire.msg_to_board(m)
+            return turn, np.array(board, np.uint8)
+
+
+def _wait(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+# --- slow-consumer degradation ------------------------------------------
+
+
+def test_stalled_observer_degrades_then_resumes_bit_exact(tmp_path):
+    """The acceptance pin: stall an observer's reader on a live
+    multi-session serve → the server DEGRADES it (sheds, counts) while
+    the driver's turn cadence continues; unstall → one coalescing
+    BoardSync makes the observer whole, verified bit-exactly against
+    the unfaulted oracle."""
+    from gol_tpu.distributed import Controller
+    from gol_tpu.testing.chaos import Recipe, oracle_board
+
+    deg = _series("gol_tpu_server_degradations_total")
+    rec = _series("gol_tpu_server_degraded_recoveries_total")
+    ovf = _series("gol_tpu_server_queue_overflows_total")
+    evi = _series("gol_tpu_server_peer_evicted_total")
+    d0, r0, o0, e0 = deg.value, rec.value, ovf.value, evi.value
+    # 192²: thousands of flips/turn as legacy JSON — a stalled reader
+    # hits high_water in well under a second.
+    recipe = Recipe("soup", width=192, height=192, seed=11, density=0.3)
+    srv = _session_server(tmp_path, high_water=16, drain_secs=120.0)
+    srv.start()
+    try:
+        srv.manager.create(recipe.sid, **recipe.create_kwargs())
+        other = srv.manager.create("bystander", width=64, height=64,
+                                   seed=3)
+        assert other["id"] == "bystander"
+        driver = Controller(*srv.address, want_flips=False, batch=True,
+                            session=recipe.sid)
+        assert driver.wait_sync(60)
+        ob = _raw_attach(srv.address, recipe.sid)
+        turn, shadow = _read_to_sync(ob)
+        # STALL: stop reading until the server declares degradation.
+        _wait(lambda: deg.value > d0, 60, "degradation entry")
+        assert ovf.value == o0 and evi.value == e0, (
+            "a freshly degraded peer must be neither overflow-killed "
+            "nor hb-evicted before the drain deadline"
+        )
+        # The driver's cadence is unaffected while the observer sheds:
+        # count driver turn events over a short window.
+        import queue as _queue
+
+        from gol_tpu.events import TurnComplete
+
+        seen = []
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(seen) < 5:
+            try:
+                ev = driver.events.get(timeout=0.5)
+            except _queue.Empty:
+                continue
+            if ev is None:
+                break
+            if isinstance(ev, TurnComplete):
+                seen.append(ev.completed_turns)
+        assert len(seen) >= 5, (
+            f"driver cadence stalled behind a degraded observer: only "
+            f"{len(seen)} turn events in 10s"
+        )
+        # UNSTALL: drain the backlog; the coalescing BoardSync arrives
+        # and must match the unfaulted oracle bit-for-bit; flips after
+        # it must keep matching (nothing double-applied). The server
+        # enqueues the sync frame BEFORE bumping the recovery counter,
+        # so the counter is re-checked on every subsequent message, not
+        # only at the board frame itself (boards never recur).
+        synced_turn, resynced, saw_board = turn, False, False
+        applied = turn
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            m = wire.recv_msg(ob, allow_binary=False)
+            assert m is not None
+            kind = m.get("t")
+            if kind == "board":
+                synced_turn, shadow = wire.msg_to_board(m)
+                shadow = np.array(shadow, np.uint8)
+                applied = synced_turn
+                saw_board = True
+            elif kind == "flips":
+                ft, coords = wire.msg_flips_array(m)
+                if ft > synced_turn and len(coords):
+                    xy = np.asarray(coords).reshape(-1, 2)
+                    shadow[xy[:, 1], xy[:, 0]] ^= np.uint8(255)
+                    applied = ft
+            if saw_board and rec.value > r0:
+                resynced = True
+                break
+        assert resynced, "no coalescing BoardSync after the drain"
+        want = oracle_board(recipe, applied)
+        np.testing.assert_array_equal(
+            shadow != 0, want != 0,
+            err_msg="coalesced BoardSync diverges from the unfaulted run",
+        )
+        # Follow a few more turns: the post-recovery stream must stay
+        # bit-exact (a double-applied buffered flip would XOR-corrupt).
+        deadline = time.monotonic() + 60
+        while applied < synced_turn + 3 and time.monotonic() < deadline:
+            m = wire.recv_msg(ob, allow_binary=False)
+            assert m is not None
+            if m.get("t") == "flips":
+                ft, coords = wire.msg_flips_array(m)
+                if ft > synced_turn and len(coords):
+                    xy = np.asarray(coords).reshape(-1, 2)
+                    shadow[xy[:, 1], xy[:, 0]] ^= np.uint8(255)
+                    applied = ft
+            elif m.get("t") == "board":
+                synced_turn, shadow = wire.msg_to_board(m)
+                shadow = np.array(shadow, np.uint8)
+                applied = synced_turn
+        want = oracle_board(recipe, applied)
+        np.testing.assert_array_equal(
+            shadow != 0, want != 0,
+            err_msg="post-recovery stream diverges (XOR corruption)",
+        )
+        ob.close()
+        driver.close()
+    finally:
+        srv.shutdown()
+
+
+def test_drain_deadline_evicts_only_wedged_peers(tmp_path):
+    """Overflow-eviction fires ONLY past the drain deadline: a peer
+    that stays wedged is dropped (overflows counter, socket closed);
+    crossing high water alone never kills it (the test above pins the
+    survival half)."""
+    from gol_tpu.testing.chaos import Recipe
+
+    deg = _series("gol_tpu_server_degradations_total")
+    ovf = _series("gol_tpu_server_queue_overflows_total")
+    d0, o0 = deg.value, ovf.value
+    recipe = Recipe("soup", width=192, height=192, seed=5, density=0.3)
+    srv = _session_server(tmp_path, high_water=16, drain_secs=0.5)
+    srv.start()
+    try:
+        srv.manager.create(recipe.sid, **recipe.create_kwargs())
+        ob = _raw_attach(srv.address, recipe.sid)
+        _read_to_sync(ob)
+        _wait(lambda: deg.value > d0, 60, "degradation entry")
+        # Stay wedged past the 0.5s deadline: the server must evict.
+        _wait(lambda: ovf.value > o0, 30, "drain-deadline eviction")
+        # The socket is dead from our side too (EOF or reset).
+        ob.settimeout(10)
+        with pytest.raises((wire.WireError, TimeoutError, OSError,
+                            ConnectionError)):
+            while True:
+                if wire.recv_msg(ob, allow_binary=False) is None:
+                    raise ConnectionError("clean EOF")
+        ob.close()
+    finally:
+        srv.shutdown()
+
+
+# --- admission control + retry_after ------------------------------------
+
+
+def test_at_capacity_and_busy_reject_with_retry_after(golden_root,
+                                                      tmp_path):
+    from gol_tpu.distributed import Controller, EngineServer, \
+        ServerBusyError
+
+    p = Params(turns=10 ** 9, threads=1, image_width=64, image_height=64,
+               image_dir=str(golden_root / "images"),
+               out_dir=str(tmp_path / "out"), tick_seconds=60.0, chunk=2)
+    srv = EngineServer(p, port=0, max_peers=1,
+                       retry_after_secs=0.75).start()
+    try:
+        a = Controller(*srv.address, want_flips=False, reconnect=False)
+        assert a.wait_sync(60)
+        with pytest.raises(ServerBusyError) as ei:
+            Controller(*srv.address, want_flips=False, observe=True,
+                       reconnect=False)
+        assert str(ei.value) == "at-capacity"
+        assert ei.value.retry_after == 0.75
+        a.send_key("k")
+    finally:
+        srv.shutdown()
+
+
+def test_session_budget_rejects_with_retry_after_and_admits_later(
+        tmp_path):
+    """max_sessions: over-budget creates answer max-sessions +
+    retry_after; after a destroy frees budget, the SAME retried create
+    (same rid, the client loop) succeeds."""
+    from gol_tpu.distributed import SessionControl
+    from gol_tpu.sessions import SessionError
+
+    srv = _session_server(tmp_path, max_sessions=1,
+                          retry_after_secs=0.1)
+    srv.start()
+    try:
+        ctl = SessionControl(*srv.address, retry_window=2.0,
+                             retry_seed=7)
+        ctl.create("one", width=64, height=64, seed=1)
+        t0 = time.monotonic()
+        with pytest.raises(SessionError, match="max-sessions"):
+            ctl.create("two", width=64, height=64, seed=2)
+        waited = time.monotonic() - t0
+        assert waited >= 0.09, (
+            "the retry loop must actually wait out the hint, not spin"
+        )
+
+        # Free the budget from another thread mid-retry: the retried
+        # create (same rid riding every attempt) must then land.
+        def _free():
+            time.sleep(0.4)
+            srv.manager.destroy("one")
+
+        threading.Thread(target=_free, daemon=True).start()
+        info = ctl.create("three", width=64, height=64, seed=3)
+        assert info["id"] == "three"
+        ctl.close()
+    finally:
+        srv.shutdown()
+
+
+def test_reconnect_backoff_honors_retry_after_hint():
+    """A fake server that always answers busy+retry_after=0.2: with an
+    exponential base of 10s the client could not attempt twice inside
+    a 3s window — only the hint makes the observed re-dial cadence
+    possible."""
+    from gol_tpu.distributed.client import Controller
+
+    dials = []
+    listener = socket.create_server(("127.0.0.1", 0))
+    stop = threading.Event()
+
+    def serve():
+        first = True
+        while not stop.is_set():
+            try:
+                s, _ = listener.accept()
+            except OSError:
+                return
+            dials.append(time.monotonic())
+            try:
+                wire.recv_msg(s, allow_binary=False)
+                if first:
+                    first = False
+                    wire.send_msg(s, {"t": "attach-ack"})
+                    s.close()  # immediate link-down: trigger reconnect
+                else:
+                    wire.send_msg(s, {"t": "error", "reason": "busy",
+                                      "retry_after": 0.2})
+                    s.close()
+            except Exception:
+                with contextlib.suppress(OSError):
+                    s.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        ctl = Controller(*listener.getsockname(), want_flips=False,
+                         reconnect=True, reconnect_window=3.0,
+                         backoff_base=10.0, reconnect_seed=1)
+        _wait(lambda: ctl.lost.is_set(), 30, "reconnect exhaustion")
+        busy_dials = len(dials) - 1  # first dial was the attach
+        assert busy_dials >= 3, (
+            f"only {busy_dials} re-dials in a 3s window: the 0.2s "
+            "retry_after hint was not honored (exponential base alone "
+            "is 10s)"
+        )
+        ctl.close()
+    finally:
+        stop.set()
+        listener.close()
+
+
+# --- idempotent session verbs -------------------------------------------
+
+
+def _control_sock(address):
+    s = socket.create_connection(address, timeout=30)
+    s.settimeout(30)
+    wire.send_msg(s, {"t": "hello", "sessions": True})
+    first = wire.recv_msg(s, allow_binary=False)
+    assert first and first.get("sessions")
+    return s
+
+
+def _verb(sock, msg):
+    wire.send_msg(sock, msg)
+    while True:
+        r = wire.recv_msg(sock, allow_binary=False)
+        assert r is not None
+        if r.get("t") == "hb":
+            wire.send_msg(sock, {"t": "hb"})
+            continue
+        if r.get("t") == "session-r":
+            return r
+
+
+def test_rid_replay_window_and_state_idempotency(tmp_path):
+    """Raw-wire pin of the dedupe contract: a replayed create rid
+    answers the RECORDED reply (one session exists), a replayed
+    destroy rid stays ok, a fresh-rid destroy of an absent session is
+    ensure-absent ok, and an identical create WITHOUT a rid keeps the
+    legacy strict `exists` error."""
+    srv = _session_server(tmp_path)
+    srv.start()
+    try:
+        s = _control_sock(srv.address)
+        create = {"t": "session", "op": "create", "id": "idem",
+                  "width": 64, "height": 64, "seed": 9,
+                  "density": 0.25, "rid": "rid-create-1"}
+        r1 = _verb(s, create)
+        assert r1["ok"], r1
+        r2 = _verb(s, create)  # replayed: the recorded reply
+        assert r2["ok"] and r2["rid"] == "rid-create-1"
+        assert srv.manager.get("idem") is not None
+        assert len(srv.manager.list_sessions()) == 1  # never doubled
+
+        # Same id, same recipe, DIFFERENT rid, after the window entry:
+        # state-based idempotency still answers ok.
+        r3 = _verb(s, {**create, "rid": "rid-create-2"})
+        assert r3["ok"] and r3.get("replayed")
+        # Different recipe: a REAL duplicate — strict error.
+        r4 = _verb(s, {**create, "seed": 10, "rid": "rid-create-3"})
+        assert not r4["ok"] and r4["reason"] == "exists"
+        # No rid at all: legacy strict semantics.
+        legacy = dict(create)
+        del legacy["rid"]
+        r5 = _verb(s, legacy)
+        assert not r5["ok"] and r5["reason"] == "exists"
+
+        destroy = {"t": "session", "op": "destroy", "id": "idem",
+                   "rid": "rid-destroy-1"}
+        assert _verb(s, destroy)["ok"]
+        assert _verb(s, destroy)["ok"]  # replayed
+        r6 = _verb(s, {**destroy, "rid": "rid-destroy-2"})
+        assert r6["ok"] and r6.get("replayed")  # ensure-absent
+        # Legacy destroy of an absent session keeps its strict error.
+        r7 = _verb(s, {"t": "session", "op": "destroy", "id": "idem"})
+        assert not r7["ok"] and r7["reason"] == "unknown-session"
+        s.close()
+    finally:
+        srv.shutdown()
+
+
+def test_session_control_retries_verbs_across_reconnect(tmp_path):
+    """The client half: a seeded fault plan resets the control link
+    mid-verb; SessionControl re-dials and retries the SAME rid until
+    the verb lands exactly once."""
+    from gol_tpu.distributed import SessionControl
+    from gol_tpu.testing import faults
+
+    srv = _session_server(tmp_path)
+    srv.start()
+    try:
+        ctl = SessionControl(*srv.address, retry_window=30.0,
+                             retry_seed=3)
+        # Reset the client's 4th and 7th reads: mid-RPC, after the
+        # handshake — the verb replies get torn off the wire.
+        faults.install(faults.FaultPlan.parse(
+            "client:reset@recv:4;client:reset@recv:7"
+        ))
+        try:
+            info = ctl.create("tough", width=64, height=64, seed=21)
+            assert info["id"] == "tough"
+            ctl.destroy("tough")
+        finally:
+            faults.clear()
+        assert srv.manager.get("tough") is None
+        assert len(srv.manager.list_sessions()) == 0
+        ctl.close()
+    finally:
+        srv.shutdown()
+
+
+# --- crash-consistent multi-session resume ------------------------------
+
+
+def _manager(tmp_path, **kw):
+    from gol_tpu.sessions import SessionManager
+
+    return SessionManager(out_dir=str(tmp_path / "out"), **kw)
+
+
+def test_manifest_resume_restores_exactly_the_live_set(tmp_path):
+    """Manifest-first resume: checkpointed sessions restore from their
+    snapshots, a created-but-never-checkpointed seeded session is
+    rebuilt from its manifest recipe bit-exactly at turn 0, and a
+    destroyed session never comes back."""
+    from gol_tpu.sessions.manager import seeded_board
+
+    m = _manager(tmp_path)
+    m.create("snap", width=64, height=64, seed=1)
+    m.pump(7)
+    cp = m.checkpoint("snap")
+    m.create("fresh", width=64, height=64, seed=2, density=0.4)
+    m.create("gone", width=64, height=64, seed=3)
+    m.destroy("gone")
+    # No close(): the process "dies" here (close would be a graceful
+    # shutdown; the manifest must already be complete without it).
+
+    m2 = _manager(tmp_path)
+    assert m2.resume_all() == 2
+    ids = {s["id"] for s in m2.list_sessions()}
+    assert ids == {"snap", "fresh"}
+    assert m2.get("gone") is None  # tombstoned: never resurrected
+    np.testing.assert_array_equal(
+        m2.fetch_board("snap"),
+        np.asarray(__import__("gol_tpu.io.pgm", fromlist=["read_pgm"])
+                   .read_pgm(cp["path"])),
+    )
+    assert m2.get("snap").turn == cp["turn"]
+    np.testing.assert_array_equal(
+        m2.fetch_board("fresh"), seeded_board(64, 64, 2, 0.4),
+        err_msg="manifest-recipe rebuild is not bit-exact",
+    )
+
+
+def test_kill_between_tombstone_and_manifest_stays_destroyed(tmp_path):
+    """The SIGKILL-mid-destroy window: tombstone written, manifest
+    rewrite never landed — the stale manifest still lists the session,
+    and the tombstone must overrule it."""
+    from gol_tpu.checkpoint import tombstone_path
+
+    m = _manager(tmp_path)
+    m.create("victim", width=64, height=64, seed=4)
+    m.checkpoint("victim")
+    # Simulate the torn destroy: tombstone only, manifest untouched.
+    with open(tombstone_path(m.out_dir, "victim"), "w") as f:
+        f.write("{}")
+    m2 = _manager(tmp_path)
+    assert m2.resume_all() == 0
+    assert m2.get("victim") is None
+
+
+def test_recreate_after_destroy_clears_old_incarnation(tmp_path):
+    """A re-created id must not inherit its destroyed predecessor's
+    snapshots or tombstone: resume restores the NEW recipe."""
+    from gol_tpu.sessions.manager import seeded_board
+
+    m = _manager(tmp_path)
+    m.create("phoenix", width=64, height=64, seed=5)
+    m.pump(9)
+    m.checkpoint("phoenix")
+    m.destroy("phoenix")
+    m.create("phoenix", width=64, height=64, seed=6, density=0.35)
+    m2 = _manager(tmp_path)
+    assert m2.resume_all() == 1
+    s = m2.get("phoenix")
+    assert s is not None and s.turn == 0
+    np.testing.assert_array_equal(
+        m2.fetch_board("phoenix"), seeded_board(64, 64, 6, 0.35),
+        err_msg="resume restored the destroyed incarnation's board",
+    )
+
+
+def test_mid_resume_crash_keeps_manifest_authoritative(tmp_path):
+    """A crash in the middle of resume_all must not shrink the
+    authoritative set: restoring creates defer the manifest rewrite to
+    one commit at the END of the resume, so the pre-crash manifest
+    still names every session and the next resume restores them all."""
+    from gol_tpu.checkpoint import read_session_manifest
+
+    m = _manager(tmp_path)
+    for i in range(3):
+        m.create(f"s{i}", width=64, height=64, seed=i)
+
+    m2 = _manager(tmp_path)
+    real = m2.create
+    calls = []
+
+    def dying(sid, **kw):
+        calls.append(sid)
+        if len(calls) == 2:
+            raise KeyboardInterrupt  # the mid-resume kill stand-in
+        return real(sid, **kw)
+
+    m2.create = dying
+    with pytest.raises(KeyboardInterrupt):
+        m2.resume_all()
+    assert set(read_session_manifest(tmp_path / "out")) == \
+        {"s0", "s1", "s2"}, (
+        "a torn resume rewrote the manifest down to the restored few"
+    )
+    m3 = _manager(tmp_path)
+    assert m3.resume_all() == 3
+
+
+def test_snapshot_resume_keeps_create_recipe(tmp_path):
+    """A session resumed FROM A SNAPSHOT must keep its creation
+    recipe: the state-based create idempotency compares seed/density
+    (a rid-retried identical create across a server restart must read
+    `exists` as success), and the next manifest rewrite must not lose
+    the recipe either."""
+    from gol_tpu.checkpoint import read_session_manifest
+
+    m = _manager(tmp_path)
+    m.create("keeper", width=64, height=64, seed=11, density=0.3)
+    m.pump(5)
+    m.checkpoint("keeper")
+    m2 = _manager(tmp_path)
+    assert m2.resume_all() == 1
+    s = m2.get("keeper")
+    assert s.seed == 11 and s.density == 0.3, (
+        "the snapshot path dropped the creation recipe"
+    )
+    meta = read_session_manifest(tmp_path / "out")["keeper"]
+    assert meta["seed"] == 11 and meta["density"] == 0.3
+
+
+def test_io_error_answers_verb_and_keeps_reader_alive(tmp_path):
+    """A full/read-only disk during a verb's manifest write must
+    answer the verb (`io-error`), never kill the reader thread — a
+    dead reader leaks a conn that consumes an admission slot forever
+    (SessionControl peers negotiate no heartbeats to evict them)."""
+    srv = _session_server(tmp_path)
+    srv.start()
+    try:
+        s = _control_sock(srv.address)
+
+        def boom():
+            raise OSError(28, "No space left on device")
+
+        srv.manager._write_manifest = boom
+        r = _verb(s, {"t": "session", "op": "create", "id": "nospace",
+                      "width": 64, "height": 64, "seed": 1})
+        assert not r["ok"] and r["reason"] == "io-error"
+        r2 = _verb(s, {"t": "session", "op": "list"})
+        assert r2["ok"], "the reader thread died on the I/O error"
+        s.close()
+    finally:
+        srv.shutdown()
+
+
+def test_torn_manifest_falls_back_to_directory_scan(tmp_path):
+    from gol_tpu.checkpoint import (
+        read_session_manifest,
+        session_manifest_path,
+    )
+
+    m = _manager(tmp_path)
+    m.create("scanme", width=64, height=64, seed=7)
+    m.pump(5)
+    m.checkpoint("scanme")
+    # Tear the manifest mid-write (truncated JSON).
+    path = session_manifest_path(m.out_dir)
+    with open(path, "w") as f:
+        f.write('{"sessions": {"scanme": {"width": 64,')
+    assert read_session_manifest(m.out_dir) is None
+    m2 = _manager(tmp_path)
+    assert m2.resume_all() == 1  # directory scan found the snapshot
+    assert m2.get("scanme") is not None

@@ -361,7 +361,7 @@ def _session_server(tmp_path, **kw):
 
 def test_wire_create_watch_destroy_roundtrip(tmp_path):
     from gol_tpu.distributed import Controller, SessionControl
-    from gol_tpu.events import TurnComplete
+    from gol_tpu.events import FlipBatch, TurnComplete
 
     srv = _session_server(tmp_path).start()
     try:
@@ -370,9 +370,21 @@ def test_wire_create_watch_destroy_roundtrip(tmp_path):
         w = Controller(*srv.address, want_flips=True, batch=True,
                        session="w1")
         assert w.wait_sync(30) and w.board is not None
+        # Rebuild the board from the CONSUMED event stream (the sync
+        # replays as a flip burst against zeros, then per-turn
+        # batches): unlike `w.board` — which the reader thread keeps
+        # mutating past whatever turn this loop has reached — the
+        # consumer-side shadow is exactly at `last` when we stop, so
+        # the oracle comparison races nothing (deflaked, ISSUE 8; the
+        # old form compared a moving board against a fixed turn and
+        # failed whenever the reader outran this loop).
+        shadow = np.zeros((64, 64), bool)
         last = 0
         deadline = time.monotonic() + 60
         for ev in w.events:
+            if isinstance(ev, FlipBatch) and len(ev.cells):
+                xy = np.asarray(ev.cells).reshape(-1, 2)
+                shadow[xy[:, 1], xy[:, 0]] ^= True
             if isinstance(ev, TurnComplete):
                 last = ev.completed_turns
                 if last >= 24:
@@ -381,7 +393,7 @@ def test_wire_create_watch_destroy_roundtrip(tmp_path):
         rng = np.random.default_rng(77)
         b0 = ((rng.random((64, 64)) < 0.25) * 255).astype(np.uint8)
         want = np.asarray(life.step_n(b0, last))
-        assert np.array_equal(np.asarray(w.board) != 0, want != 0), (
+        assert np.array_equal(shadow, want != 0), (
             "wire flip stream diverged from the dense oracle"
         )
         cp = ctl.checkpoint("w1")

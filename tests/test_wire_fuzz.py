@@ -494,3 +494,201 @@ def test_session_verb_fuzz_never_kills_the_reader(session_server):
         reply = wire.recv_msg(s)
     assert reply["ok"] is True
     s.close()
+
+
+# --- ISSUE 8: overload-plane surfaces -----------------------------------
+
+
+def test_retry_after_hint_sanitized_against_hostile_values():
+    """A server-supplied retry_after is attacker-adjacent input: the
+    client must clamp absurd numbers and ignore garbage — a hostile
+    hint must never park a client forever or crash the backoff math."""
+    from gol_tpu.distributed.client import (
+        RETRY_AFTER_CAP,
+        sanitize_retry_after,
+    )
+
+    assert sanitize_retry_after(1.5) == 1.5
+    assert sanitize_retry_after(0) == 0.0
+    assert sanitize_retry_after(-7) == 0.0          # no time travel
+    assert sanitize_retry_after(10 ** 9) == RETRY_AFTER_CAP
+    assert sanitize_retry_after(float("inf")) is None
+    assert sanitize_retry_after(float("nan")) is None
+    assert sanitize_retry_after("a week") is None   # non-numeric
+    assert sanitize_retry_after(None) is None
+    assert sanitize_retry_after(True) is None       # bool is not a delay
+    assert sanitize_retry_after([5]) is None
+
+
+def test_busy_rejection_with_absurd_retry_after_stays_bounded():
+    """End-to-end: a rejection carrying retry_after=1e18 surfaces as a
+    ServerBusyError whose hint is clamped to the cap — the reconnect
+    loop sleeps on the sanitized number, never the raw one."""
+    import threading
+
+    from gol_tpu.distributed.client import (
+        Controller,
+        RETRY_AFTER_CAP,
+        ServerBusyError,
+    )
+
+    listener = socket.create_server(("127.0.0.1", 0))
+
+    def serve_one():
+        s, _ = listener.accept()
+        try:
+            wire.recv_msg(s, allow_binary=False)
+            wire.send_msg(s, {"t": "error", "reason": "busy",
+                              "retry_after": 1e18})
+        finally:
+            s.close()
+
+    t = threading.Thread(target=serve_one, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(ServerBusyError) as ei:
+            Controller(*listener.getsockname(), want_flips=False,
+                       reconnect=False)
+        assert ei.value.retry_after == RETRY_AFTER_CAP
+    finally:
+        listener.close()
+
+
+def test_session_rid_fuzz_hostile_and_colliding_ids(session_server):
+    """Hostile rids (non-string, empty, oversized) degrade to plain
+    one-shot semantics; a COLLIDING rid (reused for a different verb)
+    replays the recorded reply and executes nothing — the state the
+    first verb left is untouched."""
+    s = _hello(session_server.address, sessions=True)
+    assert wire.recv_msg(s)["t"] == "attach-ack"
+
+    def verb(msg):
+        wire.send_msg(s, msg)
+        r = wire.recv_msg(s)
+        while r is not None and r.get("t") == "hb":
+            r = wire.recv_msg(s)
+        assert r is not None and r["t"] == "session-r", msg
+        return r
+
+    # Hostile rid shapes: treated as absent (strict legacy semantics),
+    # never a crash, never an entry in the replay window.
+    for bad_rid in (42, ["x"], {"r": 1}, "", "r" * 4096, None):
+        r = verb({"t": "session", "op": "destroy", "id": "nosuch",
+                  "rid": bad_rid})
+        assert r["ok"] is False and r["reason"] == "unknown-session", (
+            bad_rid, r,
+        )
+
+    # Colliding rid: create records the reply; reusing the SAME rid
+    # for a destroy replays the create's answer and destroys nothing.
+    r1 = verb({"t": "session", "op": "create", "id": "collide",
+               "width": 64, "height": 64, "rid": "shared-rid"})
+    assert r1["ok"], r1
+    r2 = verb({"t": "session", "op": "destroy", "id": "collide",
+               "rid": "shared-rid"})
+    assert r2["ok"] and r2["op"] == "create", (
+        "a colliding rid must replay the recorded reply verbatim, "
+        "not execute the new verb"
+    )
+    assert session_server.manager.get("collide") is not None, (
+        "the colliding destroy executed"
+    )
+    verb({"t": "session", "op": "destroy", "id": "collide",
+          "rid": "cleanup-rid"})
+    s.close()
+
+
+def test_truncated_manifest_and_tombstone_files(tmp_path):
+    """Crash-consistency file hardening: a torn manifest reads as "no
+    manifest" (resume falls back to the directory scan, never raises);
+    a truncated — even empty — tombstone still records the destroy."""
+    import os
+
+    from gol_tpu.checkpoint import (
+        is_tombstoned,
+        read_session_manifest,
+        session_manifest_path,
+        tombstone_path,
+    )
+
+    out = str(tmp_path)
+    assert read_session_manifest(out) is None  # missing
+    path = session_manifest_path(out)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    for torn in (b"", b'{"sessions": {"a": {"width"',
+                 b'[1, 2, 3]', b'{"sessions": "nope"}', b"\xff\xfe"):
+        with open(path, "wb") as f:
+            f.write(torn)
+        assert read_session_manifest(out) is None, torn
+    # Hostile entries inside a well-formed manifest are filtered.
+    with open(path, "w") as f:
+        f.write('{"sessions": {"ok": {"width": 64}, "bad": 42}}')
+    m = read_session_manifest(out)
+    assert m == {"ok": {"width": 64}}
+
+    # Tombstones: existence IS the record.
+    assert not is_tombstoned(out, "gone")
+    ts = tombstone_path(out, "gone")
+    os.makedirs(os.path.dirname(ts), exist_ok=True)
+    open(ts, "w").close()  # zero bytes — a kill mid-write
+    assert is_tombstoned(out, "gone")
+
+
+def test_coalesced_boardsync_interleaved_with_buffered_flips():
+    """The degradation-coalesced BoardSync arrives with older flips
+    frames still buffered around it: flips BEFORE the sync are
+    superseded by it (the sync diffs against the tracked shadow), and
+    a stale flips frame arriving AFTER it (turn <= sync turn) must be
+    DROPPED by the synced_turn gate — applying it would XOR-corrupt
+    every consumer. A scripted server pins the exact interleaving."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from gol_tpu.distributed.client import Controller
+    from gol_tpu.distributed.wire import board_to_msg, flips_to_msg
+
+    rng = np.random.default_rng(8)
+    r2 = (rng.random((8, 8)) < 0.4).astype(np.uint8) * np.uint8(255)
+    r5 = (rng.random((8, 8)) < 0.4).astype(np.uint8) * np.uint8(255)
+    f3 = np.array([[1, 1], [2, 3]], np.int32)   # pre-sync flips
+    f3_late = np.array([[4, 4], [5, 5]], np.int32)  # the stale replay
+    f6 = np.array([[0, 0], [7, 7]], np.int32)   # post-sync flips
+
+    listener = socket.create_server(("127.0.0.1", 0))
+
+    def serve_one():
+        s, _ = listener.accept()
+        try:
+            wire.recv_msg(s, allow_binary=False)  # hello
+            wire.send_msg(s, {"t": "attach-ack"})
+            wire.send_msg(s, board_to_msg(2, r2, 0))
+            wire.send_msg(s, flips_to_msg(3, f3))
+            wire.send_msg(s, board_to_msg(5, r5, 0))       # coalesced
+            wire.send_msg(s, flips_to_msg(3, f3_late))     # stale!
+            wire.send_msg(s, flips_to_msg(6, f6))
+            wire.send_msg(s, {"t": "bye"})
+            _time.sleep(0.5)
+        finally:
+            s.close()
+
+    t = threading.Thread(target=serve_one, daemon=True)
+    t.start()
+    try:
+        ctl = Controller(*listener.getsockname(), want_flips=True,
+                         batch=True, reconnect=False)
+        deadline = _time.monotonic() + 20
+        while ctl.state != "closed" and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        assert ctl.state == "closed", ctl.state
+        want = np.array(r5)
+        want[f6[:, 1], f6[:, 0]] ^= np.uint8(255)
+        np.testing.assert_array_equal(
+            ctl.board, want,
+            err_msg="stale buffered flips XOR-corrupted the shadow "
+                    "around a coalesced BoardSync",
+        )
+        ctl.close()
+    finally:
+        listener.close()
