@@ -139,17 +139,33 @@ def measure_headline() -> tuple[float, int]:
 def measure_device_rate(side: int, turns: int, latency: float,
                         backend: str = "auto") -> dict:
     """Sustained device turns/s at side² on the given backend (chained
-    dispatches, one realization, measured link latency subtracted)."""
+    dispatches, one realization, measured link latency subtracted),
+    plus the compiled one-turn step's own cost model (FLOPs / bytes
+    accessed — `gol_tpu.obs.device.cost_of`) so the capture records
+    what a turn COSTS next to how fast it ran."""
     import jax
 
-    from gol_tpu.parallel.stepper import make_stepper
+    from gol_tpu import obs
+    from gol_tpu.obs import device
+    from gol_tpu.parallel.stepper import _make_stepper, instrument_stepper
 
-    stepper = make_stepper(threads=1, height=side, width=side,
-                           devices=[jax.devices()[0]], backend=backend)
-    return _sustained_rate(stepper, side, turns, latency)
+    # ONE bare stepper and ONE device board serve both the cost probe
+    # and the rate loop: cost the BARE step (the instrumented wrapper
+    # would drag host-side obs calls through the trace), then wrap for
+    # the measurement — a second stepper + board upload per lane would
+    # double peak device memory right after measuring it.
+    bare = _make_stepper(threads=1, height=side, width=side,
+                         devices=[jax.devices()[0]], backend=backend)
+    world = bare.put(_world(side))
+    cost = device.cost_of(bare.step, world)
+    stepper = instrument_stepper(bare) if obs.enabled() else bare
+    out = _sustained_rate(stepper, side, turns, latency, world=world)
+    out["cost_per_turn"] = cost
+    return out
 
 
-def _sustained_rate(stepper, side: int, turns: int, latency: float) -> dict:
+def _sustained_rate(stepper, side: int, turns: int, latency: float,
+                    world=None) -> dict:
     """Sustained turns/s of any Stepper at side²: warm once, chain
     dispatches, realize once, subtract the measured link latency.
     Dispatches are large (100k turns where the budget allows): each
@@ -157,8 +173,9 @@ def _sustained_rate(stepper, side: int, turns: int, latency: float) -> dict:
     512² kernel rate made dispatch overhead ~10% of the measurement.
     Best-of-2: single chains occasionally catch a tunnel stall or a
     chip slow window and record 30-40% low (the r5 capture's 2048²
-    outlier vs the same-day kernel_ab anchor); one retry damps it."""
-    p = stepper.put(_world(side))
+    outlier vs the same-day kernel_ab anchor); one retry damps it.
+    `world` reuses a board the caller already put on device."""
+    p = world if world is not None else stepper.put(_world(side))
     n = min(100_000, turns)
     k = max(1, turns // n)
     int(stepper.step_n(p, n)[1])
@@ -758,6 +775,22 @@ def measure_sessions_lane(sessions: int = 64, side: int = 256,
     }
 
 
+def _lane(fn, *a, **kw):
+    """Run one bench lane with the device plane bracketed: a dict lane
+    result gains {"device_plane": {compiles, compile_seconds, split,
+    hbm_watermark_bytes, ...}} — the per-lane deltas of the compile
+    watcher and the dispatch split, so the capture shows where each
+    lane's wall time went BELOW the jit boundary (the next perf PR's
+    evidence for the watched-path budget)."""
+    from gol_tpu.obs import device
+
+    before = device.plane_snapshot()
+    out = fn(*a, **kw)
+    if isinstance(out, dict):
+        out["device_plane"] = device.plane_delta(before)
+    return out
+
+
 def metrics_capture() -> dict:
     """The gol_tpu.obs registry as a BENCH_DETAIL payload: the full
     snapshot plus a compact per-phase breakdown — device dispatch vs
@@ -802,7 +835,25 @@ def metrics_capture() -> dict:
 
     trace = {"recorded": tracing.TRACER.recorded,
              "dropped": tracing.TRACER.dropped}
-    return {"phases": phases, "snapshot": snap, "trace": trace}
+    # Device plane (r9): run-total compiles by cause, compile seconds,
+    # the dispatch device-vs-host split and the HBM watermark.
+    from gol_tpu.obs import device
+
+    dev = device.plane_snapshot()
+    # Histogram percentile summaries (r9): p50/p95/p99 of the latency-
+    # shaped histograms, computed by the registry's own quantile (the
+    # same numbers the fleet console renders live) — bench_compare
+    # gates these as HIGHER-worse series.
+    percentiles = {}
+    for name in ("gol_tpu_client_turn_latency_seconds",
+                 "gol_tpu_client_apply_seconds",
+                 "gol_tpu_engine_dispatch_seconds",
+                 "gol_tpu_device_compile_seconds"):
+        p = obs.registry().percentiles(name)
+        if p is not None:
+            percentiles[name] = p
+    return {"phases": phases, "snapshot": snap, "trace": trace,
+            "device": dev, "percentiles": percentiles}
 
 
 def expected_alive() -> int | None:
@@ -853,8 +904,8 @@ def main() -> None:
                         (8192, 25_000),   # (README.md:209-211)
                         (16384, 8_000)):  # 268M cells: strip-tiled scale
         try:
-            detail["device_rates"][f"{side}x{side}"] = measure_device_rate(
-                side, turns, latency
+            detail["device_rates"][f"{side}x{side}"] = _lane(
+                measure_device_rate, side, turns, latency
             )
         except Exception as e:
             detail["device_rates"][f"{side}x{side}"] = {"error": repr(e)}
@@ -873,7 +924,7 @@ def main() -> None:
         try:
             s = _mk(threads=1, height=side, width=side, rule=rule_s,
                     devices=[_jax.devices()[0]])
-            detail[key] = _sustained_rate(s, side, turns, latency)
+            detail[key] = _lane(_sustained_rate, s, side, turns, latency)
         except Exception as e:
             detail[key] = {"error": repr(e)}
     try:
@@ -883,8 +934,8 @@ def main() -> None:
         s = packed_gens_sharded_stepper(
             get_rule("B2/S345/C4"), [_jax.devices()[0]], 512
         )
-        detail["gens_ring1_512x512_B2_S345_C4"] = _sustained_rate(
-            s, 512, 500_000, latency
+        detail["gens_ring1_512x512_B2_S345_C4"] = _lane(
+            _sustained_rate, s, 512, 500_000, latency
         )
     except Exception as e:
         detail["gens_ring1_512x512_B2_S345_C4"] = {"error": repr(e)}
@@ -894,23 +945,23 @@ def main() -> None:
     # 2-D tiled kernel (1-D thin strips measured 1.85 Tcells/s there).
     for side, turns in ((1024, 400_000), (4096, 60_000), (16384, 12_000)):
         try:
-            detail[f"ring1_{side}x{side}"] = measure_ring_rate(
-                side, turns, latency
+            detail[f"ring1_{side}x{side}"] = _lane(
+                measure_ring_rate, side, turns, latency
             )
         except Exception as e:
             detail[f"ring1_{side}x{side}"] = {"error": repr(e)}
     # Product-path (Engine) throughput and cold-start liveness — the
     # machine-captured versions of VERDICT r1 Weak #2 and Weak #6.
     try:
-        detail["engine_512x512"] = measure_engine_rate(tps)
+        detail["engine_512x512"] = _lane(measure_engine_rate, tps)
     except Exception as e:
         detail["engine_512x512"] = {"error": repr(e)}
     try:
-        detail["diff_kernel_512x512"] = measure_diff_rate(latency)
+        detail["diff_kernel_512x512"] = _lane(measure_diff_rate, latency)
     except Exception as e:
         detail["diff_kernel_512x512"] = {"error": repr(e)}
     try:
-        detail["wire_watched_512x512"] = measure_wire_watched()
+        detail["wire_watched_512x512"] = _lane(measure_wire_watched)
     except Exception as e:
         detail["wire_watched_512x512"] = {"error": repr(e)}
     # Wire-encoding A/Bs: the same watched path forced onto binary
@@ -983,7 +1034,7 @@ def main() -> None:
     # concurrent 256² sessions as one vmapped dispatch vs 64 sequential
     # single-board engines.
     try:
-        detail["sessions_64x256"] = measure_sessions_lane()
+        detail["sessions_64x256"] = _lane(measure_sessions_lane)
     except Exception as e:
         detail["sessions_64x256"] = {"error": repr(e)}
     detail["first_alive_report_s"] = first_report

@@ -36,6 +36,8 @@ _OBS_MODULES = (
     "gol_tpu.obs.http",
     "gol_tpu.obs.tracing",
     "gol_tpu.obs.flight",
+    "gol_tpu.obs.device",
+    "gol_tpu.obs.console",
 )
 
 

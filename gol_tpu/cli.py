@@ -96,6 +96,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="bind address for --metrics-port (default "
                          "loopback; non-loopback exposure should sit "
                          "behind the same controls as --serve)")
+    ap.add_argument("--profile-dir", default=None, dest="profile_dir",
+                    metavar="DIR",
+                    help="capture a jax.profiler device trace into DIR "
+                         "for the whole run (opt-in: profiling taxes "
+                         "the dispatch path) — the capture directory "
+                         "is linked from the span tracer's export so "
+                         "obs.report merge names it next to the "
+                         "host-side timeline; see docs/OBSERVABILITY.md "
+                         "'Device plane'")
     ap.add_argument("--check-invariants", action="store_true",
                     dest="check_invariants",
                     help="assert distributed-protocol invariants at "
@@ -283,7 +292,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     # the black box the instant SIGTERM lands (the handler then raises
     # KeyboardInterrupt, so every mode's ordinary graceful-shutdown
     # path still runs). All no-ops under GOL_TPU_METRICS=0.
-    from gol_tpu.obs import flight, tracing
+    from gol_tpu.obs import device, flight, tracing
 
     tracing.set_process_label(
         "serve" if args.serve is not None
@@ -291,6 +300,19 @@ def main(argv: Optional[list[str]] = None) -> int:
     )
     flight.configure(args.out)
     flight.install_sigterm_handler()
+    # Device plane (docs/OBSERVABILITY.md "Device plane"): every real
+    # run watches its compiles and publishes its programs' cost model;
+    # library embedders opt in explicitly (a cost probe is one small
+    # AOT compile per engine). --profile-dir drives jax.profiler and
+    # stops it at exit (atexit inside start_profile).
+    device.install_compile_watcher()
+    device.enable_cost_probes()
+    if args.profile_dir:
+        if device.start_profile(args.profile_dir):
+            print(f"jax profiler capturing to {args.profile_dir}")
+        else:
+            print("warning: jax profiler capture could not start "
+                  f"in {args.profile_dir}", file=sys.stderr)
 
     # Banner (ref: main.go:48-50).
     print("Threads:", args.t)

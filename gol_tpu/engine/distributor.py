@@ -43,7 +43,7 @@ import numpy as np
 
 from gol_tpu import obs
 from gol_tpu.engine.cycles import CycleDetector
-from gol_tpu.obs import flight, tracing
+from gol_tpu.obs import device, flight, tracing
 from gol_tpu.events import (
     AliveCellsCount,
     BoardSync,
@@ -674,8 +674,16 @@ class Engine:
                     k = max(1, min(
                         k, self._autosave_turn + p.autosave_turns - turn
                     ))
-                tick = time.perf_counter() if self.timeline else 0.0
-                world, count = self.stepper.step_n(world, k)
+                tick = time.perf_counter()
+                with device.cause("fused-chunk"):
+                    world, count = self.stepper.step_n(world, k)
+                # Fused chunks report only the enqueue leg of the
+                # device split: nothing is fetched per chunk, so the
+                # sync boundary does not exist here (realizing one
+                # would BE the observer tax this path avoids).
+                device.observe_split(
+                    enqueue_s=time.perf_counter() - tick
+                )
                 _METRICS.dispatches["chunk"].inc()
                 _METRICS.turns["chunk"].inc(k)
                 _METRICS.effective_chunk.set(self.effective_chunk)
@@ -857,34 +865,43 @@ class Engine:
             world = self._pending_diffs["new_world"]
         pending = {"k": k, "world_before": world, "sparse_cap": None,
                    "compact_cap": None, "tick": time.perf_counter()}
-        if (self._sparse_cap is not None
-                and self.stepper.step_n_with_diffs_compact is not None):
-            # Variable-length compact chunk (r6): the fetch pays for
-            # headers + actual activity, not the cap — preferred over
-            # fixed-width sparse rows whenever the stepper offers it.
-            total_cap = self._compact_total_cap(k)
-            pending["compact_cap"] = total_cap
-            _METRICS.compact_chunks.inc()
-            new_world, buf, values, count = (
-                self.stepper.step_n_with_diffs_compact(world, k, total_cap)
-            )
-            # The value buffer is NOT eagerly copied: the used prefix
-            # is unknowable until the headers land, and an async copy
-            # of the whole (total_cap,) slab would ship the very
-            # per-turn value reservation this encoding exists to
-            # avoid. Only the header stack overlaps the fan-out.
-            pending["values"] = values
-        elif self._sparse_cap is not None:
-            pending["sparse_cap"] = self._sparse_cap
-            _METRICS.sparse_chunks.inc()
-            new_world, buf, count = self.stepper.step_n_with_diffs_sparse(
-                world, k, self._sparse_cap
-            )
-        else:
-            new_world, buf, count = self.stepper.step_n_with_diffs(world, k)
+        with device.cause("diff-chunk"):
+            if (self._sparse_cap is not None
+                    and self.stepper.step_n_with_diffs_compact is not None):
+                # Variable-length compact chunk (r6): the fetch pays for
+                # headers + actual activity, not the cap — preferred over
+                # fixed-width sparse rows whenever the stepper offers it.
+                total_cap = self._compact_total_cap(k)
+                pending["compact_cap"] = total_cap
+                _METRICS.compact_chunks.inc()
+                new_world, buf, values, count = (
+                    self.stepper.step_n_with_diffs_compact(world, k,
+                                                           total_cap)
+                )
+                # The value buffer is NOT eagerly copied: the used prefix
+                # is unknowable until the headers land, and an async copy
+                # of the whole (total_cap,) slab would ship the very
+                # per-turn value reservation this encoding exists to
+                # avoid. Only the header stack overlaps the fan-out.
+                pending["values"] = values
+            elif self._sparse_cap is not None:
+                pending["sparse_cap"] = self._sparse_cap
+                _METRICS.sparse_chunks.inc()
+                new_world, buf, count = (
+                    self.stepper.step_n_with_diffs_sparse(
+                        world, k, self._sparse_cap
+                    )
+                )
+            else:
+                new_world, buf, count = self.stepper.step_n_with_diffs(
+                    world, k
+                )
         start_copy = getattr(buf, "copy_to_host_async", None)
         if start_copy is not None:  # overlap the transfer (jax Arrays)
             start_copy()
+        # Host overhead to get the dispatch in flight — the `enqueue`
+        # leg of the device-vs-host split (gol_tpu.obs.device).
+        pending["enqueue_s"] = time.perf_counter() - pending["tick"]
         pending.update(new_world=new_world, buf=buf, count=count)
         return pending
 
@@ -957,14 +974,21 @@ class Engine:
             # redo through the ordinary dense scan.
             redo = (self.stepper.step_n_with_diffs_redo
                     or self.stepper.step_n_with_diffs)
-            new_world, diffs, count = redo(pending["world_before"], k)
+            with device.cause("diff-redo"):
+                new_world, diffs, count = redo(pending["world_before"], k)
             # (bit-identical to the discarded encoded result)
         if rows is None:
             if not encoded:
                 diffs = pending["buf"]
+            sync0 = time.perf_counter()
             host_diffs = (self.stepper.fetch_diffs or np.asarray)(diffs)
+            t_host = time.perf_counter()
+            pending["sync_s"] = (pending.get("sync_s", 0.0)
+                                 + t_host - sync0)
             rows = [host_diffs[i] for i in range(k)]
             self._observe_diff_activity(rows)
+            pending["host_extra_s"] = (pending.get("host_extra_s", 0.0)
+                                       + time.perf_counter() - t_host)
         # Pipelined spans overlap at dispatch time; clamping each
         # span's start to the previous span's end keeps them
         # disjoint so Timeline's busy_seconds <= wall invariant
@@ -1010,6 +1034,16 @@ class Engine:
             tracing.add_span("engine.emit", "engine",
                              time.time() - emit_dt, emit_dt,
                              {"turns": k, "turn": turn + k})
+            # The device-vs-host split of this dispatch, at the
+            # boundaries the chunk already crossed: enqueue (the
+            # dispatch call returning), sync (the fetched buffers
+            # materialising = device work + transfer), host (row
+            # DECODE — accumulated in host_extra_s by the decode
+            # paths — plus the fan-out above) — gol_tpu.obs.device.
+            device.observe_split(
+                pending.get("enqueue_s"), pending.get("sync_s"),
+                emit_dt + pending.get("host_extra_s", 0.0),
+            )
         turn += k
         self._throttle_events()
         self._maybe_autosave(turn, new_world)
@@ -1021,7 +1055,10 @@ class Engine:
         from gol_tpu.parallel.stepper import sparse_decode_rows
 
         cap = pending["sparse_cap"]
+        sync0 = time.perf_counter()
         host = np.ascontiguousarray(np.asarray(pending["buf"])).view(np.uint32)
+        t_host = time.perf_counter()
+        pending["sync_s"] = t_host - sync0
         counts = host[:, 0]
         max_m = int(counts.max()) if counts.size else 0
         if max_m > cap:
@@ -1032,6 +1069,9 @@ class Engine:
             for words in sparse_decode_rows(host, hw * w)
         ]
         self._adapt_sparse_cap(max_m)
+        # Decode is HOST work: it lands in the split's host leg (via
+        # host_extra_s), not in the sync boundary above.
+        pending["host_extra_s"] = time.perf_counter() - t_host
         return rows
 
     def _decode_compact(self, pending: dict):
@@ -1046,24 +1086,30 @@ class Engine:
             compact_value_prefix,
         )
 
+        sync0 = time.perf_counter()
         header = np.ascontiguousarray(
             np.asarray(pending["buf"])
         ).view(np.uint32)
+        pending["sync_s"] = time.perf_counter() - sync0
         counts = header[:, 0]
         total = int(counts.sum())
         if total > pending["compact_cap"]:
             return None
         fetch_vals = (self.stepper.fetch_compact_values
                       or compact_value_prefix)
+        sync0 = time.perf_counter()
         vals = np.asarray(fetch_vals(pending["values"], total))
         if vals.dtype != np.uint32:
             vals = np.ascontiguousarray(vals).view(np.uint32)
+        t_host = time.perf_counter()
+        pending["sync_s"] += t_host - sync0
         hw, w = self.p.image_height // 32, self.p.image_width
         rows = [
             words.reshape(hw, w)
             for words in compact_decode_rows(header, vals, hw * w)
         ]
         self._adapt_sparse_cap(int(counts.max()) if counts.size else 0)
+        pending["host_extra_s"] = time.perf_counter() - t_host
         # Actual link cost: the header stack plus the (bucketed) value
         # prefix that was really fetched.
         nbytes = header.nbytes + vals.nbytes

@@ -1,6 +1,7 @@
-"""gol_tpu.obs — unified observability: metrics, spans, black box.
+"""gol_tpu.obs — unified observability: metrics, spans, black box,
+device plane, fleet console.
 
-Three planes (catalog: docs/OBSERVABILITY.md):
+Five planes (catalog: docs/OBSERVABILITY.md):
 
 - **metrics** — Counter / Gauge / Histogram in a process-global
   Registry (`gol_tpu.obs.registry`), exposed as Prometheus text and
@@ -15,7 +16,14 @@ Three planes (catalog: docs/OBSERVABILITY.md):
   crash-surviving ring of recent lifecycle notes + metric deltas,
   dumped crash-atomically on SIGTERM / fatal engine errors / peer
   eviction / reconnect exhaustion, live at `/flightrecorder`, rendered
-  by `python -m gol_tpu.obs.report render`.
+  by `python -m gol_tpu.obs.report render`;
+- **device plane** (`gol_tpu.obs.device`): BELOW the jit boundary —
+  compile watcher with cause attribution, cost_analysis FLOPs/bytes,
+  memory census + HBM watermark, the `fits()` capacity estimator, the
+  per-dispatch device-vs-host time split, `--profile-dir`;
+- **fleet console** (`gol_tpu.obs.console`): ABOVE the process —
+  `python -m gol_tpu.obs.console`, a top-like live view over N
+  `/metrics` endpoints with merged fleet percentiles.
 
 Instrumented layers and their series (catalog: docs/OBSERVABILITY.md):
 
@@ -48,6 +56,8 @@ from gol_tpu.obs.registry import (
     exponential_buckets,
     gauge,
     histogram,
+    merge_cumulative_buckets,
+    quantile_from_buckets,
     registry,
     remove,
     set_enabled,
@@ -66,6 +76,8 @@ __all__ = [
     "exponential_buckets",
     "gauge",
     "histogram",
+    "merge_cumulative_buckets",
+    "quantile_from_buckets",
     "registry",
     "remove",
     "set_enabled",

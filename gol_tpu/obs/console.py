@@ -1,0 +1,421 @@
+"""Fleet console — `top` for a gol_tpu fleet, over N `/metrics` sidecars.
+
+The obs planes below one process are rich (metrics, spans, the black
+box), but a multi-tenant server plus N clients/relays had no aggregated
+view at all: an operator tailed N curl loops. This module is the plane
+ABOVE the process:
+
+    python -m gol_tpu.obs.console 127.0.0.1:9100 127.0.0.1:9101
+    python -m gol_tpu.obs.console 9100 --once          # CI snapshot
+    python -m gol_tpu.obs.console 9100 --json --once   # machine form
+
+Each endpoint is one process's `--metrics-port` sidecar. The console
+scrapes `/metrics` (Prometheus text — parsed here, stdlib only) on an
+interval and renders one row per endpoint: committed turn, turns/s
+(rate between scrapes), live sessions/peers, worst peer lag, shed/
+degradation counters, clock offset, compile count, the HBM/live-buffer
+watermark, and p50/p95/p99 turn latency computed from the histogram
+buckets via the registry's own `quantile_from_buckets` (one quantile
+implementation for every surface). A `TOTAL` row sums the fleet,
+merging the latency histograms across endpoints before taking
+percentiles (`merge_cumulative_buckets`) — fleet percentiles are NOT
+averages of per-endpoint percentiles.
+
+`--once` prints a single non-interactive snapshot (no rates — there is
+no previous sample) and exits 0 as long as every endpoint answered —
+the CI mode `scripts/metrics_smoke.sh` drives. Live mode redraws with
+ANSI clears every `--interval` seconds until Ctrl-C. A down endpoint
+renders as `DOWN` and never kills the loop (fleets have partial
+outages; that is when you want the console most).
+
+Stdlib only, read-only, loopback-friendly: every request carries a
+timeout, nothing is written anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from gol_tpu.obs.registry import (
+    merge_cumulative_buckets,
+    quantile_from_buckets,
+)
+
+__all__ = [
+    "Endpoint",
+    "fleet_snapshot",
+    "histogram_buckets",
+    "main",
+    "parse_prometheus",
+    "render",
+    "sum_series",
+]
+
+_SCRAPE_TIMEOUT = 5.0
+
+#: name{labels} -> value. Histogram buckets stay individual series
+#: (`<name>_bucket{...,le="x"}`) — `histogram_buckets` reassembles.
+Series = Dict[str, float]
+
+_LINE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)\s*$'
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> Series:
+    """The text exposition format -> {name{labels}: float}. Comments
+    and malformed lines are skipped (a scraper must survive whatever a
+    half-written exposition throws at it); label order is preserved as
+    emitted (the registry emits sorted labels, so keys are stable)."""
+    out: Series = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if not m:
+            continue
+        name, labels, raw = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            v = float(raw.replace("+Inf", "inf").replace("-Inf", "-inf"))
+        except ValueError:
+            continue
+        out[name + labels] = v
+    return out
+
+
+def _labels_of(key: str) -> Dict[str, str]:
+    i = key.find("{")
+    if i < 0:
+        return {}
+    return {m.group(1): m.group(2).replace('\\"', '"')
+            for m in _LABEL.finditer(key[i:])}
+
+
+def _name_of(key: str) -> str:
+    i = key.find("{")
+    return key if i < 0 else key[:i]
+
+
+def sum_series(metrics: Series, name: str,
+               match: Optional[Dict[str, str]] = None) -> Optional[float]:
+    """Sum every series of one family (optionally filtered by label
+    values); None when absent — callers render '-' for metrics a
+    process legitimately doesn't export (a client has no sessions)."""
+    total, seen = 0.0, False
+    for key, v in metrics.items():
+        if _name_of(key) != name:
+            continue
+        if match:
+            labels = _labels_of(key)
+            if any(labels.get(k) != want for k, want in match.items()):
+                continue
+        total += v
+        seen = True
+    return total if seen else None
+
+
+def max_series(metrics: Series, name: str) -> Optional[float]:
+    vals = [v for key, v in metrics.items() if _name_of(key) == name]
+    return max(vals) if vals else None
+
+
+def histogram_buckets(metrics: Series, name: str) -> list:
+    """Reassemble `<name>_bucket{...,le=...}` series into the
+    cumulative [(bound, cum)] form `quantile_from_buckets` takes,
+    merging across any non-`le` label sets (one population per
+    endpoint)."""
+    by_labels: Dict[Tuple, list] = {}
+    for key, v in metrics.items():
+        if _name_of(key) != f"{name}_bucket":
+            continue
+        labels = _labels_of(key)
+        le = labels.pop("le", None)
+        if le is None:
+            continue
+        bound = float("inf") if le == "+Inf" else float(le)
+        by_labels.setdefault(tuple(sorted(labels.items())), []).append(
+            (bound, int(v))
+        )
+    lists = [sorted(buckets) for buckets in by_labels.values()]
+    return merge_cumulative_buckets(lists)
+
+
+class Endpoint:
+    """One scraped `/metrics` sidecar, with the previous sample kept so
+    rates (turns/s) come from successive scrapes."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        base = spec if "://" in spec else f"http://{spec}"
+        if re.fullmatch(r"\d+", spec):
+            base = f"http://127.0.0.1:{spec}"
+        base = base.rstrip("/")
+        if base.endswith("/metrics"):
+            # The CLI banner prints the full .../metrics URL — pasting
+            # it verbatim must work, not 404 on /metrics/metrics.
+            base = base[: -len("/metrics")]
+        self.url = base + "/metrics"
+        self.prev: Optional[Tuple[float, Series]] = None
+        self.last_error: Optional[str] = None
+
+    def scrape(self) -> Optional[dict]:
+        """One sample -> the row dict `render` consumes, or None when
+        the endpoint is down (`last_error` says why)."""
+        try:
+            with urllib.request.urlopen(
+                self.url, timeout=_SCRAPE_TIMEOUT
+            ) as resp:
+                text = resp.read().decode("utf-8", "replace")
+        except Exception as e:
+            self.last_error = repr(e)
+            return None
+        self.last_error = None
+        now = time.monotonic()
+        metrics = parse_prometheus(text)
+        row = self._row(metrics, now)
+        self.prev = (now, metrics)
+        return row
+
+    def _turns(self, metrics: Series) -> Optional[float]:
+        parts = [sum_series(metrics, "gol_tpu_engine_turns_total"),
+                 sum_series(metrics, "gol_tpu_session_turns_total")]
+        vals = [p for p in parts if p is not None]
+        return sum(vals) if vals else None
+
+    def _row(self, metrics: Series, now: float) -> dict:
+        turns = self._turns(metrics)
+        rate = None
+        if self.prev is not None and turns is not None:
+            t0, prev_metrics = self.prev
+            prev_turns = self._turns(prev_metrics)
+            if prev_turns is not None and now > t0:
+                rate = max(0.0, (turns - prev_turns) / (now - t0))
+        lat = histogram_buckets(
+            metrics, "gol_tpu_client_turn_latency_seconds"
+        )
+        return {
+            "endpoint": self.spec,
+            "up": True,
+            "turn": max_series(metrics, "gol_tpu_engine_committed_turn"),
+            "turns_total": turns,
+            "turns_per_sec": rate,
+            "sessions": sum_series(metrics, "gol_tpu_sessions_active"),
+            "peers": sum_series(metrics, "gol_tpu_server_peers"),
+            "peer_lag": max_series(metrics,
+                                   "gol_tpu_server_peer_lag_frames"),
+            "degradations": sum_series(
+                metrics, "gol_tpu_server_degradations_total"
+            ),
+            "shed": sum_series(metrics,
+                               "gol_tpu_server_shed_frames_total"),
+            "reconnects": sum_series(
+                metrics, "gol_tpu_client_reconnects_total"
+            ),
+            "clock_offset_s": sum_series(
+                metrics, "gol_tpu_client_clock_offset_seconds"
+            ),
+            "compiles": sum_series(metrics,
+                                   "gol_tpu_device_compiles_total"),
+            "hbm_watermark_bytes": max_series(
+                metrics, "gol_tpu_device_hbm_watermark_bytes"
+            ),
+            "violations": sum_series(
+                metrics, "gol_tpu_invariant_violations_total"
+            ),
+            "latency_buckets": lat,
+            "latency": {
+                q: quantile_from_buckets(lat, p)
+                for q, p in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99))
+            } if lat else None,
+        }
+
+
+def fleet_snapshot(endpoints: List[Endpoint]) -> dict:
+    """Scrape every endpoint once; returns {"rows": [...], "total":
+    {...}, "down": [spec, ...]}. The TOTAL row merges latency
+    histograms across endpoints BEFORE taking percentiles."""
+    # Concurrent scrapes: one black-holed endpoint (a hanging TCP
+    # connect eats its whole 5s timeout) must not freeze the healthy
+    # rows' refresh — a partial outage is when the console matters.
+    from concurrent.futures import ThreadPoolExecutor
+
+    rows, down = [], []
+    with ThreadPoolExecutor(max_workers=min(16, len(endpoints))) as pool:
+        scraped = list(pool.map(lambda ep: ep.scrape(), endpoints))
+    for ep, row in zip(endpoints, scraped):
+        if row is None:
+            down.append(ep.spec)
+            rows.append({"endpoint": ep.spec, "up": False,
+                         "error": ep.last_error})
+        else:
+            rows.append(row)
+    live = [r for r in rows if r.get("up")]
+
+    def total_of(key):
+        vals = [r[key] for r in live if r.get(key) is not None]
+        return sum(vals) if vals else None
+
+    merged_lat = merge_cumulative_buckets(
+        [r["latency_buckets"] for r in live if r.get("latency_buckets")]
+    )
+    total = {
+        "endpoints": len(endpoints),
+        "up": len(live),
+        "turns_per_sec": total_of("turns_per_sec"),
+        "sessions": total_of("sessions"),
+        "peers": total_of("peers"),
+        "degradations": total_of("degradations"),
+        "compiles": total_of("compiles"),
+        "violations": total_of("violations"),
+        "latency": {
+            q: quantile_from_buckets(merged_lat, p)
+            for q, p in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99))
+        } if merged_lat else None,
+    }
+    return {"rows": rows, "total": total, "down": down}
+
+
+# --- rendering -----------------------------------------------------------
+
+
+def _num(v, unit: str = "") -> str:
+    if v is None:
+        return "-"
+    if unit == "bytes":
+        for suffix, scale in (("G", 1 << 30), ("M", 1 << 20),
+                              ("K", 1 << 10)):
+            if v >= scale:
+                return f"{v / scale:.1f}{suffix}"
+        return str(int(v))
+    if unit == "s":
+        return f"{v * 1e3:.1f}ms" if abs(v) < 1.0 else f"{v:.2f}s"
+    if abs(v) >= 1e6:
+        return f"{v / 1e6:.2f}M"
+    if abs(v) >= 1e4:
+        return f"{v / 1e3:.1f}k"
+    if v == int(v):
+        return str(int(v))
+    return f"{v:.1f}"
+
+
+_COLUMNS = (
+    ("endpoint", "ENDPOINT", 21, None),
+    ("turn", "TURN", 9, ""),
+    ("turns_per_sec", "TURNS/S", 9, ""),
+    ("sessions", "SESS", 5, ""),
+    ("peers", "PEERS", 5, ""),
+    ("peer_lag", "LAG", 5, ""),
+    ("degradations", "DEGR", 5, ""),
+    ("reconnects", "RECON", 5, ""),
+    ("clock_offset_s", "CLOCK", 8, "s"),
+    ("compiles", "COMPS", 5, ""),
+    ("hbm_watermark_bytes", "HBM^", 7, "bytes"),
+    ("p50", "P50", 8, "s"),
+    ("p95", "P95", 8, "s"),
+    ("p99", "P99", 8, "s"),
+)
+
+
+def _cells(row: dict) -> list:
+    lat = row.get("latency") or {}
+    cells = []
+    for key, _, width, unit in _COLUMNS:
+        if key == "endpoint":
+            cells.append(str(row.get("endpoint", "TOTAL"))[:width])
+        elif key in ("p50", "p95", "p99"):
+            cells.append(_num(lat.get(key), "s"))
+        else:
+            cells.append(_num(row.get(key), unit))
+    return cells
+
+
+def render(snap: dict, out=None, clear: bool = False) -> None:
+    out = out or sys.stdout
+    w = out.write
+    if clear:
+        w("\x1b[2J\x1b[H")
+    w("gol_tpu fleet console — %s  (%d/%d endpoints up)\n" % (
+        time.strftime("%H:%M:%S"),
+        snap["total"]["up"], snap["total"]["endpoints"],
+    ))
+    header = "  ".join(
+        f"{title:>{width}}" if key != "endpoint" else f"{title:<{width}}"
+        for key, title, width, _ in _COLUMNS
+    )
+    w(header + "\n")
+    for row in snap["rows"]:
+        if not row.get("up"):
+            w(f"{row['endpoint']:<21}  DOWN  {row.get('error', '')}\n")
+            continue
+        cells = _cells(row)
+        w("  ".join(
+            f"{c:>{width}}" if key != "endpoint" else f"{c:<{width}}"
+            for (key, _, width, _), c in zip(_COLUMNS, cells)
+        ) + "\n")
+    if len(snap["rows"]) > 1:
+        t = dict(snap["total"])
+        t["endpoint"] = "TOTAL"
+        cells = _cells(t)
+        w("  ".join(
+            f"{c:>{width}}" if key != "endpoint" else f"{c:<{width}}"
+            for (key, _, width, _), c in zip(_COLUMNS, cells)
+        ) + "\n")
+    viol = snap["total"].get("violations")
+    if viol:
+        w(f"!! INVARIANT VIOLATIONS across the fleet: {int(viol)}\n")
+
+
+# --- entry ---------------------------------------------------------------
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gol_tpu.obs.console",
+        description="top-like live view over gol_tpu /metrics endpoints",
+    )
+    ap.add_argument("endpoints", nargs="+", metavar="HOST:PORT",
+                    help="metrics sidecars to scrape (a bare PORT means "
+                         "loopback; full http:// URLs accepted)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (CI mode; exits 1 "
+                         "if any endpoint is down)")
+    ap.add_argument("--interval", type=float, default=2.0, metavar="SEC",
+                    help="live-mode refresh cadence (default 2)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the snapshot as JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    eps = [Endpoint(spec) for spec in args.endpoints]
+    if args.once:
+        snap = fleet_snapshot(eps)
+        if args.as_json:
+            snap = {**snap, "rows": [
+                {k: v for k, v in r.items() if k != "latency_buckets"}
+                for r in snap["rows"]
+            ]}
+            print(json.dumps(snap, indent=1))
+        else:
+            render(snap)
+        return 1 if snap["down"] else 0
+    try:
+        while True:
+            snap = fleet_snapshot(eps)
+            if args.as_json:
+                print(json.dumps(snap["total"]))
+            else:
+                render(snap, clear=True)
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,582 @@
+"""Device plane — observability BELOW the jit boundary.
+
+The rest of `gol_tpu.obs` deliberately stops at the dispatch line
+(docs/OBSERVABILITY.md's host-side-only rule): metrics, spans and
+flight notes record what the HOST did, never what XLA compiled or what
+HBM holds. That rule is correct — instrumentation inside a trace would
+record once per compile, not per step — but it left the questions the
+perf roadmap keeps asking unanswerable from the live endpoints: what
+did this run compile and why, what does one dispatch cost in FLOPs and
+bytes, how close is the board (or a session bucket) to OOM, and how
+much of a dispatch's wall time was device work vs host overhead.
+
+This module answers them WITHOUT breaking the rule: every hook here
+fires at a dispatch/compile boundary on the host —
+
+- **compile watcher** (`install_compile_watcher`): a
+  `jax.monitoring` duration listener that turns every backend compile
+  into a metric + a `device.compile` span + a flight note, attributed
+  to the CAUSE the dispatching layer declared via the `cause(...)`
+  context manager (bucket growth, a diff-chunk cap change, warm-up —
+  the recompile lint's runtime twin: the lint proves shipped code
+  cannot recompile per call, the watcher shows what actually compiled
+  and what it cost in wall time);
+- **cost analysis** (`cost_of` / `publish_cost`): FLOPs / bytes
+  accessed / peak temp bytes of a program via
+  `lower().compile().cost_analysis()` — an explicit AOT compile, so
+  callers opt in at known points (engine startup, bucket creation,
+  bench lanes) instead of taxing the hot path;
+- **memory census** (`memory_census` / `observe_memory`): live device
+  buffer count/bytes (`jax.live_arrays`), per-device allocator stats
+  where the backend exposes them (TPU `memory_stats`), and an
+  **HBM/live-buffer watermark** gauge — the peak footprint this
+  process ever observed;
+- **fits()**: a capacity estimator turning the census + the board/
+  bucket arithmetic into "will this geometry fit / how many sessions
+  can this bucket hold before OOM" answers;
+- **dispatch split** (`observe_split`): per-dispatch device-vs-host
+  time split histograms, attributed at the block-until-ready
+  boundaries the engine already crosses (enqueue = the dispatch call
+  returning, sync = the fetched buffers materialising on host, host =
+  decode + event fan-out) — no new realizations, no observer tax;
+- **profiler driver** (`start_profile` / `stop_profile`): the opt-in
+  `--profile-dir` path that wraps `jax.profiler.start_trace` and links
+  the capture directory from the trace metadata so `obs.report merge`
+  can point a post-mortem at the full XLA capture.
+
+jax imports are lazy (inside functions): importing this module costs
+nothing and works in processes that never touch the device. Everything
+follows the registry's enablement (`GOL_TPU_METRICS=0` silences the
+whole plane).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Optional
+
+import importlib
+
+from gol_tpu import obs
+from gol_tpu.obs import flight, tracing
+
+# Live module object — the twin of tracing.py's note (the package
+# __init__ shadows the submodule attribute with a function).
+_registry = importlib.import_module("gol_tpu.obs.registry")
+
+__all__ = [
+    "cause",
+    "cost_of",
+    "cost_probes_enabled",
+    "current_cause",
+    "device_budget",
+    "enable_cost_probes",
+    "fits",
+    "install_compile_watcher",
+    "memory_census",
+    "observe_memory",
+    "observe_split",
+    "plane_delta",
+    "plane_snapshot",
+    "publish_cost",
+    "start_profile",
+    "stop_profile",
+]
+
+#: The jax.monitoring key one backend compile fires exactly once.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: Bounded cause vocabulary — causes are metric LABELS, so free-form
+#: strings would unbound the registry. Layers declare one of these via
+#: `cause(...)`; anything else lands under its own string at the
+#: caller's risk (the shipped callers below use only these).
+CAUSE_UNATTRIBUTED = "unattributed"
+
+_cause_stack = threading.local()
+
+
+@contextlib.contextmanager
+def cause(label: str):
+    """Declare WHY any compile fired inside this block (thread-local,
+    nestable — innermost wins). The compile watcher stamps the label
+    onto the metric, the span and the flight note, so a post-mortem
+    reads 'bucket-grow recompiled for 1.8s at 14:02' instead of a bare
+    compile count."""
+    stack = getattr(_cause_stack, "stack", None)
+    if stack is None:
+        stack = _cause_stack.stack = []
+    stack.append(str(label))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def current_cause() -> str:
+    stack = getattr(_cause_stack, "stack", None)
+    return stack[-1] if stack else CAUSE_UNATTRIBUTED
+
+
+class _DeviceMetrics:
+    """Registry handles, resolved once at import (stdlib-only — the
+    registry neither knows nor cares that this plane watches jax)."""
+
+    def __init__(self):
+        self.compile_seconds = obs.histogram(
+            "gol_tpu_device_compile_seconds",
+            "Backend (XLA) compile wall seconds per compilation",
+        )
+        self._compiles: dict = {}
+        phases = ("enqueue", "sync", "host")
+        self.split_seconds = {
+            p: obs.histogram(
+                "gol_tpu_device_dispatch_split_seconds",
+                "Per-dispatch wall seconds split at the block-until-"
+                "ready boundaries: enqueue (dispatch call returning), "
+                "sync (fetched buffers materialising = device work + "
+                "transfer), host (decode + event fan-out)",
+                {"phase": p},
+            ) for p in phases
+        }
+        self.device_fraction = obs.gauge(
+            "gol_tpu_device_fraction",
+            "Last fully-split dispatch's sync share of its wall time "
+            "(device work + transfer over enqueue+sync+host)",
+        )
+        self.live_buffers = obs.gauge(
+            "gol_tpu_device_live_buffers",
+            "Live device arrays at the last census",
+        )
+        self.live_bytes = obs.gauge(
+            "gol_tpu_device_live_bytes",
+            "Bytes held by live device arrays at the last census",
+        )
+        self.watermark = obs.gauge(
+            "gol_tpu_device_hbm_watermark_bytes",
+            "Peak device-memory footprint this process observed "
+            "(allocator bytes_in_use where the backend reports it, "
+            "live-array bytes otherwise)",
+        )
+
+    def compiles(self, cause_label: str):
+        c = self._compiles.get(cause_label)
+        if c is None:
+            c = self._compiles[cause_label] = obs.counter(
+                "gol_tpu_device_compiles_total",
+                "Backend (XLA) compilations by declared cause",
+                {"cause": cause_label},
+            )
+        return c
+
+
+_METRICS = _DeviceMetrics()
+
+_WATCHER_INSTALLED = False
+
+
+def install_compile_watcher() -> bool:
+    """Register the jax.monitoring listener that records every backend
+    compile (count by cause, duration histogram, `device.compile` span,
+    flight note). Idempotent; returns False where jax.monitoring is
+    unavailable. The listener itself is host-side code running at
+    compile time — exactly a dispatch boundary, never inside a trace —
+    and no-ops behind the registry flag when the plane is disabled."""
+    global _WATCHER_INSTALLED
+    if _WATCHER_INSTALLED:
+        return True
+    try:
+        import jax.monitoring as mon
+    except Exception:
+        return False
+    mon.register_event_duration_secs_listener(_on_event_duration)
+    _WATCHER_INSTALLED = True
+    return True
+
+
+def _on_event_duration(name: str, dur: float, **kw) -> None:
+    if name != _COMPILE_EVENT or not _registry._ENABLED:
+        return
+    why = current_cause()
+    _METRICS.compiles(why).inc()
+    _METRICS.compile_seconds.observe(dur)
+    tracing.add_span("device.compile", "device", time.time() - dur, dur,
+                     {"cause": why})
+    flight.note("device.compile", cause=why, seconds=round(dur, 4))
+
+
+# --- cost analysis -------------------------------------------------------
+
+#: Auto cost probes (one small AOT compile per engine/bucket) are a
+#: REAL-RUN concern: the CLI enables them so a live `/metrics` carries
+#: the cost model, while library embedders and the test suite — which
+#: build hundreds of engines and would pay a compile each — default
+#: off. Explicit `cost_of`/`publish_cost` calls always work.
+_COST_PROBES = False
+
+
+def enable_cost_probes(on: bool = True) -> None:
+    global _COST_PROBES
+    _COST_PROBES = bool(on)
+
+
+def cost_probes_enabled() -> bool:
+    return _COST_PROBES and _registry._ENABLED
+
+
+def cost_of(fn: Callable, *args, **kw) -> dict:
+    """FLOPs / bytes of one call of `fn(*args)` from the compiled
+    executable's own cost model (`lower().compile().cost_analysis()` +
+    `memory_analysis()`). `fn` may be jitted or plain-traceable (a
+    plain callable is wrapped in jax.jit; an already-jitted inner fn
+    inlines). This performs a REAL ahead-of-time compile — call it at
+    known cold points (engine startup, bucket creation, bench lanes),
+    never per dispatch. Returns {"error": ...} instead of raising: the
+    estimate is advisory and must never kill the run it describes."""
+    try:
+        import jax
+
+        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        with cause("cost-analysis"):
+            compiled = jitted.lower(*args, **kw).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        out = {
+            "flops": float(cost.get("flops", 0.0)),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+        }
+        mem = None
+        with contextlib.suppress(Exception):
+            mem = compiled.memory_analysis()
+        if mem is not None:
+            out["argument_bytes"] = int(mem.argument_size_in_bytes)
+            out["output_bytes"] = int(mem.output_size_in_bytes)
+            out["temp_bytes"] = int(mem.temp_size_in_bytes)
+            out["generated_code_bytes"] = int(
+                mem.generated_code_size_in_bytes
+            )
+        return out
+    except Exception as e:
+        return {"error": repr(e)}
+
+
+def publish_cost(program: str, fn: Callable, *args, **kw) -> dict:
+    """`cost_of`, exported: the FLOPs/bytes land as labeled gauges
+    (`gol_tpu_device_cost_{flops,bytes_accessed}{program=...}`) so a
+    live `/metrics` scrape carries the cost model of the programs this
+    process runs, plus a trace event + flight note with the full
+    numbers. `program` must come from a BOUNDED vocabulary (shipped:
+    "engine.step", "bucket.step") — it is a label."""
+    if not _registry._ENABLED:
+        return {}
+    out = cost_of(fn, *args, **kw)
+    if "error" not in out:
+        obs.gauge(
+            "gol_tpu_device_cost_flops",
+            "cost_analysis FLOPs per call of the named program",
+            {"program": program},
+        ).set(out["flops"])
+        obs.gauge(
+            "gol_tpu_device_cost_bytes_accessed",
+            "cost_analysis bytes accessed per call of the named program",
+            {"program": program},
+        ).set(out["bytes_accessed"])
+    tracing.event("device.cost", "device", program=program, **{
+        k: v for k, v in out.items() if not isinstance(v, str)
+    })
+    flight.note("device.cost", program=program, **out)
+    return out
+
+
+# --- memory census -------------------------------------------------------
+
+_census_lock = threading.Lock()
+_last_census = 0.0
+_peak_bytes = 0.0
+
+
+def memory_census() -> dict:
+    """One census of device memory, host-side only: live jax arrays
+    (count + summed nbytes), per-device allocator stats where the
+    backend reports them (TPU; CPU returns none), and the process-peak
+    watermark. Updates the gauges and returns the numbers."""
+    global _peak_bytes
+    import jax
+
+    arrs = jax.live_arrays()
+    live_bytes = 0
+    for a in arrs:
+        with contextlib.suppress(Exception):
+            live_bytes += int(a.nbytes)
+    per_device = {}
+    in_use = None
+    limit = None
+    for d in jax.devices():
+        ms = None
+        with contextlib.suppress(Exception):
+            ms = d.memory_stats()
+        if ms:
+            per_device[str(d)] = {
+                k: ms[k] for k in ("bytes_in_use", "peak_bytes_in_use",
+                                   "bytes_limit") if k in ms
+            }
+            in_use = (in_use or 0) + int(ms.get("bytes_in_use", 0))
+            if "bytes_limit" in ms:
+                limit = (limit or 0) + int(ms["bytes_limit"])
+    footprint = in_use if in_use is not None else live_bytes
+    with _census_lock:
+        _peak_bytes = max(_peak_bytes, float(footprint))
+        peak = _peak_bytes
+    if _registry._ENABLED:
+        _METRICS.live_buffers.set(len(arrs))
+        _METRICS.live_bytes.set(live_bytes)
+        _METRICS.watermark.set(peak)
+    return {
+        "live_buffers": len(arrs),
+        "live_bytes": live_bytes,
+        "bytes_in_use": in_use,
+        "bytes_limit": limit,
+        "watermark_bytes": peak,
+        "per_device": per_device,
+    }
+
+
+def observe_memory(min_interval: float = 0.5) -> None:
+    """Rate-limited census for dispatch boundaries: the engine and the
+    session manager call this once per committed dispatch; the census
+    itself (a live-arrays walk) runs at most every `min_interval`
+    seconds, so a 10k-dispatch/s fused run pays one attribute read per
+    dispatch and two censuses per second."""
+    global _last_census
+    if not _registry._ENABLED:
+        return
+    now = time.monotonic()
+    if now - _last_census < min_interval:
+        return
+    _last_census = now
+    with contextlib.suppress(Exception):
+        memory_census()
+
+
+# --- capacity estimation -------------------------------------------------
+
+
+def device_budget() -> Optional[int]:
+    """Device-memory budget in bytes: the GOL_TPU_DEVICE_BUDGET_BYTES
+    override when set (explicit operator intent always wins), else the
+    allocator's bytes_limit where the backend reports one (TPU), else
+    None (unknown — CPU test meshes have no meaningful ceiling, and
+    fits() answers None rather than inventing one)."""
+    import os
+
+    env = os.environ.get("GOL_TPU_DEVICE_BUDGET_BYTES")
+    if env:
+        with contextlib.suppress(ValueError):
+            return int(env)
+    try:
+        import jax
+
+        limit = 0
+        for d in jax.devices():
+            ms = None
+            with contextlib.suppress(Exception):
+                ms = d.memory_stats()
+            if not ms or "bytes_limit" not in ms:
+                return None
+            limit += int(ms["bytes_limit"])
+        return limit or None
+    except Exception:
+        return None
+
+
+#: Working-set multiple over one board's bytes: the scanned diff paths
+#: keep the carry board, the new board and the stacked per-turn output
+#: alive at once; 3x is the boards' own share (the diff STACK is priced
+#: separately — it is chunk-bounded by DIFF_STACK_BUDGET already).
+_BOARD_WORKING_SET = 3
+
+
+def fits(height: int, width: int, *, sessions: int = 1,
+         packed: Optional[bool] = None,
+         diff_stack_bytes: Optional[int] = None) -> dict:
+    """Will this geometry fit device memory — and how far can it grow?
+
+    Pure arithmetic over the census and the board layout (never a
+    device call): one packed board is H/32 * W * 4 bytes (the bitlife
+    word layout), a dense one H * W; a bucket of S sessions stacks S of
+    them; the working set holds ~3 boards' worth (carry + result +
+    stacked diffs' board share) plus the engine's bounded diff-stack
+    budget when the caller prices a watched run (`diff_stack_bytes`,
+    e.g. engine.DIFF_STACK_BUDGET).
+
+    Returns board_bytes / bucket_bytes / estimated working set,
+    `budget_bytes` (None when the backend reports no ceiling — then
+    `fits` is None, not a guess), the estimated `max_sessions` this
+    geometry could stack before OOM, and `max_board_side` — the
+    largest square single board the budget admits."""
+    if height <= 0 or width <= 0 or sessions < 1:
+        raise ValueError("need positive geometry and sessions >= 1")
+    if packed is None:
+        from gol_tpu.ops.bitlife import packable
+
+        packed = packable(height, width)
+    board = (height // 32) * width * 4 if packed else height * width
+    bucket = board * sessions
+    need = bucket * _BOARD_WORKING_SET + (diff_stack_bytes or 0)
+    budget = device_budget()
+    out = {
+        "height": height,
+        "width": width,
+        "sessions": sessions,
+        "packed": bool(packed),
+        "board_bytes": board,
+        "bucket_bytes": bucket,
+        "working_set_bytes": need,
+        "budget_bytes": budget,
+        "fits": None,
+        "max_sessions": None,
+        "max_board_side": None,
+    }
+    if budget is None:
+        return out
+    usable = budget - (diff_stack_bytes or 0)
+    out["fits"] = need <= budget
+    out["headroom_bytes"] = budget - need
+    if board > 0 and usable > 0:
+        out["max_sessions"] = max(
+            0, usable // (board * _BOARD_WORKING_SET)
+        )
+    # Largest square single board: bytes/cell is 1/8 packed (uint32
+    # words of 32 cells), 1 dense; side rounded down to the packed
+    # layout's 32-row granularity so the answer is actually buildable.
+    per_cell = 0.125 if packed else 1.0
+    if usable > 0:
+        side = int((usable / (_BOARD_WORKING_SET * per_cell)) ** 0.5)
+        out["max_board_side"] = side // 32 * 32 if packed else side
+    return out
+
+
+# --- dispatch split ------------------------------------------------------
+
+
+def observe_split(enqueue_s: Optional[float] = None,
+                  sync_s: Optional[float] = None,
+                  host_s: Optional[float] = None) -> None:
+    """Record one dispatch's device-vs-host time split. The phases are
+    the boundaries the engine already crosses (no added realizations):
+    `enqueue` = the dispatch call returning (host overhead to launch),
+    `sync` = the fetched result materialising on host (device work +
+    transfer — the block-until-ready boundary), `host` = decode +
+    event fan-out. Fused chunks report enqueue only (nothing is
+    fetched per chunk); diff chunks report all three, and the fraction
+    gauge tracks the last fully-split dispatch."""
+    if not _registry._ENABLED:
+        return
+    if enqueue_s is not None:
+        _METRICS.split_seconds["enqueue"].observe(enqueue_s)
+    if sync_s is not None:
+        _METRICS.split_seconds["sync"].observe(sync_s)
+    if host_s is not None:
+        _METRICS.split_seconds["host"].observe(host_s)
+    if enqueue_s is not None and sync_s is not None and host_s is not None:
+        total = enqueue_s + sync_s + host_s
+        if total > 0:
+            _METRICS.device_fraction.set(round(sync_s / total, 5))
+
+
+# --- bench snapshots -----------------------------------------------------
+
+
+def plane_snapshot() -> dict:
+    """The device plane's accumulated totals as one JSON-able dict —
+    what bench.py embeds per lane (via `plane_delta`) and as the run
+    total. Reads only registry handles and the census gauges."""
+    compiles = {
+        c: int(m.value) for c, m in _METRICS._compiles.items()
+    }
+    split = {
+        p: {"count": h.count, "seconds": round(h.sum, 4)}
+        for p, h in _METRICS.split_seconds.items()
+    }
+    return {
+        "compiles": compiles,
+        "compiles_total": sum(compiles.values()),
+        "compile_seconds": round(_METRICS.compile_seconds.sum, 4),
+        "split": split,
+        "device_fraction": _METRICS.device_fraction.value,
+        "live_buffers": int(_METRICS.live_buffers.value),
+        "live_bytes": int(_METRICS.live_bytes.value),
+        "hbm_watermark_bytes": int(_METRICS.watermark.value),
+    }
+
+
+def plane_delta(before: dict) -> dict:
+    """What one bench lane did to the device plane: compile count/
+    seconds and split deltas vs a `plane_snapshot()` taken before the
+    lane, plus the current (peak-inclusive) census values."""
+    now = plane_snapshot()
+    out = {
+        "compiles": now["compiles_total"] - before.get("compiles_total", 0),
+        "compile_seconds": round(
+            now["compile_seconds"] - before.get("compile_seconds", 0.0), 4
+        ),
+        "hbm_watermark_bytes": now["hbm_watermark_bytes"],
+        "live_bytes": now["live_bytes"],
+    }
+    split = {}
+    for p, v in now["split"].items():
+        b = before.get("split", {}).get(p, {})
+        dc = v["count"] - b.get("count", 0)
+        ds = round(v["seconds"] - b.get("seconds", 0.0), 4)
+        if dc:
+            split[p] = {"count": dc, "seconds": ds}
+    if split:
+        out["split"] = split
+    return out
+
+
+# --- profiler driver (--profile-dir) -------------------------------------
+
+_profile_dir: Optional[str] = None
+
+
+def start_profile(directory: str) -> bool:
+    """Start a `jax.profiler` capture into `directory` (the CLI's
+    opt-in `--profile-dir`): the full XLA/device trace, linkable from
+    Perfetto. The directory is recorded in the span tracer's export
+    metadata so a merged report names the capture next to the
+    host-side timeline. Registers an atexit stop so the capture is
+    flushed even on unusual exits; returns False when the profiler is
+    unavailable."""
+    global _profile_dir
+    if _profile_dir is not None:
+        return True
+    try:
+        import atexit
+
+        import jax
+
+        jax.profiler.start_trace(directory)
+    except Exception as e:
+        flight.note("device.profile_failed", error=repr(e))
+        return False
+    _profile_dir = str(directory)
+    tracing.set_metadata("profile_dir", _profile_dir)
+    tracing.event("device.profile", "device", dir=_profile_dir)
+    flight.note("device.profile", dir=_profile_dir)
+    atexit.register(stop_profile)
+    return True
+
+
+def stop_profile() -> None:
+    """Flush and stop the capture; idempotent."""
+    global _profile_dir
+    if _profile_dir is None:
+        return
+    _profile_dir = None
+    with contextlib.suppress(Exception):
+        import jax
+
+        jax.profiler.stop_trace()

@@ -59,6 +59,8 @@ __all__ = [
     "exponential_buckets",
     "gauge",
     "histogram",
+    "merge_cumulative_buckets",
+    "quantile_from_buckets",
     "registry",
     "remove",
     "set_enabled",
@@ -288,6 +290,91 @@ class Histogram(_Metric):
                 "count": self._count,
             }
 
+    def cumulative_buckets(self) -> list:
+        """[(upper_bound, cumulative_count)] incl. the +Inf bucket —
+        the exposition's `le` view, as data (quantile input)."""
+        with self._lock:
+            counts = list(self._counts)
+        out, cum = [], 0
+        for b, n in zip(self.bounds, counts):
+            cum += n
+            out.append((b, cum))
+        out.append((float("inf"), cum + counts[-1]))
+        return out
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Prometheus-style histogram_quantile over this histogram's
+        own buckets (linear interpolation inside the landing bucket).
+        None on an empty histogram."""
+        return quantile_from_buckets(self.cumulative_buckets(), q)
+
+
+def quantile_from_buckets(buckets, q: float) -> Optional[float]:
+    """`histogram_quantile` over cumulative `le` buckets: `buckets` is
+    [(upper_bound, cumulative_count), ...] sorted by bound, +Inf last
+    (exactly `Histogram.cumulative_buckets()`, or what a scraper
+    reassembles from `<name>_bucket{le=...}` series — the ONE shared
+    quantile the console, the bench capture and the tests all use, so
+    the numbers cannot drift between surfaces).
+
+    Prometheus semantics: the target rank is q * total observations;
+    the answer interpolates linearly inside the first bucket whose
+    cumulative count reaches it (lower edge 0 for the first bucket). A
+    rank landing in the +Inf bucket returns the highest finite bound —
+    the histogram cannot resolve beyond it. None on an empty histogram;
+    q outside [0, 1] raises."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0
+    saw_finite = False
+    for bound, cum in buckets:
+        if bound == float("inf"):
+            break
+        saw_finite = True
+        if cum >= rank:
+            frac = (0.0 if cum == prev_cum
+                    else (rank - prev_cum) / (cum - prev_cum))
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_cum = bound, cum
+    # Rank lands in the +Inf bucket: the highest finite bound is the
+    # most the histogram can resolve (Prometheus does the same).
+    return prev_bound if saw_finite else None
+
+
+def merge_cumulative_buckets(bucket_lists) -> list:
+    """Sum several cumulative-bucket lists (same-name histograms from
+    N registries/endpoints or N label sets) into one — fleet-wide
+    percentiles. Bounds need not match: the union grid is used, each
+    input contributing its cumulative count at every bound at or past
+    its own (cumulative counts are monotone step functions, so the sum
+    at a bound between two of an input's bounds is the lower one —
+    exact, no interpolation)."""
+    lists = [b for b in bucket_lists if b]
+    if not lists:
+        return []
+    bounds = sorted({b for lst in lists for b, _ in lst})
+    out = []
+    for bound in bounds:
+        cum = 0
+        for lst in lists:
+            at = 0
+            for b, c in lst:
+                if b <= bound:
+                    at = c
+                else:
+                    break
+            cum += at
+        out.append((bound, cum))
+    if not out or out[-1][0] != float("inf"):
+        out.append((float("inf"), sum(lst[-1][1] for lst in lists)))
+    return out
+
 
 class Registry:
     """Get-or-create metric store with Prometheus-text and JSON
@@ -340,6 +427,25 @@ class Registry:
     def metrics(self) -> list:
         with self._lock:
             return list(self._metrics.values())
+
+    def percentiles(self, name: str, qs: Sequence[float] = (0.5, 0.95, 0.99)
+                    ) -> Optional[dict]:
+        """{p50: v, p95: v, ...} over EVERY labeled series of the named
+        histogram family merged into one distribution (an endpoint's
+        per-label children are one population to an operator). None
+        when the family is absent or empty."""
+        lists = [m.cumulative_buckets() for m in self.metrics()
+                 if m.name == name and isinstance(m, Histogram)]
+        if not lists:
+            return None
+        merged = merge_cumulative_buckets(lists)
+        out = {}
+        for q in qs:
+            v = quantile_from_buckets(merged, q)
+            if v is None:
+                return None
+            out[f"p{q * 100:g}"] = round(v, 6)
+        return out
 
     # -- exposition --
 

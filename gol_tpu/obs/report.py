@@ -65,6 +65,11 @@ def merge_traces(dumps: list, labels: Optional[list] = None) -> dict:
             or meta.get("process_label") or f"proc{i}"
         offsets[str(pid)] = {"label": label, "source_pid": orig_pid,
                              "clock_offset_seconds": off_us / 1e6}
+        if meta.get("profile_dir"):
+            # The device plane's --profile-dir capture: name it next to
+            # the merged timeline so the post-mortem links to the full
+            # XLA trace.
+            offsets[str(pid)]["profile_dir"] = meta["profile_dir"]
         seen_name = False
         for ev in dump.get("traceEvents", []):
             ev = dict(ev)
@@ -110,7 +115,7 @@ def turn_pairs(merged: dict) -> dict:
 
 def _cmd_merge(args) -> int:
     dumps = [load_trace(p) for p in args.paths]
-    merged = merge_traces(dumps)
+    merged = merge_traces(dumps, labels=args.label)
     out = json.dumps(merged, indent=1)
     if args.output:
         with open(args.output, "w") as f:
@@ -121,6 +126,10 @@ def _cmd_merge(args) -> int:
         print(f"merged {len(args.paths)} dumps -> {args.output} "
               f"({len(merged['traceEvents'])} events, "
               f"{matched} turns matched emit<->apply)")
+        for pid, info in merged["metadata"]["merged_from"].items():
+            if info.get("profile_dir"):
+                print(f"  {info['label']}: jax profiler capture at "
+                      f"{info['profile_dir']}")
     else:
         sys.stdout.write(out + "\n")
     return 0
@@ -275,6 +284,11 @@ def main(argv: Optional[list] = None) -> int:
                          "own metadata)")
     mp.add_argument("-o", "--output", default=None,
                     help="write the merged trace here (default stdout)")
+    mp.add_argument("-l", "--label", action="append", default=None,
+                    metavar="NAME",
+                    help="override process labels, in input order "
+                         "(repeatable — useful when merging N relays "
+                         "that all call themselves 'connect')")
     mp.set_defaults(fn=_cmd_merge)
     rp = sub.add_parser("render", help="human post-mortem of a "
                                        "flight-recorder dump")

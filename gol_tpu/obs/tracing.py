@@ -67,6 +67,7 @@ __all__ = [
     "clock_offset",
     "event",
     "set_clock_offset",
+    "set_metadata",
     "set_process_label",
     "span",
     "trace_payload",
@@ -142,6 +143,10 @@ class Tracer:
         #: Human label for this process in merged timelines
         #: ("serve" / "connect" / "local" — the CLI sets it).
         self.process_label: str = ""
+        #: Extra metadata keys carried verbatim in the export (e.g.
+        #: the device plane's profile-capture directory) — merged
+        #: reports surface them next to the timeline.
+        self.extra_metadata: dict = {}
 
     # -- writers (hot path) --
 
@@ -242,6 +247,7 @@ class Tracer:
                 "recorded": self._recorded,
                 "dropped": self.dropped,
                 "dumped_at": time.time(),
+                **self.extra_metadata,
             },
         }
 
@@ -279,6 +285,12 @@ def clock_offset() -> Optional[float]:
 
 def set_process_label(label: str) -> None:
     TRACER.process_label = str(label)
+
+
+def set_metadata(key: str, value) -> None:
+    """Attach one JSON-able key to the export metadata (e.g. the
+    --profile-dir capture path, so merged reports can link it)."""
+    TRACER.extra_metadata[str(key)] = value
 
 
 def trace_payload() -> dict:

@@ -978,6 +978,10 @@ def instrument_stepper(s: Stepper) -> Stepper:
 
     from gol_tpu import obs
     from gol_tpu.obs import tracing
+    # Aliased: this module's builders take a `device` PARAMETER, and
+    # the obs-in-jit checker treats every binding of an obs-imported
+    # name as obs-rooted (name-based on purpose).
+    from gol_tpu.obs import device as obs_device
 
     backend = {"backend": s.name}
     dispatches = {}
@@ -1039,6 +1043,35 @@ def instrument_stepper(s: Stepper) -> Stepper:
 
         return wrapper
 
+    # One cost-model probe per instrumented stepper (CLI-enabled —
+    # device.enable_cost_probes): the FIRST `put` publishes the
+    # one-turn step program's cost_analysis as gol_tpu_device_cost_*
+    # gauges. Probed on the BARE stepper's step (the wrapped entries
+    # would drag instrumentation, and the invariant checker's identity
+    # state, through the trace), and at PUT time on purpose: the probe
+    # is a real AOT compile, and running it inside a dispatch wrapper
+    # would land compile seconds in the engine's enqueue-split and
+    # first-dispatch latency measurements.
+    probed = []
+
+    def _maybe_cost_probe(world) -> None:
+        if probed or not obs_device.cost_probes_enabled():
+            return
+        probed.append(True)
+        if jax.process_count() > 1:
+            # The SPMD mirror's entries broadcast opcodes to worker
+            # processes as a side effect — tracing one for an AOT
+            # compile would desync the job for an advisory number.
+            return
+        obs_device.publish_cost("engine.step", s.step, world)
+
+    _timed_put = timed("put", s.put)
+
+    def put(host_world):
+        out = _timed_put(host_world)
+        _maybe_cost_probe(out)
+        return out
+
     def step_n(world, k):
         dispatches["step_n"].inc()
         cost = _charge_halo(world, int(k), False)
@@ -1050,6 +1083,9 @@ def instrument_stepper(s: Stepper) -> Stepper:
         if s.halo_cost is not None:
             halo_seconds.observe(dt)
         _span("step_n", wall0, dt, cost)
+        # Memory census at the dispatch boundary (rate-limited inside):
+        # the HBM/live-buffer watermark tracks every dispatching run.
+        obs_device.observe_memory()
         return out
 
     def _diffy(entry, fn):
@@ -1062,6 +1098,7 @@ def instrument_stepper(s: Stepper) -> Stepper:
             dt = time.perf_counter() - t0
             seconds[entry].observe(dt)
             _span(entry, wall0, dt, cost)
+            obs_device.observe_memory()
             return out
 
         return wrapper
@@ -1082,7 +1119,7 @@ def instrument_stepper(s: Stepper) -> Stepper:
 
     return dataclasses.replace(
         s,
-        put=timed("put", s.put),
+        put=put,
         fetch=timed("fetch", s.fetch),
         step=_one_turn("step", s.step),
         step_n=step_n,

@@ -24,7 +24,7 @@ import numpy as np
 
 from gol_tpu import obs
 from gol_tpu.models.rules import GenRule, LIFE, Rule, get_rule
-from gol_tpu.obs import flight, tracing
+from gol_tpu.obs import device, flight, tracing
 
 #: Session ids are path components (checkpoints live under
 #: out/sessions/<id>/) and metric label values — one conservative
@@ -202,16 +202,24 @@ class _Bucket:
     stacked device state, and the slot bookkeeping."""
 
     def __init__(self, height: int, width: int, rule: Rule,
-                 capacity: int, device=None):
+                 capacity: int, dev=None):
         from gol_tpu.parallel.stepper import make_batch_stepper
 
         self.height, self.width, self.rule = height, width, rule
         self.key = f"{width}x{height}/{rule}"
-        self.device = device
-        self.bs = make_batch_stepper(capacity, height, width, rule,
-                                     device)
-        zero = np.zeros((height, width), np.uint8)
-        self.stack = self.bs.put_all([zero] * capacity)
+        self.device = dev
+        # Compiles fired while a bucket is built/warmed are attributed
+        # to it on the device plane (the compile watcher's cause).
+        with device.cause("bucket-new"):
+            self.bs = make_batch_stepper(capacity, height, width, rule,
+                                         dev)
+            zero = np.zeros((height, width), np.uint8)
+            self.stack = self.bs.put_all([zero] * capacity)
+        if device.cost_probes_enabled():
+            device.publish_cost(
+                "bucket.step",
+                lambda st: self.bs.step_n(st, 1)[0], self.stack,
+            )
         #: Free slots, lowest first (pop from the end).
         self.free = list(range(capacity - 1, -1, -1))
         self.sessions: "dict[int, Session]" = {}   # slot -> Session
@@ -549,11 +557,12 @@ class SessionManager:
 
         old_cap = b.bs.capacity
         new_cap = old_cap * 2
-        boards = [b.bs.fetch_one(b.stack, i) for i in range(old_cap)]
-        boards += [np.zeros((b.height, b.width), np.uint8)] * old_cap
-        b.bs = make_batch_stepper(new_cap, b.height, b.width, b.rule,
-                                  b.device)
-        b.stack = b.bs.put_all(boards)
+        with device.cause("bucket-grow"):
+            boards = [b.bs.fetch_one(b.stack, i) for i in range(old_cap)]
+            boards += [np.zeros((b.height, b.width), np.uint8)] * old_cap
+            b.bs = make_batch_stepper(new_cap, b.height, b.width, b.rule,
+                                      b.device)
+            b.stack = b.bs.put_all(boards)
         b.free = list(range(new_cap - 1, old_cap - 1, -1)) + b.free
         _METRICS.bucket_grows.inc()
         tracing.event("session.bucket_grow", "lifecycle", bucket=b.key,
@@ -775,9 +784,12 @@ class SessionManager:
         t0 = time.perf_counter()
         wall0 = time.time()
         if b.flip_watched():
-            path = self._dispatch_diffs(b, k)
+            with device.cause("bucket-dispatch"):
+                path = self._dispatch_diffs(b, k)
         else:
-            b.stack, _counts = b.bs.step_n(b.stack, k)
+            with device.cause("bucket-dispatch"):
+                b.stack, _counts = b.bs.step_n(b.stack, k)
+            device.observe_split(enqueue_s=time.perf_counter() - t0)
             path = "fused"
             self._commit(b, k)
             if b.watched():
@@ -819,9 +831,12 @@ class SessionManager:
         if b.compact_cap is not None:
             path = "compact"
             total_cap = k * b.compact_cap
+            enq0 = time.perf_counter()
             stack, headers, values, counts = (
                 b.bs.step_n_with_diffs_compact(b.stack, k, total_cap)
             )
+            enq_s = time.perf_counter() - enq0
+            sync0 = time.perf_counter()
             hdr = np.ascontiguousarray(np.asarray(headers)).view(np.uint32)
             totals = hdr[:, :, 0].sum(axis=1)
             if totals.size and int(totals.max()) > total_cap:
@@ -843,8 +858,10 @@ class SessionManager:
             vals = np.ascontiguousarray(
                 np.asarray(values[:, :n])
             ).view(np.uint32)
+            sync_s = time.perf_counter() - sync0
             b.stack = stack
             self._commit(b, k)
+            host0 = time.perf_counter()
             rows_by_slot = {}
             peak = 0
             for slot, s in b.sessions.items():
@@ -856,10 +873,15 @@ class SessionManager:
                     ))
             b.adapt_cap(peak)
         else:
+            enq0 = time.perf_counter()
             stack, diffs, counts = b.bs.step_n_with_diffs(b.stack, k)
+            enq_s = time.perf_counter() - enq0
+            sync0 = time.perf_counter()
             host = np.asarray(diffs)
+            sync_s = time.perf_counter() - sync0
             b.stack = stack
             self._commit(b, k)
+            host0 = time.perf_counter()
             rows_by_slot = {}
             peak = 0
             for slot, s in b.sessions.items():
@@ -877,6 +899,10 @@ class SessionManager:
             if b.bs.packed:
                 b.adapt_cap(peak)
         self._emit(b, k, rows_by_slot)
+        # Device-vs-host split of this bucket dispatch (same boundaries
+        # as the singleton engine: enqueue / materialise / decode+emit).
+        device.observe_split(enq_s, sync_s,
+                             time.perf_counter() - host0)
         return path
 
     def _commit(self, b: _Bucket, k: int) -> None:
@@ -884,6 +910,9 @@ class SessionManager:
         for s in b.sessions.values():
             s.turns_metric.inc(k)
         flight.note("sessions.commit", bucket=b.key, ticks=b.ticks)
+        # BatchStepper dispatches bypass instrument_stepper, so the
+        # memory census (rate-limited inside) rides the commit.
+        device.observe_memory()
 
     def _emit(self, b: _Bucket, k: int, rows_by_slot: dict) -> None:
         """Fan one dispatched chunk out to the attached sinks, per

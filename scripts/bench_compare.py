@@ -48,7 +48,13 @@ LOWER_BETTER = re.compile(
     # (the serving plane started shedding under a load it used to
     # carry). Same for invariant violations, which must never move.
     r"|degradations|shed_frames|overflows|evicted|rejects"
-    r"|violations)", re.I
+    r"|violations"
+    # Device plane + percentile summaries (ISSUE 9): turn-latency
+    # p50/p95/p99 regress UP, and compile counts are off-zero-gated —
+    # a lane whose compile count moves off a zero baseline started
+    # recompiling mid-measurement (exactly what the recompile lint
+    # exists to prevent), which is an infinite regression here.
+    r"|\bp(?:50|95|99)$|compiles)", re.I
 )
 
 

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Merge a device-plane capture into BENCH_DETAIL.json — the bounded
+form of the full bench for containers without the TPU attached.
+
+`python bench.py` already brackets every lane with the device plane
+(`_lane`) and snapshots `metrics.device` / `metrics.percentiles`; a
+full run is hours of CPU in this container and would overwrite the TPU
+trajectory with CPU numbers. This script instead runs ONE bounded
+watched workload (a real EngineServer ⇄ Controller loopback session at
+512², the `wire_watched` shape) plus the cost probes, and merges the
+result under its own key:
+
+    BENCH_DETAIL.json["device_plane_512x512"] = {
+        "platform": ...,            # honest about the substrate
+        "compiles": {cause: n},     # compile events, cause-attributed
+        "compile_seconds": ...,
+        "cost_per_turn": {...},     # lower().compile().cost_analysis()
+        "hbm_watermark_bytes": ...,
+        "split": {enqueue/sync/host: {count, seconds}},
+        "turn_latency_percentiles": {p50, p95, p99},
+    }
+
+No existing lane is touched, so `bench_compare` against an older
+capture sees one new key, never a fake regression.
+
+Usage: python scripts/device_plane_capture.py   (CPU-safe; ~1-2 min)
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    import jax
+
+    from gol_tpu import obs
+    from gol_tpu.obs import device
+
+    device.install_compile_watcher()
+    device.enable_cost_probes()
+
+    import bench
+
+    from gol_tpu.parallel.stepper import _make_stepper
+
+    lane = bench._lane(bench.measure_wire_watched)
+    pct = obs.registry().percentiles(
+        "gol_tpu_client_turn_latency_seconds"
+    )
+    bare = _make_stepper(threads=1, height=512, width=512,
+                         devices=[jax.devices()[0]])
+    plane = device.plane_snapshot()
+    entry = {
+        "platform": jax.devices()[0].platform,
+        "board": "512x512",
+        "wire_watched": lane,
+        "compiles": plane["compiles"],
+        "compiles_total": plane["compiles_total"],
+        "compile_seconds": plane["compile_seconds"],
+        "split": plane["split"],
+        "device_fraction": plane["device_fraction"],
+        "hbm_watermark_bytes": plane["hbm_watermark_bytes"],
+        "cost_per_turn": device.cost_of(bare.step,
+                                        bare.put(bench._world(512))),
+        "turn_latency_percentiles": pct,
+    }
+    path = REPO / "BENCH_DETAIL.json"
+    detail = json.loads(path.read_text()) if path.exists() else {}
+    detail["device_plane_512x512"] = entry
+    path.write_text(json.dumps(detail, indent=2))
+    print(json.dumps(entry, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,7 +63,13 @@ for series in \
     gol_tpu_engine_compact_bytes_total \
     gol_tpu_engine_compact_redos_total \
     gol_tpu_stepper_dispatches_total \
-    gol_tpu_halo_bytes_total
+    gol_tpu_halo_bytes_total \
+    gol_tpu_device_compiles_total \
+    gol_tpu_device_compile_seconds \
+    gol_tpu_device_dispatch_split_seconds \
+    gol_tpu_device_hbm_watermark_bytes \
+    gol_tpu_device_live_bytes \
+    gol_tpu_device_cost_flops
 do
     if ! grep -q "^$series" <<<"$METRICS"; then
         echo "metrics smoke: FAILED — series $series missing from /metrics" >&2
@@ -94,16 +100,35 @@ assert sum(turns) > 0, f"engine committed no turns yet: {turns}"
 }
 
 # The span tracer: /trace must serve a Chrome-trace payload with
-# engine dispatch spans already on it. (Payloads are big: pipe them,
-# never pass as argv.)
+# engine dispatch spans already on it — and (r9) the device plane's
+# compile spans. (Payloads are big: pipe them, never pass as argv.)
 fetch "$BASE/trace" | python -c '
 import json, sys
 t = json.load(sys.stdin)
 assert t.get("enabled") is True, f"tracer not enabled: {t}"
 names = {e.get("name") for e in t["traceEvents"]}
 assert "engine.dispatch" in names, f"no engine.dispatch span: {sorted(names)[:12]}"
+assert "device.compile" in names, f"no device.compile span: {sorted(names)[:12]}"
 ' || {
-    echo "metrics smoke: FAILED — /trace has no live engine spans" >&2
+    echo "metrics smoke: FAILED — /trace has no live engine/compile spans" >&2
+    exit 1
+}
+
+# The device plane on /metrics must carry real numbers: at least one
+# compile counted, a nonzero watermark, and the cost model published.
+python -c '
+import sys
+m = sys.stdin.read()
+def val(prefix):
+    return sum(float(l.split()[-1]) for l in m.splitlines()
+               if l.startswith(prefix) and not l.startswith("#"))
+assert val("gol_tpu_device_compiles_total") > 0, "no compiles counted"
+assert val("gol_tpu_device_hbm_watermark_bytes") > 0, "watermark is zero"
+assert val("gol_tpu_device_cost_flops") > 0, "cost model not published"
+assert val("gol_tpu_device_dispatch_split_seconds_count") > 0, \
+    "no dispatch split observed"
+' <<<"$METRICS" || {
+    echo "metrics smoke: FAILED — device-plane series present but empty" >&2
     exit 1
 }
 
@@ -125,7 +150,7 @@ assert f.get("state", {}).get("completed_turns", 0) > 0, f["state"]
 
 python -m gol_tpu -noVis -t 2 -w 64 -h 64 -turns 1000000000 \
     --images fixtures/images --out "$OUT2" --platform cpu --chunk 16 \
-    --serve 127.0.0.1:0 >"$LOG2" 2>&1 &
+    --metrics-port 0 --serve 127.0.0.1:0 >"$LOG2" 2>&1 &
 PID2=$!
 for _ in $(seq 1 240); do
     grep -q '^engine serving on ' "$LOG2" && break
@@ -137,6 +162,39 @@ for _ in $(seq 1 240); do
     sleep 0.5
 done
 sleep 3   # let it commit some dispatches
+
+# The fleet console (r9): a non-interactive snapshot against the LIVE
+# --serve run's sidecar must render its row (exit 0 = endpoint up).
+BASE2=$(sed -n 's#^metrics serving on \(http://[^/]*\)/metrics$#\1#p' "$LOG2" | head -1)
+if [ -z "$BASE2" ]; then
+    echo "metrics smoke: FAILED — serve run printed no metrics address" >&2
+    cat "$LOG2" >&2
+    exit 1
+fi
+CONSOLE=$(python -m gol_tpu.obs.console --once "$BASE2") || {
+    echo "metrics smoke: FAILED — obs.console --once could not scrape $BASE2" >&2
+    exit 1
+}
+grep -q "fleet console" <<<"$CONSOLE" || {
+    echo "metrics smoke: FAILED — console rendered nothing: $CONSOLE" >&2
+    exit 1
+}
+grep -q "DOWN" <<<"$CONSOLE" && {
+    echo "metrics smoke: FAILED — console shows the live server DOWN:" >&2
+    echo "$CONSOLE" >&2
+    exit 1
+}
+python -m gol_tpu.obs.console --once --json "$BASE2" | python -c '
+import json, sys
+snap = json.load(sys.stdin)
+assert snap["total"]["up"] == 1, snap
+row = snap["rows"][0]
+assert row["up"] and row.get("compiles", 0) > 0, row
+' || {
+    echo "metrics smoke: FAILED — console --json snapshot inconsistent" >&2
+    exit 1
+}
+
 kill -TERM "$PID2"
 for _ in $(seq 1 60); do
     kill -0 "$PID2" 2>/dev/null || break
@@ -165,4 +223,6 @@ python -m gol_tpu.obs.report render "$DUMP" >/dev/null || {
 }
 
 echo "metrics smoke: OK ($BASE — /metrics, /healthz, /vars, /trace,"
-echo "  /flightrecorder all live; SIGTERM dump at $DUMP renders clean)"
+echo "  /flightrecorder all live; device plane carries compiles/cost/"
+echo "  watermark/split; obs.console --once rendered $BASE2;"
+echo "  SIGTERM dump at $DUMP renders clean)"

@@ -1,0 +1,151 @@
+"""gol_tpu.obs.console tests — the fleet plane: Prometheus text
+parsing, histogram reassembly, live scrapes against a real
+MetricsServer, rate computation between scrapes, the --once CI mode,
+and fleet-total percentiles merged across endpoints."""
+
+import io
+import json
+
+import pytest
+
+from gol_tpu.obs import console
+from gol_tpu.obs.http import MetricsServer
+from gol_tpu.obs.registry import Registry, quantile_from_buckets
+
+
+# --- parsing ------------------------------------------------------------
+
+
+def test_parse_prometheus_roundtrips_registry_exposition():
+    r = Registry()
+    r.counter("c_total", "help", {"kind": "x"}).inc(3)
+    r.gauge("g").set(-2.5)
+    h = r.histogram("h_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    parsed = console.parse_prometheus(r.prometheus_text())
+    assert parsed['c_total{kind="x"}'] == 3
+    assert parsed["g"] == -2.5
+    assert parsed['h_seconds_bucket{le="0.1"}'] == 1
+    assert parsed['h_seconds_bucket{le="+Inf"}'] == 2
+    assert parsed["h_seconds_count"] == 2
+    # Comments/garbage never kill the parser.
+    assert console.parse_prometheus("# junk\nnot a line\nx 1\n") == {"x": 1}
+
+
+def test_histogram_buckets_match_registry_cumulative_view():
+    r = Registry()
+    h = r.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 2.0):
+        h.observe(v)
+    parsed = console.parse_prometheus(r.prometheus_text())
+    assert console.histogram_buckets(parsed, "lat_seconds") == \
+        h.cumulative_buckets()
+    for q in (0.5, 0.95):
+        assert quantile_from_buckets(
+            console.histogram_buckets(parsed, "lat_seconds"), q
+        ) == pytest.approx(h.quantile(q))
+
+
+def test_sum_and_max_series_across_label_sets():
+    r = Registry()
+    r.counter("t_total", labels={"kind": "a"}).inc(2)
+    r.counter("t_total", labels={"kind": "b"}).inc(5)
+    r.gauge("lag", labels={"peer": "p1"}).set(3)
+    r.gauge("lag", labels={"peer": "p2"}).set(9)
+    parsed = console.parse_prometheus(r.prometheus_text())
+    assert console.sum_series(parsed, "t_total") == 7
+    assert console.sum_series(parsed, "t_total", {"kind": "a"}) == 2
+    assert console.max_series(parsed, "lag") == 9
+    assert console.sum_series(parsed, "absent") is None
+
+
+# --- live scrapes -------------------------------------------------------
+
+
+def _fleet_registry(turns=1000, sessions=3, latencies=()):
+    r = Registry()
+    r.gauge("gol_tpu_engine_committed_turn").set(turns)
+    r.counter("gol_tpu_engine_turns_total", labels={"kind": "diffs"}).inc(
+        turns
+    )
+    r.gauge("gol_tpu_sessions_active").set(sessions)
+    r.counter("gol_tpu_device_compiles_total",
+              labels={"cause": "unattributed"}).inc(4)
+    r.gauge("gol_tpu_device_hbm_watermark_bytes").set(1 << 20)
+    h = r.histogram("gol_tpu_client_turn_latency_seconds")
+    for v in latencies:
+        h.observe(v)
+    return r
+
+
+def test_endpoint_scrape_and_rate_between_samples():
+    reg = _fleet_registry(latencies=[0.002] * 9 + [0.4])
+    srv = MetricsServer(port=0, registry=reg).start()
+    try:
+        ep = console.Endpoint(f"{srv.address[0]}:{srv.address[1]}")
+        row = ep.scrape()
+        assert row["up"] and row["turn"] == 1000
+        assert row["sessions"] == 3
+        assert row["compiles"] == 4
+        assert row["hbm_watermark_bytes"] == 1 << 20
+        assert row["turns_per_sec"] is None  # no previous sample yet
+        lat = row["latency"]
+        assert lat["p50"] < 0.01 < lat["p99"]
+        reg.counter("gol_tpu_engine_turns_total",
+                    labels={"kind": "diffs"}).inc(500)
+        row2 = ep.scrape()
+        assert row2["turns_per_sec"] is not None
+        assert row2["turns_per_sec"] > 0
+    finally:
+        srv.close()
+
+
+def test_fleet_total_merges_latency_before_percentiles():
+    """The TOTAL row's percentiles come from the MERGED buckets, so
+    one slow endpoint shows up in the fleet tail even when the fast
+    endpoint dominates the population."""
+    fast = _fleet_registry(latencies=[0.001] * 95)
+    slow = _fleet_registry(latencies=[2.0] * 5)
+    s1 = MetricsServer(port=0, registry=fast).start()
+    s2 = MetricsServer(port=0, registry=slow).start()
+    try:
+        eps = [console.Endpoint(f"127.0.0.1:{s.address[1]}")
+               for s in (s1, s2)]
+        snap = console.fleet_snapshot(eps)
+        assert snap["down"] == []
+        total = snap["total"]
+        assert total["up"] == 2
+        assert total["sessions"] == 6
+        assert total["latency"]["p50"] < 0.01
+        assert total["latency"]["p99"] > 1.0  # the slow 5% survives
+        out = io.StringIO()
+        console.render(snap, out=out)
+        text = out.getvalue()
+        assert "fleet console" in text and "TOTAL" in text
+    finally:
+        s1.close()
+        s2.close()
+
+
+def test_once_mode_exit_codes_and_down_endpoint(capsys):
+    reg = _fleet_registry()
+    srv = MetricsServer(port=0, registry=reg).start()
+    try:
+        spec = f"127.0.0.1:{srv.address[1]}"
+        assert console.main([spec, "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "fleet console" in out and spec in out
+        # JSON mode is machine-readable and drops the raw buckets.
+        assert console.main([spec, "--once", "--json"]) == 0
+        snap = json.loads(capsys.readouterr().out)
+        assert snap["total"]["up"] == 1
+        assert "latency_buckets" not in snap["rows"][0]
+        # A down endpoint renders DOWN and fails the CI exit code,
+        # without killing the scrape of live ones.
+        rc = console.main([spec, "127.0.0.1:9", "--once"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "DOWN" in out and spec in out
+    finally:
+        srv.close()

@@ -1,0 +1,217 @@
+"""gol_tpu.obs.device tests — the plane below the jit boundary: the
+compile watcher (count/duration/cause/span/flight note), cost analysis
+via the compiled executable's own model, the memory census + watermark,
+the fits() capacity estimator, and the per-dispatch device-vs-host
+split recorded by a REAL engine run at its existing block-until-ready
+boundaries.
+
+The device plane instruments the PROCESS-GLOBAL registry (like every
+other layer), so these tests assert deltas, never absolutes.
+"""
+
+import numpy as np
+import pytest
+
+from gol_tpu import obs
+from gol_tpu.obs import device, flight, tracing
+
+
+def _series_value(name, labels=None):
+    m = obs.registry().snapshot().get(
+        name + ("" if not labels else
+                "{" + ",".join(f'{k}="{v}"'
+                               for k, v in sorted(labels.items())) + "}")
+    )
+    return 0 if m is None else m["value"]
+
+
+def _compiles_total():
+    return sum(
+        v["value"] for k, v in obs.registry().snapshot().items()
+        if k.startswith("gol_tpu_device_compiles_total")
+    )
+
+
+# --- compile watcher ----------------------------------------------------
+
+
+def test_compile_watcher_counts_attributes_and_notes():
+    assert device.install_compile_watcher()
+    assert device.install_compile_watcher()  # idempotent
+    import jax
+    import jax.numpy as jnp
+
+    before = _series_value("gol_tpu_device_compiles_total",
+                           {"cause": "dp-test"})
+    notes_before = sum(1 for _, kind, _f in flight.FLIGHT.entries
+                      if kind == "device.compile")
+    with device.cause("dp-test"):
+        # A shape/closure this process has never compiled.
+        jax.jit(lambda x: x * 3 + 17)(jnp.ones((13, 7)))
+    after = _series_value("gol_tpu_device_compiles_total",
+                          {"cause": "dp-test"})
+    assert after > before, "backend compile not counted under its cause"
+    spans = [r for r in tracing.TRACER.records
+             if r[1] == "device.compile"
+             and (r[6] or {}).get("cause") == "dp-test"]
+    assert spans, "no device.compile span with the declared cause"
+    assert spans[-1][4] > 0  # a real compile has nonzero duration
+    notes_after = sum(1 for _, kind, _f in flight.FLIGHT.entries
+                     if kind == "device.compile")
+    assert notes_after > notes_before
+
+
+def test_cause_is_nested_and_thread_local():
+    assert device.current_cause() == device.CAUSE_UNATTRIBUTED
+    with device.cause("outer"):
+        assert device.current_cause() == "outer"
+        with device.cause("inner"):
+            assert device.current_cause() == "inner"
+        assert device.current_cause() == "outer"
+    assert device.current_cause() == device.CAUSE_UNATTRIBUTED
+
+
+# --- cost analysis ------------------------------------------------------
+
+
+def test_cost_of_reports_flops_and_bytes():
+    import jax.numpy as jnp
+
+    out = device.cost_of(lambda x: x @ x, jnp.ones((32, 32)))
+    assert "error" not in out
+    # A 32³ matmul is ~2·32³ = 65536 FLOPs; the model must be in that
+    # regime, not zero and not wildly off.
+    assert out["flops"] >= 2 * 32 ** 3 * 0.5
+    assert out["bytes_accessed"] > 0
+    assert out["argument_bytes"] == 32 * 32 * 4
+
+
+def test_cost_of_never_raises():
+    out = device.cost_of(lambda x: x.nonsense(), np.zeros(3))
+    assert "error" in out
+
+
+def test_publish_cost_exports_gauges():
+    import jax.numpy as jnp
+
+    device.publish_cost("dp-test.prog", lambda x: x + 1,
+                        jnp.ones((8, 128)))
+    assert _series_value("gol_tpu_device_cost_flops",
+                         {"program": "dp-test.prog"}) > 0
+    assert _series_value("gol_tpu_device_cost_bytes_accessed",
+                         {"program": "dp-test.prog"}) > 0
+
+
+# --- memory census + watermark ------------------------------------------
+
+
+def test_memory_census_counts_live_arrays_and_watermark():
+    import jax
+
+    held = jax.device_put(np.ones((64, 1024), np.float32))
+    c = device.memory_census()
+    assert c["live_buffers"] >= 1
+    assert c["live_bytes"] >= held.nbytes
+    assert c["watermark_bytes"] >= c["live_bytes"] or \
+        c["bytes_in_use"] is not None
+    assert _series_value("gol_tpu_device_live_bytes") == c["live_bytes"]
+    # The watermark is monotone: dropping the array never lowers it.
+    peak = _series_value("gol_tpu_device_hbm_watermark_bytes")
+    del held
+    device.memory_census()
+    assert _series_value("gol_tpu_device_hbm_watermark_bytes") >= peak
+
+
+# --- fits() capacity estimator ------------------------------------------
+
+
+def test_fits_arithmetic_and_budget(monkeypatch):
+    monkeypatch.setenv("GOL_TPU_DEVICE_BUDGET_BYTES", str(64 << 20))
+    f = device.fits(512, 512, sessions=1)
+    assert f["packed"] is True
+    assert f["board_bytes"] == (512 // 32) * 512 * 4  # = H*W/8
+    assert f["fits"] is True and f["headroom_bytes"] > 0
+    # Max sessions: budget // (board * working-set multiple).
+    assert f["max_sessions"] == (64 << 20) // (f["board_bytes"] * 3)
+    # The estimator must say NO before the allocator would: a bucket
+    # bigger than the budget cannot fit.
+    over = device.fits(512, 512,
+                       sessions=f["max_sessions"] * 4 or 4)
+    assert over["fits"] is False
+    # Dense (non-packable) geometry prices a byte per cell.
+    dense = device.fits(100, 100)
+    assert dense["packed"] is False and dense["board_bytes"] == 100 * 100
+    # max_board_side is buildable: packed answers are 32-row aligned.
+    assert f["max_board_side"] % 32 == 0
+    side = f["max_board_side"]
+    assert device.fits(side, side)["fits"] is True
+
+
+def test_fits_unknown_budget_answers_none(monkeypatch):
+    monkeypatch.delenv("GOL_TPU_DEVICE_BUDGET_BYTES", raising=False)
+    f = device.fits(512, 512)
+    if f["budget_bytes"] is None:  # CPU: no allocator ceiling
+        assert f["fits"] is None and f["max_sessions"] is None
+    with pytest.raises(ValueError):
+        device.fits(0, 512)
+
+
+# --- dispatch split ------------------------------------------------------
+
+
+def _split_counts():
+    snap = obs.registry().snapshot()
+    return {
+        p: snap.get(
+            'gol_tpu_device_dispatch_split_seconds{phase="%s"}' % p,
+            {"value": {"count": 0, "sum": 0.0}},
+        )["value"]
+        for p in ("enqueue", "sync", "host")
+    }
+
+
+def test_observe_split_records_phases_and_fraction():
+    before = _split_counts()
+    device.observe_split(0.010, 0.070, 0.020)
+    after = _split_counts()
+    for p in ("enqueue", "sync", "host"):
+        assert after[p]["count"] == before[p]["count"] + 1
+    assert after["sync"]["sum"] - before["sync"]["sum"] == \
+        pytest.approx(0.070)
+    assert _series_value("gol_tpu_device_fraction") == pytest.approx(0.7)
+    # Partial splits (fused chunks: enqueue only) never move the
+    # fraction gauge.
+    device.observe_split(enqueue_s=0.5)
+    assert _series_value("gol_tpu_device_fraction") == pytest.approx(0.7)
+
+
+def test_engine_diff_run_records_full_split_and_compiles(tmp_path):
+    """Acceptance: a real watched engine run records all three split
+    phases at its existing boundaries (no added realizations) and its
+    compiles land attributed to the diff path."""
+    from gol_tpu.engine.distributor import Engine
+    from gol_tpu.events import FinalTurnComplete
+    from gol_tpu.params import Params
+
+    device.install_compile_watcher()
+    split_before = _split_counts()
+    compiles_before = _compiles_total()
+    w = ((np.random.default_rng(7).random((64, 64)) < 0.25) * 255
+         ).astype(np.uint8)
+    p = Params(turns=400, threads=1, image_width=64, image_height=64,
+               chunk=0, tick_seconds=60.0, image_dir=str(tmp_path),
+               out_dir=str(tmp_path))
+    e = Engine(p, emit_flips=True, initial_world=w)
+    e.start()
+    for ev in e.events:
+        if isinstance(ev, FinalTurnComplete):
+            break
+    e.join(60)
+    assert e.error is None
+    split_after = _split_counts()
+    for phase in ("enqueue", "sync", "host"):
+        assert split_after[phase]["count"] > split_before[phase]["count"], \
+            f"diff run recorded no {phase} split"
+    assert _compiles_total() > compiles_before
+    assert _series_value("gol_tpu_device_compiles_total",
+                         {"cause": "diff-chunk"}) > 0

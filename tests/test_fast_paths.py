@@ -431,13 +431,19 @@ def test_local_block_mode_selection():
     assert local_block_mode(1024, 8192, on_tpu=True) == (8, "tiled")
 
 
+@pytest.mark.slow
 def test_packed_sharded_pallas_local_blocks_match_dense():
     """The TPU local-block fast path — the pallas kernel running inside
     shard_map on the 4-word ghost-extended strip — forced on the CPU
     mesh via interpreter mode. 1024 rows / 4 shards = 8 word-rows per
     strip, so ext = 16 rows is tile-aligned and pallas-eligible; 165
     turns = one 128-turn pallas block + one 32-turn XLA block + 5
-    per-turn steps, covering all three loops of step_n."""
+    per-turn steps, covering all three loops of step_n.
+
+    slow (r9 tier-1 runtime audit): ~14s of interpret-mode pallas under
+    shard_map; pallas-inside-shard_map stays tier-1 via the tiled2d
+    variant (test_packed_sharded_tiled2d_local_blocks_match_dense) and
+    the uneven-split one (test_packed_uneven_pallas_local_blocks...)."""
     import jax
 
     from gol_tpu.parallel.packed_halo import packed_sharded_stepper

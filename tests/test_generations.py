@@ -334,10 +334,17 @@ def test_gens_uneven_shard_parity(threads):
     assert int(c1) == int(cn)
 
 
+@pytest.mark.slow
 def test_gens_tiled2d_local_blocks_inside_shard_map():
     """Wide gens shards route local blocks through the 2-D tiled gens
     kernel inside shard_map (interpreter mode on the CPU mesh), staying
-    bit-exact vs the XLA ring."""
+    bit-exact vs the XLA ring.
+
+    slow (r9 tier-1 runtime audit): ~15s of interpret-mode pallas
+    under shard_map; tier-1 keeps the same coverage pair via the
+    single-device tiled2d interpret sweep (this file) plus
+    pallas-inside-the-ring via
+    test_gens_packed_uneven_diff_stack_and_local_pallas."""
     from gol_tpu.parallel.gens_halo import (
         gens_local_block_mode,
         packed_gens_sharded_stepper,

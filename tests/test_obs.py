@@ -544,3 +544,129 @@ def test_invariant_violation_increments_registry_counter():
     with pytest.raises(InvariantViolation):
         chk.observe(TurnComplete(4))  # non-monotone: violation
     assert violations_total() == before + 1
+
+
+# --- histogram quantiles (r9: the fleet plane's shared math) ------------
+
+
+def test_quantile_interpolates_within_bucket():
+    """Rank q·total lands inside a bucket: linear interpolation between
+    the previous bound (0 for the first) and the landing bound."""
+    from gol_tpu.obs.registry import quantile_from_buckets
+
+    # 10 obs ≤ 1.0, 10 more ≤ 3.0 (cum 20), none beyond.
+    b = [(1.0, 10), (3.0, 20), (float("inf"), 20)]
+    # p50: rank 10 = exactly the first bucket's cum → its upper bound.
+    assert quantile_from_buckets(b, 0.5) == pytest.approx(1.0)
+    # p75: rank 15, halfway through the (1.0, 3.0] bucket.
+    assert quantile_from_buckets(b, 0.75) == pytest.approx(2.0)
+    # p100 caps at the highest finite bound that covers the mass.
+    assert quantile_from_buckets(b, 1.0) == pytest.approx(3.0)
+    # p0 is the lower edge of the distribution.
+    assert quantile_from_buckets(b, 0.0) == pytest.approx(0.0)
+
+
+def test_quantile_bucket_boundary_and_inf_cases():
+    from gol_tpu.obs.registry import quantile_from_buckets
+
+    # Mass beyond every finite bound: the histogram cannot resolve
+    # past its top bound — report that bound, never invent a value.
+    b = [(0.5, 0), (2.0, 1), (float("inf"), 10)]
+    assert quantile_from_buckets(b, 0.99) == pytest.approx(2.0)
+    # ALL mass in +Inf with no finite information at all → None.
+    only_inf = [(float("inf"), 7)]
+    assert quantile_from_buckets(only_inf, 0.5) is None
+    # Empty buckets between populated ones are skipped, not divided by.
+    b2 = [(1.0, 4), (2.0, 4), (4.0, 8), (float("inf"), 8)]
+    assert quantile_from_buckets(b2, 0.75) == pytest.approx(3.0)
+    with pytest.raises(ValueError):
+        quantile_from_buckets(b2, 1.5)
+
+
+def test_quantile_empty_histogram_is_none():
+    from gol_tpu.obs.registry import quantile_from_buckets
+
+    r = Registry()
+    h = r.histogram("t_q_empty", buckets=(1.0, 2.0))
+    assert h.quantile(0.5) is None
+    assert quantile_from_buckets([], 0.5) is None
+    assert quantile_from_buckets([(1.0, 0), (float("inf"), 0)], 0.9) is None
+
+
+def test_histogram_quantile_matches_observations():
+    r = Registry()
+    h = r.histogram("t_q", buckets=exponential_buckets(1e-3, 2.0, 12))
+    for v in (0.002, 0.002, 0.003, 0.004, 0.1):
+        h.observe(v)
+    p50 = h.quantile(0.5)
+    # Rank 2.5 lands in the (0.002, 0.004] bucket (cum 2 → 4).
+    assert 0.002 < p50 <= 0.004
+    # p99 lands in the bucket holding the 0.1 outlier.
+    assert 0.064 < h.quantile(0.99) <= 0.128
+
+
+def test_merged_registry_percentiles():
+    """Fleet percentiles merge the BUCKETS across registries before
+    taking the quantile — merging per-endpoint percentile numbers
+    would be wrong (quantiles do not average)."""
+    from gol_tpu.obs.registry import (
+        merge_cumulative_buckets,
+        quantile_from_buckets,
+    )
+
+    bounds = (0.001, 0.01, 0.1, 1.0)
+    fast, slow, union = Registry(), Registry(), Registry()
+    hf = fast.histogram("lat", buckets=bounds)
+    hs = slow.histogram("lat", buckets=bounds)
+    hu = union.histogram("lat", buckets=bounds)
+    for v in [0.0005] * 98 + [0.05] * 2:
+        hf.observe(v)
+        hu.observe(v)
+    for v in [0.5] * 10:
+        hs.observe(v)
+        hu.observe(v)
+    merged = merge_cumulative_buckets(
+        [hf.cumulative_buckets(), hs.cumulative_buckets()]
+    )
+    for q in (0.5, 0.95, 0.99):
+        assert quantile_from_buckets(merged, q) == pytest.approx(
+            hu.quantile(q)
+        ), "merged-registry quantile must equal the union population's"
+    # The naive average of per-registry p99s is nowhere near the truth.
+    naive = (hf.quantile(0.99) + hs.quantile(0.99)) / 2
+    assert abs(naive - hu.quantile(0.99)) > 0.1
+
+
+def test_registry_percentiles_merges_label_children():
+    r = Registry()
+    a = r.histogram("t_pp", labels={"peer": "a"}, buckets=(1.0, 2.0, 4.0))
+    b = r.histogram("t_pp", labels={"peer": "b"}, buckets=(1.0, 2.0, 4.0))
+    for _ in range(9):
+        a.observe(0.5)
+    b.observe(3.0)
+    p = r.percentiles("t_pp")
+    assert set(p) == {"p50", "p95", "p99"}
+    assert p["p50"] <= 1.0
+    assert 2.0 < p["p99"] <= 4.0  # the one slow child pulls the tail
+    assert r.percentiles("no_such_family") is None
+
+
+def test_obs_in_jit_covers_device_plane(tmp_path):
+    """The device plane (gol_tpu.obs.device) is an obs module: calls
+    rooted at it inside a traced function are flagged like any other
+    instrumentation."""
+    findings = _lint(tmp_path, """
+        import jax
+        from gol_tpu.obs import device
+
+        @jax.jit
+        def f(x):
+            device.observe_split(enqueue_s=0.1)   # traced: flagged
+            return x
+
+        def host(x):
+            device.observe_split(enqueue_s=0.1)   # host-side: fine
+            return x
+    """)
+    hits = [f for f in findings if f.check == "obs-in-jit"]
+    assert len(hits) == 1 and "device" in hits[0].message

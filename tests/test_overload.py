@@ -98,12 +98,20 @@ def _wait(cond, timeout, what):
 # --- slow-consumer degradation ------------------------------------------
 
 
+@pytest.mark.slow
 def test_stalled_observer_degrades_then_resumes_bit_exact(tmp_path):
     """The acceptance pin: stall an observer's reader on a live
     multi-session serve → the server DEGRADES it (sheds, counts) while
     the driver's turn cadence continues; unstall → one coalescing
     BoardSync makes the observer whole, verified bit-exactly against
-    the unfaulted oracle."""
+    the unfaulted oracle.
+
+    slow (r9 tier-1 runtime audit): ~19s multi-actor scenario whose
+    stall/drain deadlines are only honest on an unloaded box (the
+    chaos-test rationale — it flaked under full-suite load while
+    passing alone). Degradation/drain/eviction stay tier-1 via the
+    other overload tests (drain-deadline eviction, high-water clamp,
+    shed accounting)."""
     from gol_tpu.distributed import Controller
     from gol_tpu.testing.chaos import Recipe, oracle_board
 

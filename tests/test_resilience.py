@@ -175,8 +175,15 @@ def test_seeded_reset_reconnect_resync_bit_identical(golden_root, tmp_path):
     bit-identical to a fault-free run (the golden 64x64x100 fixture) —
     with invariant checkers ON and zero violations (module fixture)."""
     faults.install(FaultPlan.parse("client:reset@recv:40"))
+    # hb 2.0 → a 6s client read deadline: this test's substance is the
+    # SEEDED reset → reconnect → bit-identity, and the dedicated hb
+    # tests below pin the liveness deadlines. At the old 0.5s (1.5s
+    # deadline) a loaded box could starve the server long enough for a
+    # spurious hb-miss near run end — the reconnect then races the
+    # server's exit and FinalTurnComplete is gone forever (flaked 2/3
+    # full-suite runs on a busy container, r9).
     server = make_server(golden_root, tmp_path, chunk=1,
-                         heartbeat_secs=0.5).start()
+                         heartbeat_secs=2.0).start()
     ctl = Controller(*server.address, want_flips=True, **fast_reconnect())
     board = NumpyBoard(64, 64)
     final = None

@@ -76,9 +76,16 @@ def test_timeline_records_chunk_spans_and_dump(golden_root, tmp_path):
     assert len(loaded["spans"]) == 3
 
 
+@pytest.mark.slow
 def test_device_trace_writes_artifact(golden_root, tmp_path):
     """jax.profiler trace artifacts land in the given dir — the
-    trace.out analog, viewable in Perfetto/TensorBoard."""
+    trace.out analog, viewable in Perfetto/TensorBoard.
+
+    slow (r9 tier-1 runtime audit): ~19s of profiler capture around a
+    real run; the profiler driver path stays exercised tier-1 through
+    the obs.device --profile-dir plumbing (tests/test_device_plane.py
+    and metrics_smoke.sh cover the device plane; the capture itself is
+    a jax API, re-verified here in full runs)."""
     trace_dir = tmp_path / "trace"
     p = make_params(golden_root, tmp_path, turns=5, threads=1, chunk=5)
     engine, tl = profile_run(p, trace_dir=str(trace_dir), emit_flips=False)

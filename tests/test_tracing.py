@@ -679,3 +679,65 @@ def test_sigterm_leaves_flight_dump_with_committed_turn(
     assert abs(committed - max(commits)) <= CHUNK
     # And the post-mortem renderer accepts the artifact as-is.
     assert report.main(["render", str(out_dir / dumps[0])]) == 0
+
+
+def test_merge_n_way_remaps_pids_and_clocks(tmp_path):
+    """r9: `report merge` takes N dumps, not 2 — a server plus three
+    relays/clients, ALL claiming pid 1 (containers) and each with its
+    own measured clock offset, must land as four distinct viewer
+    tracks with each dump's events shifted by ITS OWN offset."""
+    base = 1_000_000_000.0 * 1e6
+    offsets = [None, 2.0, -1.5, 0.25]   # server is the reference
+    labels = ["serve", "relay-a", "relay-b", "connect"]
+    paths = []
+    for i, (off, label) in enumerate(zip(offsets, labels)):
+        raw_ts = base + 1000 * i - (off or 0.0) * 1e6
+        paths.append(_trace_file(
+            tmp_path / f"d{i}.json",
+            [{"name": "turn.emit" if i == 0 else "turn.apply",
+              "cat": "wire", "ph": "i", "ts": raw_ts, "pid": 1,
+              "tid": 1, "args": {"turn": 1, "who": i}}],
+            pid=1, label=label, offset=off,
+        ))
+    merged = report.merge_traces([report.load_trace(p) for p in paths])
+    data_events = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    assert len(data_events) == 4
+    # Four distinct pids despite the collision...
+    assert len({e["pid"] for e in data_events}) == 4
+    # ...and every dump corrected onto the ONE reference timebase:
+    # corrected ts = raw + own offset = base + 1000*i exactly.
+    by_who = {e["args"]["who"]: e["ts"] for e in data_events}
+    for i in range(4):
+        assert by_who[i] == pytest.approx(base + 1000 * i, abs=1)
+    # merged_from records every source with its label and offset.
+    mf = merged["metadata"]["merged_from"]
+    assert len(mf) == 4
+    assert {v["label"] for v in mf.values()} == set(labels)
+    recorded = sorted(v["clock_offset_seconds"] for v in mf.values())
+    assert recorded == sorted(o or 0.0 for o in offsets)
+
+
+def test_merge_label_overrides_and_profile_dir_link(tmp_path):
+    """-l/--label renames processes in input order (N relays all call
+    themselves 'connect'), and a dump whose metadata names a
+    --profile-dir capture carries it into merged_from."""
+    base = 1_000_000_000.0 * 1e6
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps({
+        "traceEvents": [{"name": "x", "ph": "i", "ts": base, "pid": 1,
+                         "tid": 1}],
+        "metadata": {"pid": 1, "process_label": "connect",
+                     "clock_offset_seconds": None,
+                     "profile_dir": "/tmp/prof-a"},
+    }))
+    b = _trace_file(tmp_path / "b.json",
+                    [{"name": "y", "ph": "i", "ts": base, "pid": 1,
+                      "tid": 1}], pid=1, label="connect", offset=0.0)
+    out = tmp_path / "m.json"
+    rc = report.main(["merge", str(a), str(b), "-o", str(out),
+                      "-l", "edge-1", "-l", "edge-2"])
+    assert rc == 0
+    mf = json.loads(out.read_text())["metadata"]["merged_from"]
+    assert {v["label"] for v in mf.values()} == {"edge-1", "edge-2"}
+    dirs = [v.get("profile_dir") for v in mf.values()]
+    assert "/tmp/prof-a" in dirs
