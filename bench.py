@@ -708,6 +708,130 @@ def measure_wire_watched(binary: bool = True, delta: bool = True) -> dict:
             "link_bytes_per_turn": round(nbytes / turns, 1)}
 
 
+def measure_wire_watched_batch(sweep=(16, 64, 256, 1024),
+                               settle_turns: int = 10_000,
+                               measure_secs: float = 8.0) -> dict:
+    """The batched watched path (ISSUE 10 acceptance lane): a real
+    EngineServer on a SETTLED 512² board, a batching controller
+    (hello "batch" max-k) attached through the byte-counting loopback
+    proxy, delivered TurnComplete rate + true link bytes per turn, k
+    swept over `sweep` plus an UNBATCHED A/B on the same fixture.
+
+    The fixture is settled (10k turns, the golden board's period-2
+    steady state) because that is the regime the batch frames — and
+    the engine's cycle ride — are built for: the un-settled soup tier
+    stays covered by `wire_watched_512x512`. Cycle detection is ON
+    (the product configuration for astronomically long runs): once the
+    engine proves the period, chunks are synthesized host-side and
+    the lane measures the full serving plane — chunk emit, frame
+    encode, wire, vectorized client apply, per-turn event delivery —
+    rather than this box's raw device stepping rate (~8k turns/s at
+    512² on the CPU substrate; a TPU link changes which leg is the
+    ceiling, not the protocol).
+
+    The client runs batch_flip_events=False (the high-rate watching
+    mode: per-turn TurnComplete events + the always-current shadow
+    raster; reconstructing per-turn coord arrays at 10⁵ turns/s would
+    measure Python object churn, not the wire). Each measurement
+    asserts the shadow raster still matches the fused oracle at a
+    period boundary — the lane is bit-exactness-gated, not just a
+    throughput count."""
+    import queue as _q
+    import threading
+
+    import jax
+    import numpy as np
+
+    from gol_tpu.distributed import Controller, EngineServer
+    from gol_tpu.events import TurnComplete
+    from gol_tpu.params import Params
+    from gol_tpu.parallel.stepper import make_stepper
+
+    st = make_stepper(threads=1, height=H, width=W,
+                      devices=[jax.devices()[0]])
+    q0, c = st.step_n(st.put(_world(W)), settle_turns)
+    int(c)
+    settled = st.fetch(q0)
+    # Oracle boards for one full period (the settled tier is p2, but
+    # derive the period empirically up to 16 to stay assumption-free).
+    period_boards = [settled != 0]
+    qq = q0
+    for _ in range(16):
+        qq, cc = st.step_n(qq, 1)
+        b = st.fetch(qq) != 0
+        if np.array_equal(b, period_boards[0]):
+            break
+        period_boards.append(b)
+
+    out = {"board": f"{W}x{H} settled (turn {settle_turns}+)",
+           "encoding": "fbatch-delta-frames", "cycle_detect": True}
+
+    def one(batch_turns) -> dict:
+        p = Params(turns=10**9, threads=1, image_width=W,
+                   image_height=H, chunk=0, tick_seconds=60.0,
+                   image_dir="images", out_dir="out",
+                   cycle_detect=True)
+        server = EngineServer(p, port=0, initial_world=settled).start()
+        proxy_addr, stats = _counting_proxy(server.address)
+        ctl = Controller(*proxy_addr, want_flips=True, batch=True,
+                         batch_turns=batch_turns,
+                         batch_flip_events=False)
+        t_end = time.time() + measure_secs
+        seen = 0
+        t0 = None
+        b0 = 0
+        while time.time() < t_end:
+            try:
+                evs = ctl.events.get_batch(65536, timeout=1.0)
+            except _q.Empty:
+                continue
+            if evs is None:
+                break
+            n = sum(1 for e in evs if isinstance(e, TurnComplete))
+            if n and t0 is None:
+                t0 = time.perf_counter()
+                b0 = stats["down"]
+                n = 0  # rate starts after the first delivery
+            seen += n
+        elapsed = (time.perf_counter() - t0) if t0 else 0.0
+        nbytes = stats["down"] - b0
+        # QUIESCE before the bit-exactness gate: detach stops the
+        # reader at a frame boundary (frames carry whole turns), so
+        # the raster compared below is a settled turn-boundary board,
+        # never a torn mid-apply read.
+        with contextlib.suppress(Exception):
+            ctl.detach(30)
+        board_ok = any(
+            np.array_equal(ctl.board != 0, pb) for pb in period_boards
+        )
+        server.shutdown()
+        ctl.close()
+        if not board_ok:
+            return {"error": "shadow raster matched no oracle phase"}
+        if not seen or elapsed <= 0:
+            return {"error": f"no turns delivered in {measure_secs}s"}
+        return {"turns_per_sec": round(seen / elapsed, 1),
+                "turns": seen,
+                "link_bytes_per_turn": round(nbytes / max(seen, 1), 2)}
+
+    best = 0.0
+    unbatched = one(None)
+    out["unbatched"] = unbatched
+    for k in sweep:
+        r = one(k)
+        out[f"k{k}"] = r
+        if "turns_per_sec" in r:
+            if r["turns_per_sec"] > best:
+                best = r["turns_per_sec"]
+                out["best_k"] = k
+    out["turns_per_sec"] = best
+    if "turns_per_sec" in unbatched and unbatched["turns_per_sec"]:
+        out["speedup_vs_unbatched"] = round(
+            best / unbatched["turns_per_sec"], 1
+        )
+    return out
+
+
 def measure_sessions_lane(sessions: int = 64, side: int = 256,
                           k: int = 16, rounds: int = 4) -> dict:
     """The multi-session lane (ROADMAP open item 3 / ISSUE 7
@@ -967,6 +1091,12 @@ def main() -> None:
     # Wire-encoding A/Bs: the same watched path forced onto binary
     # coord frames without the delta-of-sparse chain (r6), and onto
     # the legacy compact (base64-inside-JSON) encodings (r5).
+    try:
+        detail["wire_watched_512x512_batch"] = _lane(
+            measure_wire_watched_batch
+        )
+    except Exception as e:
+        detail["wire_watched_512x512_batch"] = {"error": repr(e)}
     try:
         detail["wire_watched_512x512_coords"] = measure_wire_watched(
             delta=False

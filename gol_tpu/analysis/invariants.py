@@ -125,6 +125,8 @@ class EventStreamChecker:
         turn = getattr(ev, "completed_turns", None)
         if kind in ("FlipBatch", "CellFlipped"):
             self._on_flips(turn, kind)
+        elif kind == "FlipChunk":
+            self._on_flip_chunk(getattr(ev, "first_turn", None), turn)
         elif kind == "TurnComplete":
             self._on_turn_complete(turn)
         elif kind == "BoardSync":
@@ -164,6 +166,37 @@ class EventStreamChecker:
         elif turn != self._pending_turn:
             self._pending_initial = False
         self._pending_turn = turn
+
+    def _on_flip_chunk(self, first_turn, last_turn: int) -> None:
+        """A FlipChunk is k (FlipBatch, TurnComplete) pairs emitted
+        atomically: it must start exactly one turn past the stream
+        position, never rewind behind a sync, and it advances the
+        stream to its last turn (so a chunk can never straddle a
+        BoardSync — the engine only emits whole chunks between
+        dispatch boundaries, where syncs are serviced)."""
+        if first_turn is None or last_turn < first_turn:
+            self._fail(
+                f"malformed FlipChunk: turns {first_turn}..{last_turn}"
+            )
+        if self._sync_turn is not None and first_turn <= self._sync_turn:
+            self._fail(
+                f"FlipChunk starting at turn {first_turn} after a "
+                f"BoardSync at turn {self._sync_turn} — its leading "
+                "turns are already in the synced board"
+            )
+        if self._last_tc is not None and first_turn <= self._last_tc:
+            self._fail(
+                f"stale FlipChunk starting at turn {first_turn}: the "
+                f"stream is already at TurnComplete {self._last_tc}"
+            )
+        if self._pending_turn is not None and not self._pending_initial:
+            self._fail(
+                f"FlipChunk at turns {first_turn}..{last_turn} while "
+                f"flips for turn {self._pending_turn} are unflushed"
+            )
+        self._last_tc = last_turn
+        self._pending_turn = None
+        self._pending_initial = False
 
     def _on_turn_complete(self, turn: int) -> None:
         if self._last_tc is not None and turn <= self._last_tc:

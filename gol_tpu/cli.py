@@ -193,6 +193,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "stay wedged before eviction — peers that "
                          "drain inside the deadline are resynced and "
                          "keep watching (default 10)")
+    ap.add_argument("--batch-turns", type=int, default=None,
+                    dest="batch_turns", metavar="K",
+                    help="with --serve: ceiling on a peer's hello "
+                         "\"batch\" max-k (turns per flip-batch wire "
+                         "frame; default 1024, 0 disables batching). "
+                         "With --connect: request k-turn batch frames "
+                         "— the watched-path throughput mode "
+                         "(docs/PERF.md \"Batched wire turns\")")
     ap.add_argument("--no-reconnect", action="store_true",
                     dest="no_reconnect",
                     help="with --connect: die on the first link "
@@ -515,7 +523,10 @@ def _serve(args, params: Params, resume_path: Optional[str] = None) -> int:
                           evict_secs=args.evict_secs,
                           max_peers=args.max_peers,
                           high_water=args.high_water,
-                          drain_secs=args.drain_secs)
+                          drain_secs=args.drain_secs,
+                          batch_turns=(args.batch_turns
+                                       if args.batch_turns is not None
+                                       else 1024))
     print(f"engine serving on {server.address[0]}:{server.address[1]}")
     # Sidecar BEFORE the engine/broadcast threads: a failed port bind
     # aborts while nothing needing teardown is running (a bind failure
@@ -559,7 +570,10 @@ def _serve_sessions(args, params: Params, resume: bool) -> int:
                            max_peers=args.max_peers,
                            max_sessions=args.max_sessions,
                            high_water=args.high_water,
-                           drain_secs=args.drain_secs)
+                           drain_secs=args.drain_secs,
+                           batch_turns=(args.batch_turns
+                                        if args.batch_turns is not None
+                                        else 1024))
     print(f"session engine serving on "
           f"{server.address[0]}:{server.address[1]}")
     if resume:
@@ -605,6 +619,7 @@ def _control(args, params: Params, keypresses: queue.Queue) -> int:
     # levels follows the rule family (gray-level gens batches, r5).
     ctl = Controller(host, port, want_flips=not args.novis,
                      secret=args.secret, batch=not args.novis,
+                     batch_turns=args.batch_turns,
                      levels=vis_levels and not args.novis,
                      observe=args.observe,
                      session=args.session,

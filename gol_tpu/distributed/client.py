@@ -75,11 +75,19 @@ class _ClientMetrics:
             "gol_tpu_client_apply_seconds",
             "Decode-and-apply seconds per server message",
         )
+        self.batch_latency = obs.histogram(
+            "gol_tpu_client_batch_latency_seconds",
+            "Batch-frame emit on the server -> whole k-turn batch "
+            "applied here (PER-BATCH stamping, deliberately not fed "
+            "into turn_latency — docs/OBSERVABILITY.md \"Batch "
+            "latency semantics\")",
+        )
         self.messages = {
             t: obs.counter(
                 "gol_tpu_client_messages_total",
                 "Server messages handled by kind", {"kind": t},
-            ) for t in ("board", "flips", "dflips", "ev", "other")
+            ) for t in ("board", "flips", "dflips", "fbatch", "ev",
+                        "other")
         }
         self.reconnects = obs.counter(
             "gol_tpu_client_reconnects_total",
@@ -154,6 +162,8 @@ class Controller:
         timeout: float = 30.0,
         secret: "str | None" = None,
         batch: bool = False,
+        batch_turns: "int | None" = None,
+        batch_flip_events: bool = True,
         binary: bool = True,
         levels: bool = False,
         delta: bool = True,
@@ -173,6 +183,22 @@ class Controller:
         #: watched run at ~30 turns/s. Default stays per-cell (the
         #: reference event contract).
         self._batch = batch
+        #: batch_turns=k requests k-TURN WIRE FRAMES (hello "batch",
+        #: r10): the server ships one _TAG_FBATCH frame per dispatch
+        #: chunk instead of per-turn frames, and this client applies
+        #: each frame with one vectorized XOR pass over the shadow
+        #: raster — the ~300 -> 10⁵+ turns/s watched-path fix. The
+        #: server clamps the request to its own --batch-turns cap;
+        #: servers that predate the frame ignore the key and keep
+        #: sending per-turn frames, which this client still handles.
+        self._batch_turns = int(batch_turns) if batch_turns else 0
+        #: With batch frames, per-turn FlipBatch/CellFlipped events
+        #: are RECONSTRUCTED from the deltas (exact, but per-turn
+        #: Python cost). batch_flip_events=False skips them — consumers
+        #: read per-turn TurnComplete events plus the always-current
+        #: `board` raster instead (the high-rate watching mode: a
+        #: display renders from `board` at its own frame rate).
+        self._batch_flip_events = batch_flip_events
         #: levels=True (multi-state rules, r5): board syncs replay as
         #: level-setting batches and flips messages carrying levels
         #: surface them on the FlipBatch — pair with a level-mode board.
@@ -237,6 +263,12 @@ class Controller:
                  # Delta frames carry no levels, so level mode keeps
                  # the LFLIPS encoding (negotiated OFF here).
                  "delta": bool(delta) and bool(binary) and not levels}
+        if self._batch_turns > 0 and binary and not levels and want_flips:
+            # k-turn batch frames (binary-only, two-state only — the
+            # same constraints as delta frames — and only when flips
+            # are actually subscribed: the server ignores a flip-less
+            # "batch" anyway, so don't even advertise it).
+            hello["batch"] = self._batch_turns
         if observe:
             # Read-only attach (r5 multi-observer serving): the
             # driver slot stays free, steering verbs are rejected
@@ -431,6 +463,20 @@ class Controller:
                 # clock_offset forever unmeasured. Stream-idle links
                 # retry off the heartbeat cadence at worst.
                 self._send_clk()
+            if t == "fbatch":
+                # Per-BATCH latency: emit-of-batch (the frame's one ts
+                # stamp) -> whole batch applied. A separate histogram
+                # on purpose: feeding per-batch readings into the
+                # per-turn series would silently change its semantics
+                # under bench_compare.
+                off = self.clock_offset or 0.0
+                lag = max(0.0, time.time() + off - float(msg["ts"]))
+                _METRICS.batch_latency.observe(lag)
+                tracing.event(
+                    "turn.apply", "wire",
+                    turn=msg["first_turn"] + msg["k"] - 1,
+                    batch=msg["k"], lag_s=round(lag, 6),
+                )
             if t == "ev" and msg.get("k") == "turn" and "ts" in msg:
                 # The handshake-estimated offset moves this reading
                 # onto the SERVER's timebase (server_now ≈ client_now +
@@ -576,6 +622,9 @@ class Controller:
                 for x, y in coords:
                     self.events.put(CellFlipped(turn, Cell(int(x), int(y))))
             return True
+        if t == "fbatch":
+            self._apply_fbatch(msg)
+            return True
         if t == "flips":
             turn, coords = wire.msg_flips_array(msg)
             lv = wire.msg_flips_levels(msg) if self._levels else None
@@ -613,6 +662,98 @@ class Controller:
         if t == "bye":
             return False
         return True  # unknown message kinds are ignored (forward compat)
+
+    def _apply_fbatch(self, msg: dict) -> None:
+        """Apply one k-turn batch frame (wire _TAG_FBATCH, already
+        validated structurally at parse). The shadow raster advances
+        in ONE vectorized XOR pass: turn i's flips ride as
+        D[i] = S[i] XOR S[i-1] (D[0] = S[0]; frames self-contained),
+        so the net board change over applied turns t0..k-1 is the XOR
+        of exactly the D rows appearing an ODD number of times in
+        Σ_{t>=t0} S[t] — D[j] appears (k - max(j, t0)) times. On a
+        settled board (every turn's flips identical) every D row past
+        the first is empty and the whole apply is a few hundred words.
+
+        `synced_turn` gates per TURN, not per frame: a batch
+        straddling a reconnect resync applies only its suffix — the
+        gated prefix is already inside the synced raster (bit-exact,
+        pinned by the fuzz suite's scripted-server test)."""
+        if self.board is None:
+            raise wire.WireError("batch frame before any board sync")
+        h, w = self.board.shape
+        total, nb = wire.grid_words(w, h)
+        if msg["nb"] != nb:
+            raise wire.WireError(
+                f"batch bitmap rows of {msg['nb']} words, this board "
+                f"needs {nb}"
+            )
+        counts = msg["counts"].astype(np.int64)
+        k, first = int(msg["k"]), int(msg["first_turn"])
+        dbm, dwords = msg["dbitmaps"], msg["dwords"]
+        if total % 32 and dbm.size and np.any(
+                dbm[:, -1] >> np.uint32(total % 32)):
+            raise wire.WireError("batch bitmap bit outside the board grid")
+        t0 = max(0, self.synced_turn - first + 1)
+        if t0 >= k:
+            return  # whole batch already inside the synced raster
+        nzt = np.flatnonzero(counts)  # turns with a nonzero delta row
+        offs = np.zeros(len(nzt) + 1, np.int64)
+        np.cumsum(counts[nzt], out=offs[1:])
+        reps = k - np.maximum(nzt, t0)
+        sel = np.flatnonzero((reps > 0) & (reps % 2 == 1))
+        if sel.size:
+            acc = np.zeros(total, np.uint32)
+            for i in sel:
+                idx = wire._bitmap_indices(dbm[i])
+                acc[idx] ^= dwords[offs[i]:offs[i + 1]]
+            fw = np.flatnonzero(acc)
+            if fw.size:
+                bits = (acc[fw, None]
+                        >> np.arange(32, dtype=np.uint32)) & 1
+                rr, bb = np.nonzero(bits)
+                x = fw[rr] % w
+                y = (fw[rr] // w) * 32 + bb
+                if y.size and int(y.max()) >= h:
+                    raise wire.WireError(
+                        "batch mask bit past the board height"
+                    )
+                self.board[y, x] ^= np.uint8(255)
+        if not self._batch_flip_events:
+            self.events.put_many(
+                [TurnComplete(first + t) for t in range(t0, k)]
+            )
+            return
+        # Exact per-turn surfacing: reconstruct each turn's flip set
+        # from the delta chain (the slow-but-faithful mode; identical
+        # to the unbatched event stream, pinned by test).
+        evs: list = []
+        cur = np.zeros(total, np.uint32)
+        bi = 0
+        off = 0
+        for t in range(k):
+            m = int(counts[t])
+            if m:
+                idx = wire._bitmap_indices(dbm[bi])
+                bi += 1
+                cur[idx] ^= dwords[off:off + m]
+                off += m
+            turn = first + t
+            if turn <= self.synced_turn:
+                continue
+            nzw = np.flatnonzero(cur)
+            if nzw.size:
+                coords = wire.words_to_coords(
+                    wire._indices_to_bitmap(nzw, nb), cur[nzw], w, h
+                )
+                if self._batch:
+                    evs.append(FlipBatch(turn, coords))
+                else:
+                    evs.extend(
+                        CellFlipped(turn, Cell(int(cx), int(cy)))
+                        for cx, cy in coords
+                    )
+            evs.append(TurnComplete(turn))
+        self.events.put_many(evs)
 
     def _track_flips(self, coords, levels) -> None:
         """Mirror one delivered flip batch onto the shadow raster, so

@@ -57,6 +57,7 @@ from gol_tpu.events import (
     CellFlipped,
     FinalTurnComplete,
     FlipBatch,
+    FlipChunk,
     TurnComplete,
 )
 from gol_tpu.io.pgm import read_pgm
@@ -132,6 +133,11 @@ class _ServerMetrics:
             "gol_tpu_server_heartbeats_total",
             "Liveness beacons sent into idle peer streams",
         )
+        self.batch_turns = obs.histogram(
+            "gol_tpu_server_batch_turns",
+            "Turns carried per encoded k-turn flip-batch wire frame "
+            "(hello \"batch\" peers)",
+        )
         self.evicted = obs.counter(
             "gol_tpu_server_peer_evicted_total",
             "Peers evicted for missing the heartbeat deadline",
@@ -202,6 +208,7 @@ class _Conn:
                  compact: bool = False, binary: bool = False,
                  levels: bool = False, role: str = "drive",
                  hb: bool = False, delta: bool = False,
+                 batch: int = 0,
                  io_timeout: Optional[float] = None,
                  high_water: Optional[int] = None,
                  drain_secs: Optional[float] = None):
@@ -245,6 +252,16 @@ class _Conn:
         #: chain on both ends.
         self.delta = delta and binary
         self.delta_prev = None
+        #: Negotiated k-turn batch frames (hello "batch", r10): the
+        #: clamped max turns one _TAG_FBATCH frame may carry to this
+        #: peer, 0 = per-turn frames. Binary-only, like delta, and
+        #: flips-only — a flip-less watcher can never receive a batch
+        #: frame, so honoring its "batch" key would flip the engine
+        #: into chunk emission (and burstier delivery for everyone)
+        #: for nothing. Batch frames are SELF-CONTAINED (the turn-axis
+        #: delta chain never crosses a frame), so no chain state lives
+        #: here.
+        self.batch = batch if (binary and want_flips) else 0
         #: Peer can apply per-cell gray levels (multi-state batches,
         #: r5). Without it, level batches downgrade to plain flips —
         #: a pre-r5 peer must keep receiving frames it understands
@@ -496,6 +513,21 @@ class _Conn:
             self.sock.close()
 
 
+def _clamp_batch(hello: dict, cap: int) -> int:
+    """The peer's hello "batch" max-k request, clamped to the server's
+    --batch-turns ceiling AND the wire frame's own hard turn cap —
+    an operator cap above FBATCH_MAX_TURNS must never let the server
+    negotiate frames its peer's parser is required to reject.
+    Hostile/non-integer values read as 0 (no batching) — the request
+    is an optimization, never an error."""
+    if cap <= 0:
+        return 0
+    req = hello.get("batch")
+    if isinstance(req, bool) or not isinstance(req, int):
+        return 0
+    return max(0, min(req, cap, wire.FBATCH_MAX_TURNS))
+
+
 def _encode_and_send_flips(conn: _Conn, turn: int, flips, flips_levels,
                            width: int, height: int,
                            delta_words=None) -> None:
@@ -550,9 +582,15 @@ class EngineServer:
         high_water: Optional[int] = None,
         drain_secs: Optional[float] = None,
         retry_after_secs: float = 1.0,
+        batch_turns: int = 1024,
         **engine_kwargs,
     ):
         self.params = params
+        #: Server-side ceiling on a peer's hello "batch" request (the
+        #: max turns one flip-batch frame may carry; CLI
+        #: --batch-turns). 0 disables batch negotiation entirely —
+        #: every peer gets per-turn frames.
+        self.batch_turns = max(0, batch_turns)
         #: Admission budget (docs/RESILIENCE.md "Overload &
         #: degradation"): attaches past this many live peers are
         #: rejected "at-capacity" WITH a retry_after hint, instead of
@@ -759,6 +797,7 @@ class EngineServer:
                          levels=bool(hello.get("levels", False)),
                          role=role, hb=hb,
                          delta=bool(hello.get("delta", False)),
+                         batch=_clamp_batch(hello, self.batch_turns),
                          high_water=self.high_water,
                          drain_secs=self.drain_secs)
             if role == "observe":
@@ -802,6 +841,10 @@ class EngineServer:
             # emit-stamp offset instead of documenting the skew. Legacy
             # peers ignore the unknown key.
             ack = {"t": "attach-ack", "clock": True}
+            if conn.batch:
+                # Confirm the clamped max-k, so the peer knows the
+                # granularity its frames will arrive at.
+                ack["batch"] = conn.batch
             if hb:
                 # The client arms its own miss-detector from this: a
                 # server that stays silent past a few multiples of
@@ -833,6 +876,14 @@ class EngineServer:
         progress, ref: sdl/loop.go:44-47 prints per-event); a detached
         engine emits none and runs full-size fused chunks."""
         self.engine.emit_turns = True
+        if conn.batch:
+            # A batching watcher: diff chunks emit as whole FlipChunk
+            # events, and the dispatch chunk budget scales to the
+            # negotiated max-k (ISSUE 10's chunk-pinning fix).
+            self.engine.emit_flip_chunks = True
+            self.engine.batch_turns_hint = max(
+                self.engine.batch_turns_hint, conn.batch
+            )
         self.engine.request_board_sync(
             enable_flips=conn.want_flips, token=conn.token
         )
@@ -878,6 +929,10 @@ class EngineServer:
             conns.append(self._conn)
         self.engine.emit_flips = any(c.want_flips for c in conns)
         self.engine.emit_turns = bool(conns)
+        self.engine.emit_flip_chunks = any(c.batch for c in conns)
+        self.engine.batch_turns_hint = max(
+            (c.batch for c in conns), default=0
+        )
 
     def _all_conns(self) -> "list[_Conn]":
         with self._conn_lock:
@@ -1094,6 +1149,97 @@ class EngineServer:
                 msg["ts"] = time.time()
             conn.send(msg)
 
+    def _broadcast_chunk(self, ev: FlipChunk, conns) -> None:
+        """Fan one k-turn FlipChunk out: batch peers get ONE encoded
+        frame (shared per distinct negotiated max-k — encode runs
+        once, before any per-peer state moves), per-turn peers get the
+        expanded flips/TurnComplete stream they always got (expansion
+        also computed at most once per chunk). The per-turn
+        housekeeping the TurnComplete branch used to do — lag gauges,
+        drain-resync checks, the wire-correlation mark — runs per
+        chunk here; shedding (offer_stream) gates whole batches."""
+        k = len(ev.counts)
+        last = ev.completed_turns
+        depth = 0
+        for c in conns:
+            q = c._out.qsize()
+            depth = max(depth, q)
+            if c.lag_metric is not None:
+                c.lag_metric.set(q)
+            if c.drained():
+                c.resync_pending = True
+                self.engine.request_board_sync(
+                    enable_flips=c.want_flips, token=c.token
+                )
+        _METRICS.queue_depth.set(depth)
+        tracing.event("turn.emit", "wire", turn=last, batch=k)
+        ts = time.time()
+        enc: dict = {}
+        expanded = None
+        for conn in conns:
+            if not conn.synced or last <= conn.synced_turn:
+                continue
+            try:
+                if not conn.offer_stream():
+                    continue
+                if conn.batch and conn.want_flips:
+                    frames = enc.get(conn.batch)
+                    if frames is None:
+                        with tracing.span("wire.encode_batch", "wire",
+                                          turn=last, turns=k):
+                            frames = encode_batch_frames(
+                                ev.counts, ev.bitmaps, ev.words,
+                                ev.first_turn, self.params.image_width,
+                                self.params.image_height, conn.batch,
+                                ts,
+                            )
+                        enc[conn.batch] = frames
+                    for f in frames:
+                        conn.send_raw(f)
+                else:
+                    if expanded is None:
+                        expanded = self._expand_chunk(ev)
+                    self._send_chunk_expanded(conn, ev, expanded, ts)
+            except (wire.WireError, OSError):
+                self._detach(conn)
+
+    def _expand_chunk(self, ev: FlipChunk):
+        """Per-turn (coords, bitmap, words) triples of one chunk, for
+        peers still on per-turn frames — None entries for flip-less
+        turns. Built once per chunk, shared across such peers."""
+        W, H = self.params.image_width, self.params.image_height
+        counts = np.asarray(ev.counts, np.int64)
+        offs = np.zeros(len(counts) + 1, np.int64)
+        np.cumsum(counts, out=offs[1:])
+        out = []
+        for t in range(len(counts)):
+            if not counts[t]:
+                out.append(None)
+                continue
+            words = ev.words[offs[t]:offs[t + 1]]
+            bm = np.asarray(ev.bitmaps[t], np.uint32)
+            out.append((wire.words_to_coords(bm, words, W, H), bm, words))
+        return out
+
+    def _send_chunk_expanded(self, conn: _Conn, ev: FlipChunk,
+                             expanded, ts: float) -> None:
+        """One chunk to one per-turn peer: exactly the flips-then-
+        TurnComplete stream the per-turn emit path produced, turn by
+        turn (synced_turn still gates per turn — a chunk may straddle
+        this peer's sync)."""
+        W, H = self.params.image_width, self.params.image_height
+        for t, entry in enumerate(expanded):
+            turn = ev.first_turn + t
+            if turn <= conn.synced_turn:
+                continue
+            if entry is not None and conn.want_flips:
+                coords, bm, words = entry
+                with tracing.span("wire.encode_flips", "wire",
+                                  turn=turn):
+                    _encode_and_send_flips(conn, turn, coords, None,
+                                           W, H, (bm, words))
+            conn.send({"t": "ev", "k": "turn", "turn": turn, "ts": ts})
+
     def _broadcast_loop(self) -> None:
         """Single consumer of the engine's event stream, fanning out to
         the driver and every observer (r5 multi-observer serving); each
@@ -1150,6 +1296,14 @@ class EngineServer:
                         flips = []
                         flips_levels = None
                     flips.append([ev.cell.x, ev.cell.y])
+                continue
+            if isinstance(ev, FlipChunk):
+                # The chunk-granular stream (batching watchers
+                # attached): k turns in one event — ONE wire frame per
+                # batch peer, per-turn expansion only for peers that
+                # still consume per-turn frames.
+                if conns:
+                    self._broadcast_chunk(ev, conns)
                 continue
             if not conns:
                 flips = []
@@ -1262,6 +1416,29 @@ class EngineServer:
                 flips_levels = None
 
 
+def encode_batch_frames(counts, bitmaps, words, first_turn: int,
+                        width: int, height: int, bsize: int,
+                        ts: float) -> "list[bytes]":
+    """One chunk's _TAG_FBATCH frames for a peer whose negotiated
+    max-k is `bsize`: the chunk splits into ceil(k/bsize) independent
+    frames (each self-contained — `wire.chunk_deltas` re-bases the
+    turn-axis delta at every segment start). Shared by the singleton
+    broadcaster and the per-session sinks; observes the per-frame
+    batch-size histogram."""
+    total, nb = wire.grid_words(width, height)
+    k = len(counts)
+    frames = []
+    for a in range(0, k, bsize):
+        b = min(a + bsize, k)
+        dc, dbm, dw = wire.chunk_deltas(counts, bitmaps, words,
+                                        a, b, total)
+        frames.append(wire.flip_batch_to_frame(
+            first_turn + a, nb, dc, dbm, dw, ts
+        ))
+        _METRICS.batch_turns.observe(b - a)
+    return frames
+
+
 class _SessionSink:
     """gol_tpu.sessions.Sink feeding one attached connection: board
     syncs, per-turn flips in the connection's negotiated encoding, and
@@ -1282,6 +1459,48 @@ class _SessionSink:
     @property
     def want_flips(self) -> bool:
         return self._conn.want_flips
+
+    @property
+    def batch_turns(self) -> int:
+        """Negotiated k-turn chunk consumption (hello "batch"): a
+        positive value makes the manager hand this sink whole chunks
+        via on_flip_chunk and scale the bucket's dispatch chunk."""
+        return self._conn.batch if self._conn.want_flips else 0
+
+    def on_flip_chunk(self, sid: str, first_turn: int, counts,
+                      bitmaps, words) -> None:
+        """One dispatched chunk for this session as _TAG_FBATCH
+        frame(s) — the per-session twin of the singleton broadcaster's
+        chunk fan-out: per-chunk housekeeping, shedding at batch
+        granularity, encode gated after offer_stream."""
+        conn = self._conn
+        if conn.lag_metric is not None:
+            conn.lag_metric.set(conn._out.qsize())
+        if conn.drained():
+            conn.resync_pending = True
+            mgr = self._server.manager
+            self.on_sync(sid, mgr.peek_turn(sid), mgr._fetch_board(sid))
+            return
+        k = len(counts)
+        last = first_turn + k - 1
+        if not conn.synced or last <= conn.synced_turn:
+            return
+        try:
+            if not conn.offer_stream():
+                return
+            tracing.event("turn.emit", "wire", turn=last, session=sid,
+                          batch=k)
+            with tracing.span("wire.encode_batch", "wire", turn=last,
+                              session=sid, turns=k):
+                frames = encode_batch_frames(
+                    counts, bitmaps, words, first_turn,
+                    self._width, self._height, conn.batch, time.time(),
+                )
+            for f in frames:
+                conn.send_raw(f)
+        except (wire.WireError, OSError):
+            self._server._drop_conn(conn, detach_sink=False)
+            raise
 
     def on_sync(self, sid: str, turn: int, board) -> None:
         conn = self._conn
@@ -1399,10 +1618,12 @@ class SessionServer:
         high_water: Optional[int] = None,
         drain_secs: Optional[float] = None,
         retry_after_secs: float = 1.0,
+        batch_turns: int = 1024,
     ):
         from gol_tpu.sessions import SessionEngine, SessionManager
 
         self.params = params
+        self.batch_turns = max(0, batch_turns)
         self.heartbeat_secs = max(0.0, heartbeat_secs)
         self.evict_secs = (
             evict_secs if evict_secs is not None
@@ -1572,6 +1793,7 @@ class SessionServer:
                      levels=bool(hello.get("levels", False)),
                      role=role, hb=hb,
                      delta=bool(hello.get("delta", False)),
+                     batch=_clamp_batch(hello, self.batch_turns),
                      high_water=self.high_water,
                      drain_secs=self.drain_secs)
         if sid is not None and role == "drive":
@@ -1594,6 +1816,8 @@ class SessionServer:
         _METRICS.attaches[role].inc()
         install_lag_gauge(conn)
         ack = {"t": "attach-ack", "clock": True, "sessions": True}
+        if conn.batch:
+            ack["batch"] = conn.batch
         if sid is not None:
             ack["session"] = sid
         if hb:

@@ -23,7 +23,8 @@ wire_watched ran at the device-link bound, ~10-12 MB/s).
 Message catalog:
   controller → engine:
     {"t":"hello","want_flips":bool[,"secret":s][,"compact":bool]
-                 [,"binary":bool][,"session":id][,"sessions":true]}
+                 [,"binary":bool][,"batch":K][,"session":id]
+                 [,"sessions":true]}
         attach + subscription (the secret authenticates when the server
         was started with one — the reference's :8030 listener was open
         to any peer, ref: gol/distributor.go:49-52; that is a flaw to
@@ -63,6 +64,25 @@ Message catalog:
         BoardSync on both ends; turns with no flips send no frame and
         do not advance the chain. VERDICT r5 item 7, productized
         behind the byte measurement in BENCH_DETAIL `wire_delta_sparse`.
+    k-turn flip batches (binary tag 7, negotiated via hello "batch":
+    max-k; requires "binary"):
+        ONE frame carries up to max-k turns of changed-word XOR masks,
+        delta-compressed along the TURN axis: turn i's changed-word set
+        rides as D[i] = S[i] XOR S[i-1] (D[0] = S[0] raw), so a settled
+        board — where consecutive turns flip the same cells — collapses
+        to one turn's payload per batch. Frames are SELF-CONTAINED (the
+        first turn always ships raw), which is how the delta chain
+        "resets" at BoardSync: no encoder/decoder state ever crosses a
+        frame, so a resync can never decode against a stale chain (the
+        property _TAG_DFLIPS maintains by explicit per-peer resets).
+        The header stamps the batch's emit wall clock once — turn
+        latency is measured emit-of-batch → apply-of-batch
+        (gol_tpu_client_batch_latency_seconds, NOT the per-turn
+        histogram: docs/OBSERVABILITY.md "Batch latency semantics").
+        This frame is the watched-path throughput fix (ROADMAP item 1):
+        per-turn frames cap a watched 512² session at ~300 turns/s;
+        batch frames lift it past 100k (BENCH_DETAIL
+        `wire_watched_512x512_batch`).
     {"t":"ev", ...}                   one serialized Event (below)
     {"t":"detached"}                  'q' acknowledged; engine lives on
     {"t":"bye"}                       stream over (final turn or 'k')
@@ -233,12 +253,21 @@ def _recv_exact(sock: socket.socket, n: int, allow_eof: bool) -> Optional[bytes]
 #: (0x7b), so any tag < 0x20 is unambiguous.
 _TAG_FLIPS, _TAG_BOARD, _TAG_FINAL, _TAG_LFLIPS, _TAG_HB = 1, 2, 3, 4, 5
 _TAG_DFLIPS = 6
+_TAG_FBATCH = 7
 _FLIPS_HDR = struct.Struct("<BQ")       # tag, turn
 _BOARD_HDR = struct.Struct("<BQIIQ")    # tag, turn, width, height, token
 _FINAL_HDR = struct.Struct("<BQ")       # tag, turn
 _LFLIPS_HDR = struct.Struct("<BQI")     # tag, turn, coords-blob bytes
 _HB_HDR = struct.Struct("<BQ")          # tag, turn (liveness beacon)
 _DFLIPS_HDR = struct.Struct("<BQII")    # tag, turn, changed words, bitmap-blob bytes
+#: tag, first turn, k (turns), nb (bitmap words/turn), emit ts, then
+#: the three blob lengths: per-turn delta counts, delta bitmaps (one
+#: row per nonzero-count turn), delta word masks (Σcounts values).
+_FBATCH_HDR = struct.Struct("<BQIIdIII")
+#: Turns one batch frame may claim — far above any negotiable max-k
+#: (the engine's diff-chunk budget caps real batches in the hundreds
+#: to low thousands); a header claiming more is an attack, not a peer.
+FBATCH_MAX_TURNS = 1 << 16
 
 
 def _coords_to_frame(hdr: struct.Struct, tag: int, turn: int,
@@ -354,6 +383,185 @@ def heartbeat_to_frame(turn: int) -> bytes:
     return _HB_HDR.pack(_TAG_HB, turn)
 
 
+# --- k-turn flip batches (negotiated via hello "batch") ---
+
+#: Raw-payload ceiling under which a batch blob is worth deflating.
+#: Measured on the serving container: zlib level 1 runs ~20 MB/s on
+#: incompressible word masks — fine for the few-KB payloads a settled
+#: board produces per batch, ruinous on the multi-MB payloads of an
+#: active board (it would cost more wall time than the link saves on
+#: loopback/LAN). Each blob carries a codec byte, so the choice is
+#: per-blob and per-frame, never negotiated.
+FBATCH_ZLIB_MAX = 64 << 10
+
+
+def _pack_blob(raw: bytes) -> bytes:
+    """codec byte (0 = raw, 1 = zlib) + payload."""
+    if len(raw) <= FBATCH_ZLIB_MAX:
+        z = zlib.compress(raw, 1)
+        if len(z) < len(raw):
+            return b"\x01" + z
+    return b"\x00" + raw
+
+
+def _unpack_blob(blob: bytes, limit: int) -> bytes:
+    """Decode one codec-tagged batch blob with a hard output bound
+    (the caller knows the exact expected size from the header)."""
+    if not blob:
+        raise WireError("empty batch blob")
+    codec, data = blob[0], blob[1:]
+    if codec == 0:
+        if len(data) > limit:
+            raise WireError(
+                f"batch blob of {len(data)} bytes exceeds {limit}"
+            )
+        return data
+    if codec == 1:
+        return _decompress(data, limit=max(limit, 1))
+    raise WireError(f"unknown batch blob codec {codec}")
+
+
+def _bitmap_indices(bitmap_row) -> np.ndarray:
+    """Set-bit positions of one changed-word bitmap row, ascending —
+    the word indices its masks land at."""
+    shifts = np.arange(32, dtype=np.uint32)
+    return np.flatnonzero((bitmap_row[:, None] >> shifts) & 1)
+
+
+def _indices_to_bitmap(idx, nb: int) -> np.ndarray:
+    bm = np.zeros(nb, np.uint32)
+    np.bitwise_or.at(
+        bm, (idx >> 5).astype(np.int64),
+        np.uint32(1) << (idx & 31).astype(np.uint32),
+    )
+    return bm
+
+
+def chunk_deltas(counts, bitmaps, values, a: int, b: int,
+                 total_words: int):
+    """Turn-axis delta of one chunk segment: per-turn S-sparse rows
+    (`counts` (k,), changed-word `bitmaps` (k, nb) uint32, `values`
+    (Σcounts,) uint32 masks in ascending word order per turn — the
+    device compact layout) for turns [a, b) become (dcounts,
+    dbitmaps, dwords) where row i is D[i] = S[a+i] XOR S[a+i-1]
+    (D[0] = S[a] raw: frames are self-contained). `dbitmaps` carries
+    one row per NONZERO dcount, in turn order.
+
+    The dominant case — a settled board, where S[t] == S[t-1] exactly
+    — is detected by whole-array comparison (no per-word work); only
+    genuinely differing adjacent turns pay a dense XOR of their two
+    scattered rows."""
+    counts = np.asarray(counts, np.int64)
+    k = b - a
+    offs = np.zeros(len(counts) + 1, np.int64)
+    np.cumsum(counts, out=offs[1:])
+    cnts = counts[a:b]
+    bms = np.asarray(bitmaps, np.uint32)[a:b]
+    same = np.zeros(k, bool)
+    if k > 1:
+        cand = (cnts[1:] == cnts[:-1]) & (bms[1:] == bms[:-1]).all(axis=1)
+        if cand.any():
+            if (cnts == cnts[0]).all() and cnts[0] > 0:
+                # Uniform counts (the settled steady state): one
+                # reshaped compare settles value equality for every
+                # adjacent pair at once.
+                v = values[offs[a]:offs[b]].reshape(k, int(cnts[0]))
+                same[1:] = cand & (v[1:] == v[:-1]).all(axis=1)
+            else:
+                for t in (np.flatnonzero(cand) + 1):
+                    lo, hi = offs[a + t], offs[a + t + 1]
+                    plo, phi = offs[a + t - 1], offs[a + t]
+                    same[t] = np.array_equal(values[lo:hi],
+                                             values[plo:phi])
+    dcounts = np.zeros(k, np.uint32)
+    drows = []
+    dparts = []
+    for t in range(k):
+        if t and same[t]:
+            continue  # D[t] == 0
+        lo, hi = offs[a + t], offs[a + t + 1]
+        if t == 0:
+            if cnts[0]:
+                dcounts[0] = cnts[0]
+                drows.append(bms[0])
+                dparts.append(values[lo:hi])
+            continue
+        d = np.zeros(total_words, np.uint32)
+        d[_bitmap_indices(bms[t])] = values[lo:hi]
+        plo, phi = offs[a + t - 1], offs[a + t]
+        d[_bitmap_indices(bms[t - 1])] ^= values[plo:phi]
+        nz = np.flatnonzero(d)
+        if nz.size:
+            dcounts[t] = nz.size
+            drows.append(_indices_to_bitmap(nz, bms.shape[1]))
+            dparts.append(d[nz])
+    nb = bms.shape[1]
+    dbitmaps = (np.stack(drows) if drows
+                else np.zeros((0, nb), np.uint32))
+    dwords = (np.concatenate(dparts) if dparts
+              else np.zeros(0, np.uint32))
+    return dcounts, dbitmaps, dwords
+
+
+def flip_batch_to_frame(first_turn: int, nb: int, dcounts, dbitmaps,
+                        dwords, ts: float) -> bytes:
+    """Assemble one _TAG_FBATCH frame from turn-axis deltas (the
+    `chunk_deltas` output shape)."""
+    dcounts = np.ascontiguousarray(dcounts, np.uint32)
+    dbitmaps = np.ascontiguousarray(dbitmaps, np.uint32)
+    dwords = np.ascontiguousarray(dwords, np.uint32)
+    blobs = [_pack_blob(dcounts.tobytes()),
+             _pack_blob(dbitmaps.tobytes()),
+             _pack_blob(dwords.tobytes())]
+    return _FBATCH_HDR.pack(
+        _TAG_FBATCH, first_turn, len(dcounts), nb, ts,
+        len(blobs[0]), len(blobs[1]), len(blobs[2]),
+    ) + b"".join(blobs)
+
+
+def _parse_fbatch(payload: bytes) -> dict:
+    (_, first, k, nb, ts, lc, lb, lw) = _FBATCH_HDR.unpack_from(payload)
+    if not 0 < k <= FBATCH_MAX_TURNS:
+        raise WireError(f"implausible batch turn count {k}")
+    if not 0 < nb <= MAX_RAW // 4:
+        raise WireError(f"implausible batch bitmap width {nb}")
+    body = payload[_FBATCH_HDR.size:]
+    if lc + lb + lw != len(body):
+        raise WireError("batch blobs disagree with the frame length")
+    craw = _unpack_blob(body[:lc], 4 * k)
+    if len(craw) != 4 * k:
+        raise WireError(
+            f"batch header says {k} turns, counts blob carries "
+            f"{len(craw)} bytes"
+        )
+    counts = np.frombuffer(craw, np.uint32)
+    nnz = int(np.count_nonzero(counts))
+    total = int(counts.sum(dtype=np.int64))
+    if total > MAX_RAW // 4 or nnz * nb > MAX_RAW // 4:
+        raise WireError(f"implausible batch payload ({total} words)")
+    braw = _unpack_blob(body[lc:lc + lb], 4 * nnz * nb)
+    if len(braw) != 4 * nnz * nb:
+        raise WireError(
+            f"batch bitmap blob of {len(braw)} bytes, {nnz} nonzero "
+            f"turns x {nb} words expected"
+        )
+    wraw = _unpack_blob(body[lc + lb:], 4 * total)
+    if len(wraw) != 4 * total:
+        raise WireError(
+            f"batch counts sum to {total} words, mask blob carries "
+            f"{len(wraw)} bytes"
+        )
+    dbitmaps = np.frombuffer(braw, np.uint32).reshape(nnz, nb)
+    # Every nonzero turn's bitmap must pop exactly its count — a lying
+    # count would misalign every later turn's mask slice.
+    pops = np.bitwise_count(dbitmaps).sum(axis=1, dtype=np.int64)
+    if not np.array_equal(pops, counts[counts > 0].astype(np.int64)):
+        raise WireError("batch bitmap popcounts disagree with counts")
+    return {"t": "fbatch", "first_turn": first, "k": k, "nb": nb,
+            "ts": ts, "counts": counts, "dbitmaps": dbitmaps,
+            "dwords": np.frombuffer(wraw, np.uint32)}
+
+
 def _coords_from(blob: bytes) -> np.ndarray:
     raw = _decompress(blob)
     if len(raw) % 8:
@@ -430,6 +638,8 @@ def _parse_frame_inner(payload: bytes) -> dict:
         return {"t": "dflips", "turn": turn,
                 "dbitmap": np.frombuffer(braw, np.uint32),
                 "dwords": np.frombuffer(wraw, np.uint32)}
+    if tag == _TAG_FBATCH:
+        return _parse_fbatch(payload)
     if tag == _TAG_HB:
         _, turn = _HB_HDR.unpack_from(payload)
         return {"t": "hb", "turn": turn}

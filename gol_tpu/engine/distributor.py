@@ -51,6 +51,7 @@ from gol_tpu.events import (
     Event,
     FinalTurnComplete,
     FlipBatch,
+    FlipChunk,
     ImageOutputComplete,
     State,
     StateChange,
@@ -127,7 +128,7 @@ class _EngineMetrics:
     is process-global, like the reference's single event stream."""
 
     def __init__(self):
-        kinds = ("chunk", "diff", "diffs")
+        kinds = ("chunk", "diff", "diffs", "ride")
         self.dispatches = {
             k: obs.counter(
                 "gol_tpu_engine_dispatches_total",
@@ -224,6 +225,41 @@ class EventQueue:
     def put(self, ev: Event) -> None:
         self._q.put(ev)
 
+    def put_many(self, evs) -> None:
+        """Enqueue a whole batch under ONE lock acquisition. At the
+        batched-wire rates (10⁵ events/s) the per-put lock handshake
+        of queue.Queue is itself a measured ~5µs/event ceiling; this
+        reaches into the documented queue internals (mutex / queue /
+        not_empty — the attributes queue.Queue subclassing is built
+        on) to amortize it."""
+        q = self._q
+        with q.mutex:
+            q.queue.extend(evs)
+            q.unfinished_tasks += len(evs)
+            q.not_empty.notify_all()
+
+    def get_batch(self, max_n: int = 4096,
+                  timeout: Optional[float] = None) -> Optional[list]:
+        """Up to `max_n` queued events in one call: blocks for the
+        first like `get`, then drains whatever else is already queued
+        under one lock — the consumer-side twin of `put_many`. None
+        once the queue is closed and drained; `queue.Empty` on a
+        timeout with nothing queued (exactly `get`'s contract)."""
+        first = self.get(timeout=timeout)
+        if first is None:
+            return None
+        out = [first]
+        q = self._q
+        with q.mutex:
+            while len(out) < max_n and q.queue:
+                item = q.queue[0]
+                if item is _CLOSE:
+                    break  # keep the sentinel for the next get
+                q.queue.popleft()
+                out.append(item)
+        self._consumed += len(out) - 1
+        return out
+
     def qsize(self) -> int:
         """Approximate backlog — the producer-side backpressure signal
         (the reference throttles via its 1000-slot channel buffer,
@@ -281,6 +317,7 @@ class Engine:
         emit_flips: bool = True,
         emit_turns: Optional[bool] = None,
         emit_flip_batches: bool = False,
+        emit_flip_chunks: bool = False,
         initial_world: Optional[np.ndarray] = None,
         start_turn: int = 0,
         io_service: Optional[IOService] = None,
@@ -297,6 +334,20 @@ class Engine:
         # that apply flips vectorized (the engine server, the local
         # visualiser); the per-cell stream stays the reference contract.
         self.emit_flip_batches = emit_flip_batches
+        # Whole diff chunks as ONE FlipChunk event (events.FlipChunk)
+        # instead of k (FlipBatch, TurnComplete) pairs — the emit path
+        # behind the batched wire (ROADMAP item 1): at 10⁵ turns/s the
+        # per-turn Python event objects are the measured bottleneck.
+        # Live-togglable (the server re-derives it from attached
+        # peers); engages only where the chunk layout is exact — see
+        # _chunk_mode.
+        self.emit_flip_chunks = emit_flip_chunks
+        #: Turns per diff dispatch a batching watcher asked for (the
+        #: negotiated hello "batch" max-k, via the server). 0 = none;
+        #: a positive hint RAISES the DIFF_CHUNK budget so a watcher
+        #: that consumes k-turn frames isn't capped at the interactive
+        #: chunk size (ISSUE 10's chunk-pinning fix).
+        self.batch_turns_hint = 0
         # Per-turn TurnComplete in the fused-chunk path is pure overhead
         # when nothing consumes per-turn granularity — a 10^10-turn
         # headless run would spend its host time on queue puts (VERDICT
@@ -393,6 +444,20 @@ class Engine:
         # _run_diff_chunk). Starts off; the first plain chunk's observed
         # activity enables it.
         self._sparse_cap: Optional[int] = None
+        # Cycle-RIDING state for the watched chunk path (the watched
+        # twin of the fused path's cycle fast-forward, r10): once the
+        # detector proves the board periodic and a probe pins a small
+        # period m, chunks of whole periods are SYNTHESIZED from the
+        # recorded period's diff rows — no device dispatch, turn
+        # numbers stay dense, every emitted flip bit-exact by the
+        # device-side equality proof. Only with Params.cycle_detect,
+        # only in chunk mode (see _maybe_create_ride).
+        self._ride: Optional[dict] = None
+        self._ride_probe_due = False
+        self._ride_cycles = (
+            CycleDetector(min(cycle_check_seconds, 1.0))
+            if params.cycle_detect else None
+        )
         # In-flight chunk of the pipelined diff path (see
         # _diff_pipeline_step); engine thread only.
         self._pending_diffs: Optional[dict] = None
@@ -581,6 +646,28 @@ class Engine:
                 break
             if self.emit_flips:
                 if self.stepper.step_n_with_diffs is not None:
+                    if self._ride is not None:
+                        new_turn = self._ride_step(turn)
+                        if new_turn != turn:
+                            turn = new_turn
+                            world = self._committed[1]
+                            continue
+                        # Ride abandoned without emitting: fall
+                        # through to a real dispatch (the committed
+                        # world is the true phase-0 board, so real
+                        # stepping resumes seamlessly).
+                    elif self._ride_probe_due:
+                        self._ride_probe_due = False
+                        # The in-flight pipelined chunk (if any) is
+                        # superseded: its turns re-emit from the ride
+                        # (or from a fresh dispatch off the same
+                        # committed world if the probe fails) — its
+                        # events were never emitted, so nothing is
+                        # lost or doubled.
+                        self._pending_diffs = None
+                        self._maybe_create_ride(turn)
+                        if self._ride is not None:
+                            continue
                     if self.stepper.fetch_diffs is None:
                         # Single-device: overlap each chunk's transfer
                         # with the previous chunk's fan-out.
@@ -615,7 +702,10 @@ class Engine:
                 self._maybe_autosave(turn, world)
             else:
                 # A controller detach mid-pipeline switches paths: the
-                # in-flight diff chunk's turns must land first.
+                # in-flight diff chunk's turns must land first. Any
+                # cycle ride is dropped — fused stepping moves the
+                # board off the ride's phase anchor.
+                self._ride = None
                 turn = self._flush_pending_diffs(turn)
                 world = self._committed[1]
                 if cal is not None and not self.emit_turns:
@@ -831,6 +921,119 @@ class Engine:
             self._pending_diffs = None
         return turn
 
+    #: Longest exact period the watched cycle ride will record. The
+    #: ride holds one period of S-sparse diff rows host-side plus the
+    #: phase-0 device world — a 1024-turn period of a settled 512²
+    #: board is ~6 MB of host arrays.
+    RIDE_MAX_PERIOD = 1024
+
+    def _maybe_create_ride(self, turn: int) -> None:
+        """Pin an exact small period and record one period's diffs —
+        the watched twin of the fused cycle fast-forward. The anchor
+        walk (CycleDetector) already PROVED the committed world equals
+        an earlier state; this probe walks forward in doubling
+        segments recording the per-turn diff rows, and finds the
+        smallest period HOST-side: world(t) == world(0) exactly when
+        the XOR of the recorded diffs S[1..t] cancels, so a prefix-XOR
+        scan over the walked stack detects ANY period ≤ the walk —
+        period-3/6/15 oscillators included, not just divisors of the
+        walk length. Failure (no period within RIDE_MAX_PERIOD — e.g.
+        a torus-circumnavigating glider) costs one bounded walk, backs
+        the next probe off exponentially (a genuinely aperiodic board
+        must not pay a recurring probe tax), and the run continues
+        stepping for real."""
+        from gol_tpu.parallel.stepper import sparse_chunk_from_dense
+
+        world, count = self._committed[1], self._committed[2]
+        if (world is None or not self._chunk_mode()
+                or self._ride_cycles is None):
+            return
+        fetch = self.stepper.fetch_diffs or np.asarray
+        segs = []
+        cur = world
+        q = 0
+        step = 2
+        m = None
+        while q + step <= self.RIDE_MAX_PERIOD:
+            with device.cause("cycle-probe"):
+                nxt, diffs, _c = self.stepper.step_n_with_diffs(
+                    cur, step
+                )
+            segs.append(
+                np.asarray(fetch(diffs)).reshape(step, -1)
+                .view(np.uint32)
+            )
+            cur = nxt
+            q += step
+            stack = np.concatenate(segs, axis=0)
+            prefix = np.bitwise_xor.accumulate(stack, axis=0)
+            zero = np.flatnonzero(~prefix.any(axis=1))
+            if zero.size:
+                m = int(zero[0]) + 1
+                break
+            step = q  # segments 2, 2, 4, 8, ... — cumulative doubling
+        if m is None:
+            # Exponential probe backoff: double the detector's compare
+            # interval each failure, so an anchor-revisiting board
+            # with only LARGE periods stops paying the walk.
+            self._ride_cycles.interval = min(
+                self._ride_cycles.interval * 2, 300.0
+            )
+            tracing.event("engine.ride_probe_failed", "engine",
+                          turn=turn, walked=q)
+            return
+        counts, bitmaps, words = sparse_chunk_from_dense(stack[:m])
+        # Whole periods per synthesized chunk, tiled up to the chunk
+        # budget — Params.chunk still paces the ride (an operator's
+        # explicit pacing bounds burst size for per-turn peers), with
+        # one period as the floor; frames to batch peers split further
+        # by their negotiated max-k.
+        budget = self._diff_chunk_budget()
+        if self.p.chunk > 0:
+            budget = min(budget, self.p.chunk)
+        r = max(1, budget // m)
+        self._ride = {
+            "m": m, "r": r, "world": world, "count": count,
+            "wpp": int(counts.sum()),
+            "counts": np.tile(counts, r),
+            "bitmaps": np.tile(bitmaps, (r, 1)),
+            "words": np.tile(words, r),
+        }
+        tracing.event("engine.ride_start", "engine", turn=turn,
+                      period=m, tile=r)
+        flight.note("engine.ride_start", turn=turn, period=m)
+
+    def _ride_step(self, turn: int) -> int:
+        """Emit one synthesized chunk of whole proven periods: no
+        device dispatch, the committed world stays the REAL phase-0
+        board (every chunk is a whole number of periods, so syncs,
+        snapshots and the final output all read a world that exactly
+        matches the committed turn). Returns `turn` unchanged when the
+        ride must stand down (consumer mix changed, or fewer than one
+        period of turns remains — the tail steps for real)."""
+        ride = self._ride
+        m = ride["m"]
+        r = min(ride["r"], (self.p.turns - turn) // m)
+        if r <= 0 or not self._chunk_mode():
+            self._ride = None
+            return turn
+        k = r * m
+        self.events.put(FlipChunk(
+            turn + k, first_turn=turn + 1,
+            counts=ride["counts"][:k],
+            bitmaps=ride["bitmaps"][:k],
+            words=ride["words"][:ride["wpp"] * r],
+        ))
+        _METRICS.dispatches["ride"].inc()
+        _METRICS.turns["ride"].inc(k)
+        tracing.event("engine.dispatch", "engine", kind="ride",
+                      turn=turn + k, turns=k)
+        self._commit(turn + k, ride["world"], ride["count"])
+        turn += k
+        self._throttle_events()
+        self._maybe_autosave(turn, ride["world"])
+        return turn
+
     def _diff_dispatch(self, turn: int) -> dict:
         """Dispatch one diff chunk starting after `turn` completed
         turns and start its host transfer; no host-blocking work.
@@ -848,7 +1051,8 @@ class Engine:
         pipelined = self._pending_diffs is not None or (
             self.stepper.fetch_diffs is None
         )
-        k = min(DIFF_CHUNK, self._diff_chunk_cap(pipelined), p.turns - turn)
+        k = min(self._diff_chunk_budget(), self._diff_chunk_cap(pipelined),
+                p.turns - turn)
         if p.chunk > 0:
             k = min(k, p.chunk)
         if p.autosave_turns > 0:
@@ -905,6 +1109,17 @@ class Engine:
         pending.update(new_world=new_world, buf=buf, count=count)
         return pending
 
+    def _diff_chunk_budget(self) -> int:
+        """Turns per diff dispatch before the memory cap: DIFF_CHUNK,
+        RAISED to a batching watcher's negotiated max-k
+        (batch_turns_hint) — the chunk is what one wire frame carries,
+        so pinning it at the interactive size would cap the batched
+        path's amortization at DIFF_CHUNK regardless of negotiation.
+        Verb latency within a chunk's wall time stays bounded: a
+        batching watcher explicitly traded per-turn interactivity for
+        throughput."""
+        return max(DIFF_CHUNK, self.batch_turns_hint)
+
     def _compact_total_cap(self, k: int) -> int:
         """Value-buffer size for the next compact chunk: the maximum
         turns a chunk can carry times the per-turn activity cap the
@@ -916,7 +1131,7 @@ class Engine:
         burst headroom instead of a proportionally tinier buffer that
         a single active turn could overflow. `max(..., k)` is only a
         guard; k never exceeds the budget by construction."""
-        budget = min(DIFF_CHUNK, self._diff_chunk_cap(False))
+        budget = min(self._diff_chunk_budget(), self._diff_chunk_cap(False))
         if self.p.chunk > 0:
             budget = min(budget, self.p.chunk)
         return max(budget, k) * self._sparse_cap
@@ -935,6 +1150,19 @@ class Engine:
             per_turn //= 8
         return max(1, budget // max(per_turn, 1))
 
+    def _chunk_mode(self) -> bool:
+        """True when diff chunks should emit as ONE FlipChunk event:
+        a chunk consumer asked for it AND the per-turn diff layout is
+        the packed vertical-word grid the wire's changed-word
+        convention mirrors exactly (wire.grid_words). Everything else
+        — gens level streams, dense-mask backends, ragged heights —
+        keeps the per-turn path (consumers negotiate batches as an
+        optimization, never a requirement)."""
+        return (self.emit_flip_chunks and self.emit_flip_batches
+                and self._gens_levels is None
+                and bool(self.stepper.packed_diffs)
+                and self.p.image_height % 32 == 0)
+
     def _diff_consume(self, turn: int, pending: dict) -> int:
         """Materialize one dispatched diff chunk: decode (with the
         sparse-overflow dense fallback), commit, emit, autosave.
@@ -944,28 +1172,43 @@ class Engine:
         alive sample) can run up to the chunk size ahead of what event
         consumers have drained — the same observability skew as the
         fused path; the event stream content itself is identical to
-        the per-turn path (pinned by tests/test_diffs.py)."""
+        the per-turn path (pinned by tests/test_diffs.py).
+
+        With a chunk consumer attached (_chunk_mode) the whole decoded
+        stack emits as ONE FlipChunk event in the device's S-sparse
+        layout — no dense row scatter, no per-turn event objects: the
+        two costs that capped the watched path at ~300 turns/s."""
         k = pending["k"]
         new_world, count = pending["new_world"], pending["count"]
+        chunk_mode = self._chunk_mode()
         rows = None
+        chunk = None
         encoded = (pending["sparse_cap"] is not None
                    or pending["compact_cap"] is not None)
         if pending["compact_cap"] is not None:
-            rows = self._decode_compact(pending)
-            if rows is None:  # Σ counts burst past the value buffer
+            got = (self._chunk_from_compact(pending) if chunk_mode
+                   else self._decode_compact(pending))
+            if got is None:  # Σ counts burst past the value buffer
                 _METRICS.compact_redos.inc()
                 tracing.event("engine.compact_redo", "engine",
                               turn=turn + k,
                               total_cap=pending["compact_cap"])
                 flight.note("engine.compact_redo", turn=turn + k)
         elif pending["sparse_cap"] is not None:
-            rows = self._decode_sparse(pending)
-            if rows is None:  # truncated: the board burst past the cap
+            got = (self._chunk_from_sparse(pending) if chunk_mode
+                   else self._decode_sparse(pending))
+            if got is None:  # truncated: the board burst past the cap
                 _METRICS.sparse_redos.inc()
                 tracing.event("engine.sparse_redo", "engine",
                               turn=turn + k, cap=pending["sparse_cap"])
                 flight.note("engine.sparse_redo", turn=turn + k)
-        if encoded and rows is None:
+        else:
+            got = None
+        if chunk_mode:
+            chunk = got
+        else:
+            rows = got
+        if encoded and rows is None and chunk is None:
             self._sparse_cap = None
             # The EXPLICIT redo entry when the stepper has one
             # (mirrored steppers broadcast a dedicated opcode so
@@ -977,7 +1220,7 @@ class Engine:
             with device.cause("diff-redo"):
                 new_world, diffs, count = redo(pending["world_before"], k)
             # (bit-identical to the discarded encoded result)
-        if rows is None:
+        if rows is None and chunk is None:
             if not encoded:
                 diffs = pending["buf"]
             sync0 = time.perf_counter()
@@ -985,8 +1228,20 @@ class Engine:
             t_host = time.perf_counter()
             pending["sync_s"] = (pending.get("sync_s", 0.0)
                                  + t_host - sync0)
-            rows = [host_diffs[i] for i in range(k)]
-            self._observe_diff_activity(rows)
+            if chunk_mode and np.asarray(host_diffs).dtype == np.uint32:
+                from gol_tpu.parallel.stepper import (
+                    sparse_chunk_from_dense,
+                )
+
+                chunk = sparse_chunk_from_dense(np.asarray(host_diffs))
+                if self.stepper.step_n_with_diffs_sparse is not None:
+                    counts_c = chunk[0]
+                    self._adapt_sparse_cap(
+                        int(counts_c.max()) if counts_c.size else 0
+                    )
+            else:
+                rows = [host_diffs[i] for i in range(k)]
+                self._observe_diff_activity(rows)
             pending["host_extra_s"] = (pending.get("host_extra_s", 0.0)
                                        + time.perf_counter() - t_host)
         # Pipelined spans overlap at dispatch time; clamping each
@@ -1007,6 +1262,38 @@ class Engine:
         if self.timeline:
             self.timeline.record(turn + k, k, now - start, "diffs")
         self._commit(turn + k, new_world, count)
+        if chunk is not None:
+            # Chunk-granular emission: the whole decoded stack as ONE
+            # event, atomically — no mid-emission window for syncs to
+            # defer around, no per-turn Python objects.
+            emit_tick = time.perf_counter()
+            counts_c, bitmaps_c, words_c = chunk
+            self.events.put(FlipChunk(
+                turn + k, first_turn=turn + 1, counts=counts_c,
+                bitmaps=bitmaps_c, words=words_c,
+            ))
+            emit_dt = time.perf_counter() - emit_tick
+            _METRICS.host_seconds.observe(emit_dt)
+            tracing.add_span("engine.emit", "engine",
+                             time.time() - emit_dt, emit_dt,
+                             {"turns": k, "turn": turn + k, "chunk": 1})
+            device.observe_split(
+                pending.get("enqueue_s"), pending.get("sync_s"),
+                emit_dt + pending.get("host_extra_s", 0.0),
+            )
+            turn += k
+            self._throttle_events()
+            self._maybe_autosave(turn, new_world)
+            if (self._ride_cycles is not None and self._ride is None
+                    and self.p.autosave_turns <= 0
+                    and self._ride_cycles.observe(turn, new_world)
+                    is not None):
+                # The anchor walk proved the board revisits an earlier
+                # state: schedule a period probe at the next loop
+                # boundary (never mid-consume — the pipeline may hold
+                # an in-flight chunk).
+                self._ride_probe_due = True
+            return turn
         # Sync requests must NOT be serviced while this chunk's rows
         # are mid-emission: a BoardSync carries the committed turn+k
         # world, and landing between row i and i+1 would put rows for
@@ -1074,25 +1361,23 @@ class Engine:
         pending["host_extra_s"] = time.perf_counter() - t_host
         return rows
 
-    def _decode_compact(self, pending: dict):
-        """Headers + used value prefix of a dispatched compact chunk ->
-        dense word rows, or None when the summed counts overran the
-        value buffer (overflow — the buffer holds dropped writes and
-        must not be trusted). The fetch is the whole point of the
-        encoding: 4k + k·nb·4 header bytes plus ~4·Σmₜ value bytes,
-        with the fixed per-turn value slab of the sparse rows gone."""
-        from gol_tpu.parallel.stepper import (
-            compact_decode_rows,
-            compact_value_prefix,
-        )
+    def _fetch_compact(self, pending: dict):
+        """Materialize a dispatched compact chunk's header stack and
+        used value prefix: (header, vals, total) with the sync-split
+        and link-cost accounting, or None when the summed counts
+        overran the value buffer (overflow — the buffer holds dropped
+        writes and must not be trusted). The fetch is the whole point
+        of the encoding: 4k + k·nb·4 header bytes plus ~4·Σmₜ value
+        bytes, with the fixed per-turn value slab of the sparse rows
+        gone."""
+        from gol_tpu.parallel.stepper import compact_value_prefix
 
         sync0 = time.perf_counter()
         header = np.ascontiguousarray(
             np.asarray(pending["buf"])
         ).view(np.uint32)
         pending["sync_s"] = time.perf_counter() - sync0
-        counts = header[:, 0]
-        total = int(counts.sum())
+        total = int(header[:, 0].sum())
         if total > pending["compact_cap"]:
             return None
         fetch_vals = (self.stepper.fetch_compact_values
@@ -1101,8 +1386,27 @@ class Engine:
         vals = np.asarray(fetch_vals(pending["values"], total))
         if vals.dtype != np.uint32:
             vals = np.ascontiguousarray(vals).view(np.uint32)
+        pending["sync_s"] += time.perf_counter() - sync0
+        # Actual link cost: the header stack plus the (bucketed) value
+        # prefix that was really fetched.
+        nbytes = header.nbytes + vals.nbytes
+        _METRICS.compact_bytes.inc(nbytes)
+        dense = pending["k"] * (self.p.image_height // 32) \
+            * self.p.image_width * 4
+        if dense:
+            _METRICS.compact_ratio.set(round(nbytes / dense, 5))
+        return header, vals, total
+
+    def _decode_compact(self, pending: dict):
+        """Compact chunk -> dense word rows, or None on overflow."""
+        from gol_tpu.parallel.stepper import compact_decode_rows
+
+        got = self._fetch_compact(pending)
+        if got is None:
+            return None
+        header, vals, _total = got
         t_host = time.perf_counter()
-        pending["sync_s"] += t_host - sync0
+        counts = header[:, 0]
         hw, w = self.p.image_height // 32, self.p.image_width
         rows = [
             words.reshape(hw, w)
@@ -1110,14 +1414,47 @@ class Engine:
         ]
         self._adapt_sparse_cap(int(counts.max()) if counts.size else 0)
         pending["host_extra_s"] = time.perf_counter() - t_host
-        # Actual link cost: the header stack plus the (bucketed) value
-        # prefix that was really fetched.
-        nbytes = header.nbytes + vals.nbytes
-        _METRICS.compact_bytes.inc(nbytes)
-        dense = pending["k"] * hw * w * 4
-        if dense:
-            _METRICS.compact_ratio.set(round(nbytes / dense, 5))
         return rows
+
+    def _chunk_from_compact(self, pending: dict):
+        """Compact chunk -> the (counts, bitmaps, values) S-sparse
+        triple a FlipChunk carries, or None on overflow. The device
+        layout IS the chunk layout — no dense scatter, just slices;
+        this is what makes the batched watched path's engine side
+        nearly free."""
+        got = self._fetch_compact(pending)
+        if got is None:
+            return None
+        header, vals, total = got
+        t_host = time.perf_counter()
+        counts = header[:, 0].astype(np.int64)
+        self._adapt_sparse_cap(int(counts.max()) if counts.size else 0)
+        pending["host_extra_s"] = time.perf_counter() - t_host
+        return counts, header[:, 1:], vals[:total]
+
+    def _chunk_from_sparse(self, pending: dict):
+        """Fixed-width sparse rows -> the FlipChunk S-sparse triple,
+        or None when any row was truncated (cap overflow)."""
+        from gol_tpu.parallel.stepper import sparse_bitmap_words
+
+        cap = pending["sparse_cap"]
+        sync0 = time.perf_counter()
+        host = np.ascontiguousarray(np.asarray(pending["buf"])).view(np.uint32)
+        t_host = time.perf_counter()
+        pending["sync_s"] = t_host - sync0
+        counts = host[:, 0].astype(np.int64)
+        if counts.size and int(counts.max()) > cap:
+            return None
+        hw, w = self.p.image_height // 32, self.p.image_width
+        nb = sparse_bitmap_words(hw * w)
+        bitmaps = host[:, 1:1 + nb]
+        parts = [host[t, 1 + nb:1 + nb + int(m)]
+                 for t, m in enumerate(counts) if m]
+        values = (np.concatenate(parts) if parts
+                  else np.zeros(0, np.uint32))
+        self._adapt_sparse_cap(int(counts.max()) if counts.size else 0)
+        pending["host_extra_s"] = time.perf_counter() - t_host
+        return counts, bitmaps, values
 
     def _sparse_cap_ceiling(self) -> int:
         total_words = (self.p.image_height // 32) * self.p.image_width
@@ -1360,8 +1697,13 @@ class Engine:
         stalled_since = None
         throttled = False
         last_consumed = self.events.consumed
+        # Chunk events are k-turn ARRAYS, not per-turn objects: a
+        # backlog of 10k of them would hold gigabytes, so the depth
+        # limit drops to a few dozen chunks (still tens of thousands
+        # of turns of slack for the consumer).
+        limit = 32 if self._chunk_mode() else 10_000
         while (
-            self.events.qsize() > 10_000
+            self.events.qsize() > limit
             and self._stop_reason is None
             and not self.events.closed
         ):

@@ -113,6 +113,39 @@ class FlipBatch(Event):
     levels: "object" = None
 
 
+@dataclasses.dataclass(frozen=True, eq=False)
+class FlipChunk(Event):
+    """Framework extension (no reference analog): a whole k-turn diff
+    chunk as ONE event — the chunk-granular emit path behind the
+    batched wire (ROADMAP item 1). Covers turns
+    `first_turn .. completed_turns` inclusive; per-turn changed
+    packed words ride in the device compact layout: `counts[t]`
+    changed words for turn `first_turn + t`, their positions as the
+    changed-word `bitmaps` row (uint32, bit i of word w = packed word
+    w*32+i changed — the wire.grid_words convention), and the words'
+    XOR `words` masks concatenated across turns in ascending word
+    order per turn. Semantically identical to k FlipBatch events each
+    followed by its TurnComplete; opt-in
+    (`Engine(emit_flip_chunks=True)`) because at 10⁵ turns/s the
+    per-turn Python event objects ARE the bottleneck — consumers
+    (the wire broadcaster) expand per turn only for peers that still
+    need per-turn delivery. Never logged."""
+
+    first_turn: int = 0
+    # (k,) int changed-word counts per turn.
+    counts: "object" = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int64)
+    )
+    # (k, nb) uint32 changed-word bitmaps, one row per turn.
+    bitmaps: "object" = dataclasses.field(
+        default_factory=lambda: np.zeros((0, 0), np.uint32)
+    )
+    # (Σcounts,) uint32 changed-word XOR masks.
+    words: "object" = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.uint32)
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class TurnComplete(Event):
     """A turn was committed (ref: gol/event.go:58-60). The visualiser

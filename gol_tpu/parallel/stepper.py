@@ -371,6 +371,35 @@ def compact_value_bucket(total: int) -> int:
     return -(-total // step) * step
 
 
+def sparse_chunk_from_dense(stack):
+    """(k, ...) uint32 dense packed diff stack -> the per-turn
+    S-sparse chunk triple (counts (k,) int64, changed-word bitmaps
+    (k, nb) uint32, values (Σcounts,) uint32 in ascending word order
+    per turn) — the exact layout `compact_scan_diffs` produces on
+    device, built host-side in one vectorized pass. Shared by the
+    engine and the session manager for chunk-granular emission of
+    chunks that ran the plain (un-encoded) diff path."""
+    import numpy as _np
+
+    S = _np.ascontiguousarray(stack).reshape(stack.shape[0], -1)
+    if S.dtype != _np.uint32:
+        S = S.view(_np.uint32)
+    k, total = S.shape
+    nb = sparse_bitmap_words(total)
+    changed = S != 0
+    # int32 is ample (counts are bounded by the board's word count)
+    # and keeps this host helper inside the kernel-module dtype
+    # contract the dtype-drift lint enforces.
+    counts = changed.sum(axis=1, dtype=_np.int32)
+    values = S[changed]
+    padded = (changed if nb * 32 == total
+              else _np.pad(changed, ((0, 0), (0, nb * 32 - total))))
+    bitmaps = _np.ascontiguousarray(
+        _np.packbits(padded, axis=1, bitorder="little")
+    ).view(_np.uint32).reshape(k, nb)
+    return counts, bitmaps, values
+
+
 def compact_value_prefix(values, total: int):
     """Fetch (at least) the first `total` words of a compact chunk's
     device value buffer as host uint32 — the bucketed device slice

@@ -92,9 +92,18 @@ class SessionEngine:
                 for b in buckets:
                     # Any watcher — flips or turn-events only — gets
                     # the short interactive chunk; the dispatch path
-                    # (diffs vs fused) is flip_watched's call.
-                    k = (self.watched_chunk if b.watched()
-                         else self.idle_chunk)
+                    # (diffs vs fused) is flip_watched's call. When
+                    # EVERY watcher on the bucket is a BATCHING one
+                    # (negotiated hello "batch"), the chunk rises to
+                    # the smallest negotiated max-k: they consume
+                    # whole k-turn frames, so pinning them at the
+                    # interactive size would cap throughput at
+                    # 16-turn hops (ISSUE 10's chunk-pinning fix) —
+                    # while one per-turn watcher anywhere in the
+                    # lockstep bucket keeps the interactive pacing
+                    # (see _Bucket.batch_hint).
+                    k = (max(self.watched_chunk, b.batch_hint())
+                         if b.watched() else self.idle_chunk)
                     with m._lock:
                         if b.live:
                             m._dispatch_bucket(b, k)

@@ -133,12 +133,30 @@ class Sink:
     #: `on_sync`/`on_turn`/`on_close`.
     want_flips = True
 
+    #: A POSITIVE value makes this sink chunk-granular: the manager
+    #: hands whole dispatched chunks to `on_flip_chunk` instead of the
+    #: per-turn on_flips/on_turn loop, and the SessionEngine scales
+    #: the bucket's dispatch chunk up to this many turns (the batched
+    #: wire, ISSUE 10). 0 = per-turn callbacks (the legacy contract,
+    #: preserved).
+    batch_turns = 0
+
     def on_sync(self, sid: str, turn: int, board: np.ndarray) -> None:
         """Full board state at attach (and after any resync)."""
 
     def on_flips(self, sid: str, turn: int, coords: np.ndarray) -> None:
         """One turn's flipped cells as an (N, 2) int32 x,y array —
         exactly the single-board engine's FlipBatch payload."""
+
+    def on_flip_chunk(self, sid: str, first_turn: int, counts,
+                      bitmaps, words) -> None:
+        """A whole dispatched chunk for this session in the S-sparse
+        layout (events.FlipChunk: per-turn changed-word counts,
+        bitmaps, concatenated XOR masks), covering turns
+        `first_turn .. first_turn + len(counts) - 1`. Called instead
+        of the per-turn loop when `batch_turns` > 0 and the bucket is
+        packed; a chunk-granular sink does its own per-turn
+        bookkeeping."""
 
     def on_turn(self, sid: str, turn: int) -> None:
         """A turn committed for this session."""
@@ -247,6 +265,24 @@ class _Bucket:
             for s in self.sessions.values()
             for sink in self.sinks.get(s.id, ())
         )
+
+    def batch_hint(self) -> int:
+        """Negotiated batch pacing for this bucket's dispatch chunk —
+        the SessionEngine raises a watched bucket's chunk to it, so a
+        batching watcher isn't pinned at the 16-turn interactive chunk
+        (ISSUE 10's chunk-pinning fix). Sessions in a bucket step in
+        LOCKSTEP, so the raise only happens when EVERY attached sink
+        is chunk-granular (one per-turn watcher anywhere in the bucket
+        keeps the interactive chunk — the tenant paying the latency
+        must be one who negotiated it), and the SMALLEST negotiated
+        max-k paces the bucket (conservative: nobody's whole-batch
+        latency exceeds their own negotiation)."""
+        hints = [getattr(sink, "batch_turns", 0)
+                 for s in self.sessions.values()
+                 for sink in self.sinks.get(s.id, ())]
+        if not hints or 0 in hints:
+            return 0
+        return min(hints)
 
     def adapt_cap(self, peak_words: int) -> None:
         ceiling = self.bs.total_words // 2
@@ -863,11 +899,26 @@ class SessionManager:
             self._commit(b, k)
             host0 = time.perf_counter()
             rows_by_slot = {}
+            chunks_by_slot = {}
             peak = 0
             for slot, s in b.sessions.items():
                 hs = hdr[slot]
                 peak = max(peak, int(hs[:, 0].max()) if hs.size else 0)
-                if b.sinks.get(s.id):
+                sinks = b.sinks.get(s.id)
+                if not sinks:
+                    continue
+                if any(getattr(sk, "batch_turns", 0) for sk in sinks):
+                    # Chunk-granular sinks ride the device layout
+                    # directly — counts/bitmaps are the header, the
+                    # values slice is the used prefix; no dense
+                    # scatter for these sessions.
+                    counts_s = hs[:, 0].astype(np.int64)
+                    chunks_by_slot[slot] = (
+                        counts_s, hs[:, 1:],
+                        vals[slot][:int(counts_s.sum())],
+                    )
+                if any(not getattr(sk, "batch_turns", 0)
+                       for sk in sinks):
                     rows_by_slot[slot] = list(compact_decode_rows(
                         hs, vals[slot], b.bs.total_words
                     ))
@@ -883,6 +934,7 @@ class SessionManager:
             self._commit(b, k)
             host0 = time.perf_counter()
             rows_by_slot = {}
+            chunks_by_slot = {}
             peak = 0
             for slot, s in b.sessions.items():
                 d = host[slot]
@@ -892,13 +944,26 @@ class SessionManager:
                         max((int(np.count_nonzero(d[t]))
                              for t in range(k)), default=0),
                     )
-                if b.sinks.get(s.id):
+                sinks = b.sinks.get(s.id)
+                if not sinks:
+                    continue
+                if b.bs.packed and any(
+                        getattr(sk, "batch_turns", 0) for sk in sinks):
+                    from gol_tpu.parallel.stepper import (
+                        sparse_chunk_from_dense,
+                    )
+
+                    chunks_by_slot[slot] = sparse_chunk_from_dense(
+                        np.asarray(d).reshape(k, -1)
+                    )
+                if any(not getattr(sk, "batch_turns", 0)
+                       for sk in sinks) or not b.bs.packed:
                     rows_by_slot[slot] = [
                         d[t].reshape(-1) for t in range(k)
                     ]
             if b.bs.packed:
                 b.adapt_cap(peak)
-        self._emit(b, k, rows_by_slot)
+        self._emit(b, k, rows_by_slot, chunks_by_slot)
         # Device-vs-host split of this bucket dispatch (same boundaries
         # as the singleton engine: enqueue / materialise / decode+emit).
         device.observe_split(enq_s, sync_s,
@@ -914,9 +979,12 @@ class SessionManager:
         # memory census (rate-limited inside) rides the commit.
         device.observe_memory()
 
-    def _emit(self, b: _Bucket, k: int, rows_by_slot: dict) -> None:
+    def _emit(self, b: _Bucket, k: int, rows_by_slot: dict,
+              chunks_by_slot: "Optional[dict]" = None) -> None:
         """Fan one dispatched chunk out to the attached sinks, per
-        session, in turn order."""
+        session: chunk-granular sinks get the whole chunk in ONE
+        on_flip_chunk call, per-turn sinks keep the legacy
+        flips-then-turn loop in turn order."""
         from gol_tpu.ops.bitlife import unpack_np
         from gol_tpu.utils.cell import xy_from_mask
 
@@ -925,6 +993,21 @@ class SessionManager:
             sinks = b.sinks.get(s.id)
             if not sinks:
                 continue
+            chunk = (chunks_by_slot or {}).get(slot)
+            if chunk is not None:
+                dead = []
+                for sink in [sk for sk in sinks
+                             if getattr(sk, "batch_turns", 0)]:
+                    try:
+                        sink.on_flip_chunk(s.id, s.turn - k + 1, *chunk)
+                    except Exception:
+                        dead.append(sink)
+                for sink in dead:
+                    self._detach(s.id, sink)
+                sinks = [sk for sk in (b.sinks.get(s.id) or ())
+                         if not getattr(sk, "batch_turns", 0)]
+                if not sinks:
+                    continue
             rows = rows_by_slot.get(slot)
             base = s.turn - k
             for t in range(k):
@@ -950,6 +1033,11 @@ class SessionManager:
                         dead.append(sink)
                 for sink in dead:
                     self._detach(s.id, sink)
-                sinks = b.sinks.get(s.id)
+                # Re-read survivors, still EXCLUDING chunk-granular
+                # sinks when this session's chunk was already handed
+                # out above (they must not also get the per-turn loop).
+                sinks = [sk for sk in (b.sinks.get(s.id) or ())
+                         if chunk is None
+                         or not getattr(sk, "batch_turns", 0)]
                 if not sinks:
                     break

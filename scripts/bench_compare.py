@@ -36,6 +36,13 @@ import sys
 from typing import Dict, Optional
 
 HIGHER_BETTER = re.compile(
+    # `per_sec` covers every turns_per_sec key, including the batched
+    # watched lane's k-sweep (wire_watched_512x512_batch.k*, ISSUE
+    # 10); `speedup` covers its speedup_vs_unbatched. The same lane's
+    # link_bytes_per_turn gates LOWER via `bytes`, and its
+    # device_plane.compiles rides the off-zero compile gate below — a
+    # batch path that starts recompiling mid-measurement is an
+    # infinite regression.
     r"(per_sec|per_s$|throughput|rate$|gcells|speedup|vs_sequential)",
     re.I,
 )

@@ -195,6 +195,58 @@ assert row["up"] and row.get("compiles", 0) > 0, row
     exit 1
 }
 
+# The batched wire (r10): attach a REAL batching client (hello
+# "batch") to the live --serve run, drain a few k-turn frames, and
+# assert the batch plane moved on the server's /metrics — the
+# per-frame batch-size histogram — plus the client-side per-batch
+# latency histogram in-process.
+ADDR=$(sed -n 's#^engine serving on \(.*\)$#\1#p' "$LOG2" | head -1)
+if ! python - "$ADDR" <<'PYEOF'
+import sys, time
+host, port = sys.argv[1].rsplit(":", 1)
+from gol_tpu.distributed import Controller
+from gol_tpu.distributed.client import _METRICS
+from gol_tpu.events import TurnComplete
+ctl = Controller(host, int(port), want_flips=True, batch=True,
+                 batch_turns=64, batch_flip_events=False)
+assert ctl.wait_sync(60), "batching client never synced"
+seen = 0
+deadline = time.monotonic() + 20
+while seen < 64 and time.monotonic() < deadline:
+    try:
+        evs = ctl.events.get_batch(4096, timeout=1.0)
+    except Exception:
+        continue
+    if evs is None:
+        break
+    seen += sum(1 for e in evs if isinstance(e, TurnComplete))
+assert seen >= 64, f"only {seen} batched turns delivered"
+assert _METRICS.batch_latency.count > 0, \
+    "gol_tpu_client_batch_latency_seconds never observed"
+ctl.detach(10)
+ctl.close()
+PYEOF
+then
+    echo "metrics smoke: FAILED — batching client saw no batch frames" >&2
+    exit 1
+fi
+sleep 1
+METRICS2=$(fetch "$BASE2/metrics")
+python -c '
+import sys
+m = sys.stdin.read()
+def val(prefix):
+    return sum(float(l.split()[-1]) for l in m.splitlines()
+               if l.startswith(prefix) and not l.startswith("#"))
+assert val("gol_tpu_server_batch_turns_count") > 0, \
+    "server encoded no batch frames"
+assert val("gol_tpu_server_batch_turns_sum") >= 64, \
+    "batch frames carried almost no turns"
+' <<<"$METRICS2" || {
+    echo "metrics smoke: FAILED — gol_tpu_server_batch_turns not moving" >&2
+    exit 1
+}
+
 kill -TERM "$PID2"
 for _ in $(seq 1 60); do
     kill -0 "$PID2" 2>/dev/null || break
@@ -225,4 +277,5 @@ python -m gol_tpu.obs.report render "$DUMP" >/dev/null || {
 echo "metrics smoke: OK ($BASE — /metrics, /healthz, /vars, /trace,"
 echo "  /flightrecorder all live; device plane carries compiles/cost/"
 echo "  watermark/split; obs.console --once rendered $BASE2;"
-echo "  SIGTERM dump at $DUMP renders clean)"
+echo "  batch plane moved (gol_tpu_server_batch_turns) under a real"
+echo "  hello-batch client; SIGTERM dump at $DUMP renders clean)"

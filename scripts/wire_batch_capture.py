@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Merge the batched watched-path lane into BENCH_DETAIL.json — the
+bounded form of the full bench for containers without the TPU
+attached (the `device_plane_capture.py` pattern applied to ISSUE 10's
+acceptance lane).
+
+Runs `bench.measure_wire_watched_batch` — a real EngineServer on the
+settled 512² fixture, a batching controller through the byte-counting
+loopback proxy, k swept 16/64/256/1024 plus the unbatched A/B — with
+the device plane bracketed (`_lane`), and writes the result under
+
+    BENCH_DETAIL.json["wire_watched_512x512_batch"]
+
+stamping the substrate platform. No other lane is touched, so
+`bench_compare` against an older capture sees one new key, never a
+fake regression; the lane's `device_plane.compiles` rides the
+off-zero compile gate.
+
+Usage: python scripts/wire_batch_capture.py   (CPU-safe; ~2 min)
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    import jax
+
+    from gol_tpu.obs import device
+
+    device.install_compile_watcher()
+
+    import bench
+
+    entry = bench._lane(bench.measure_wire_watched_batch)
+    entry["platform"] = jax.devices()[0].platform
+
+    detail_path = REPO / "BENCH_DETAIL.json"
+    detail = json.loads(detail_path.read_text())
+    detail["wire_watched_512x512_batch"] = entry
+    detail_path.write_text(json.dumps(detail, indent=1))
+    print(json.dumps(entry, indent=1))
+    ok = entry.get("turns_per_sec", 0) >= 100_000
+    print(f"wire_watched_512x512_batch: "
+          f"{entry.get('turns_per_sec', 0):,.0f} turns/s "
+          f"({'PASS' if ok else 'BELOW'} the 100k acceptance bar)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,374 @@
+"""Batched wire turns (ISSUE 10): k-turn _TAG_FBATCH frames end to
+end. The acceptance contract under test:
+
+- a BATCHED watched run ends bit-identical to the unbatched run and
+  to the fused-stepper oracle, with runtime invariants forced ON;
+- the reconstructed per-turn event stream (batch_flip_events=True) is
+  identical to the unbatched client's;
+- a seeded client-reset fault MID-BATCH reconnects and resumes via the
+  diffed BoardSync with nothing double-applied;
+- legacy (no-"batch" hello) peers attached to the SAME server keep
+  receiving the per-turn stream, bit-identically;
+- the engine's chunk sizing scales to the negotiated max-k instead of
+  pinning at the interactive chunk (sessions engine included);
+- the observability satellite: gol_tpu_server_batch_turns and
+  gol_tpu_client_batch_latency_seconds move on a batched run.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from gol_tpu import obs
+from gol_tpu.distributed import Controller, EngineServer
+from gol_tpu.distributed.server import SessionServer
+from gol_tpu.events import FlipBatch, TurnComplete
+from gol_tpu.params import Params
+from gol_tpu.parallel.stepper import make_stepper
+from gol_tpu.testing import faults
+from gol_tpu.testing.faults import FaultPlan
+
+
+@pytest.fixture(autouse=True)
+def _invariant_violation_guard(monkeypatch):
+    """Invariants forced ON for every batched-wire test; any violation
+    (even one swallowed by a daemon thread) fails through the registry
+    counter."""
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.analysis.invariants import violations_total
+
+    before = violations_total()
+    yield
+    grew = violations_total() - before
+    assert grew == 0, (
+        f"gol_tpu_invariant_violations_total grew by {grew} during a "
+        "batched-wire test"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+W = H = 96
+TURNS = 260  # > one DIFF_CHUNK so batches and chunk boundaries interact
+
+
+def _world(seed: int = 11) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return ((rng.random((H, W)) < 0.25) * 255).astype(np.uint8)
+
+
+def _oracle(world: np.ndarray, turns: int) -> np.ndarray:
+    st = make_stepper(threads=1, height=H, width=W,
+                      devices=[jax.devices()[0]])
+    out, c = st.step_n(st.put(world), turns)
+    int(c)
+    return st.fetch(out)
+
+
+def _params(tmp_path, golden_root, **kw):
+    # chunk=16 PACES the engine for the correctness tests: batched
+    # production outruns a per-turn consumer by orders of magnitude,
+    # and an unpaced engine legitimately pushes slow peers into
+    # degradation shedding (covered by test_overload) — these tests
+    # pin bit-identity of the delivered streams, so both sides must
+    # actually receive every turn.
+    defaults = dict(
+        turns=TURNS, threads=1, image_width=W, image_height=H,
+        image_dir=str(golden_root / "images"),
+        out_dir=str(tmp_path / "out"), tick_seconds=60.0, chunk=16,
+    )
+    defaults.update(kw)
+    return Params(**defaults)
+
+
+def _run_watched(tmp_path, golden_root, world, *, batch_turns=None,
+                 batch_flip_events=True, collect_events=False,
+                 server_kw=None, ctl_kw=None, params_kw=None):
+    """One full watched run: returns (shadow board, event-rebuilt
+    board, per-turn event log, controller) after the stream closes."""
+    skw = dict(high_water=960)  # full per-turn streams must FIT: these
+    # tests assert delivered-stream identity, so degradation shedding
+    # (a 520-frame per-turn run vs the default 256 mark) must not
+    # engage — overload semantics have their own suite.
+    skw.update(server_kw or {})
+    server = EngineServer(
+        _params(tmp_path, golden_root, **(params_kw or {})), port=0,
+        initial_world=world, **skw,
+    ).start()
+    ctl = Controller(*server.address, want_flips=True, batch=True,
+                     batch_turns=batch_turns,
+                     batch_flip_events=batch_flip_events,
+                     **(ctl_kw or {}))
+    ev_board = np.zeros((H, W), np.uint8)
+    log = []
+    for ev in ctl.events:
+        kind = type(ev).__name__
+        if kind == "FlipBatch":
+            xy = np.asarray(ev.cells).reshape(-1, 2)
+            ev_board[xy[:, 1], xy[:, 0]] ^= np.uint8(255)
+            if collect_events:
+                log.append(("flips", ev.completed_turns,
+                            [tuple(c) for c in xy.tolist()]))
+        elif kind == "TurnComplete" and collect_events:
+            log.append(("turn", ev.completed_turns))
+    server.wait(60)
+    ctl.close()
+    return ctl.board.copy(), ev_board, log, ctl
+
+
+def test_batched_run_bit_identical_to_unbatched_and_oracle(
+        tmp_path, golden_root):
+    world = _world()
+    oracle = _oracle(world, TURNS)
+    un_board, un_ev, _, _ = _run_watched(tmp_path / "a", golden_root,
+                                         world)
+    b_board, b_ev, _, _ = _run_watched(tmp_path / "b", golden_root,
+                                       world, batch_turns=64)
+    r_board, _, _, _ = _run_watched(tmp_path / "c", golden_root, world,
+                                    batch_turns=64,
+                                    batch_flip_events=False)
+    np.testing.assert_array_equal(un_board != 0, oracle != 0)
+    np.testing.assert_array_equal(b_board, un_board)
+    np.testing.assert_array_equal(r_board, un_board)
+    # The event-reconstructed boards agree too (the stream itself is
+    # faithful, not just the shadow raster).
+    np.testing.assert_array_equal(un_ev != 0, oracle != 0)
+    np.testing.assert_array_equal(b_ev, un_ev)
+
+
+def test_batched_event_stream_identical_to_unbatched(tmp_path,
+                                                     golden_root):
+    """batch_flip_events=True reconstructs EXACTLY the per-turn event
+    stream the unbatched client delivers — same turns, same coords,
+    same order."""
+    world = _world(23)
+    _, _, un_log, _ = _run_watched(tmp_path / "a", golden_root, world,
+                                   collect_events=True)
+    _, _, b_log, _ = _run_watched(tmp_path / "b", golden_root, world,
+                                  batch_turns=32, collect_events=True)
+    assert b_log == un_log
+
+
+def test_mixed_legacy_and_batch_peers_one_server(tmp_path, golden_root):
+    """A legacy (per-turn) observer and a batching driver attached to
+    the SAME engine both end bit-identical to the oracle — the
+    broadcaster expands chunks for the one and encodes frames for the
+    other."""
+    world = _world(5)
+    oracle = _oracle(world, TURNS)
+    server = EngineServer(
+        _params(tmp_path, golden_root), port=0, initial_world=world,
+        high_water=960,
+    ).start()
+    drv = Controller(*server.address, want_flips=True, batch=True,
+                     batch_turns=64, batch_flip_events=False)
+    obs_ctl = Controller(*server.address, want_flips=True, batch=True,
+                         observe=True)
+    done = queue.Queue()
+
+    def drain(c):
+        for _ in c.events:
+            pass
+        done.put(c)
+
+    for c in (drv, obs_ctl):
+        threading.Thread(target=drain, args=(c,), daemon=True).start()
+    done.get(timeout=120)
+    done.get(timeout=120)
+    server.wait(60)
+    np.testing.assert_array_equal(drv.board != 0, oracle != 0)
+    np.testing.assert_array_equal(obs_ctl.board, drv.board)
+    drv.close()
+    obs_ctl.close()
+
+
+def test_seeded_reset_mid_batch_resumes_bit_identical(tmp_path,
+                                                      golden_root):
+    """A client-side connection reset INSIDE the batched stream: the
+    supervisor re-dials, the diffed BoardSync resumes, and the final
+    board is bit-identical to the oracle (nothing double-applied,
+    nothing lost) — the PR 3 resilience contract surviving the new
+    frame type."""
+    world = _world(31)
+    turns = 800
+    oracle = _oracle(world, turns)
+    # recv:14 lands mid-stream: the handshake+clock probe is ~10
+    # inbound messages, the batched stream another ~50.
+    faults.install(FaultPlan.parse("client:reset@recv:14"))
+    board, _, _, ctl = _run_watched(
+        tmp_path, golden_root, world, batch_turns=32,
+        batch_flip_events=False,
+        server_kw=dict(),
+        ctl_kw=dict(reconnect_seed=7, reconnect_window=60.0),
+        params_kw=dict(turns=turns),
+    )
+    assert ctl.reconnects >= 1, "the seeded reset never fired"
+    np.testing.assert_array_equal(board != 0, oracle != 0)
+
+
+def test_batch_negotiation_clamps_and_scales_chunk(tmp_path,
+                                                   golden_root):
+    """The hello max-k is clamped to the server's --batch-turns cap,
+    and the ENGINE's diff-chunk budget scales to the negotiated value
+    (the chunk-pinning fix)."""
+    world = _world(3)
+    server = EngineServer(
+        _params(tmp_path, golden_root, turns=10_000), port=0,
+        initial_world=world, batch_turns=512,
+    ).start()
+    ctl = Controller(*server.address, want_flips=True, batch=True,
+                     batch_turns=4096, batch_flip_events=False)
+    assert ctl.wait_sync(60)
+    deadline = time.monotonic() + 10
+    while (server.engine.batch_turns_hint != 512
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert server.engine.batch_turns_hint == 512
+    assert server.engine.emit_flip_chunks
+    assert server.engine._diff_chunk_budget() == 512
+    # Detach: the engine re-derives both flags off.
+    assert ctl.detach(30)
+    deadline = time.monotonic() + 10
+    while (server.engine.batch_turns_hint != 0
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert server.engine.batch_turns_hint == 0
+    assert not server.engine.emit_flip_chunks
+    ctl.close()
+    server.shutdown()
+
+
+def test_batch_requires_binary_hello(tmp_path, golden_root):
+    """batch rides binary framing: a non-binary hello never negotiates
+    it, and the run still completes per-turn, bit-identically."""
+    world = _world(13)
+    oracle = _oracle(world, TURNS)
+    board, _, _, _ = _run_watched(
+        tmp_path, golden_root, world, batch_turns=64,
+        batch_flip_events=False, ctl_kw=dict(binary=False),
+    )
+    np.testing.assert_array_equal(board != 0, oracle != 0)
+
+
+def test_batch_obs_series_move(tmp_path, golden_root):
+    """The observability satellite: per-frame batch-size histogram on
+    the server, per-batch latency histogram on the client."""
+    from gol_tpu.distributed.client import _METRICS as CLI_METRICS
+    from gol_tpu.distributed.server import _METRICS as SRV_METRICS
+
+    sb = SRV_METRICS.batch_turns.count
+    cb = CLI_METRICS.batch_latency.count
+    world = _world(17)
+    _run_watched(tmp_path, golden_root, world, batch_turns=64,
+                 batch_flip_events=False)
+    assert SRV_METRICS.batch_turns.count > sb
+    assert CLI_METRICS.batch_latency.count > cb
+
+
+def test_cycle_ride_lifts_watched_rate_bit_exactly(tmp_path,
+                                                   golden_root):
+    """With cycle detection on, a watched batched run of a PERIODIC
+    board rides the proven cycle: the engine synthesizes chunks
+    without stepping, turn numbers stay dense, and the final board is
+    still bit-identical to the fused oracle."""
+    # A glider-free seed settles fast at 96²; settle it first so the
+    # run under test is periodic from turn 0.
+    st = make_stepper(threads=1, height=H, width=W,
+                      devices=[jax.devices()[0]])
+    q, c = st.step_n(st.put(_world(2)), 3000)
+    int(c)
+    settled = st.fetch(q)
+    turns = 5000
+    oracle = _oracle(settled, turns)
+    server = EngineServer(
+        _params(tmp_path, golden_root, turns=turns, cycle_detect=True),
+        port=0, initial_world=settled, cycle_check_seconds=0.1,
+    ).start()
+    ctl = Controller(*server.address, want_flips=True, batch=True,
+                     batch_turns=256, batch_flip_events=False)
+    turns_seen = 0
+    for ev in ctl.events:
+        if isinstance(ev, TurnComplete):
+            turns_seen += 1
+    server.wait(120)
+    ctl.close()
+    assert turns_seen >= turns  # dense turn numbering, nothing leapt
+    np.testing.assert_array_equal(ctl.board != 0, oracle != 0)
+    # The ride engaged (the whole point): synthesized dispatches > 0.
+    from gol_tpu.engine.distributor import _METRICS as ENG_METRICS
+
+    assert ENG_METRICS.dispatches["ride"].value > 0, (
+        "the cycle ride never engaged on a settled periodic board"
+    )
+
+
+def test_session_server_batched_watcher_bit_identical(tmp_path,
+                                                      golden_root):
+    """The session layer's chunk-granular sink: a batching watcher on
+    a --sessions server sees the same final board as the per-board
+    oracle."""
+    turns = 200
+    side = 64
+    server = SessionServer(
+        _params(tmp_path, golden_root, turns=10**6, image_width=side,
+                image_height=side),
+        port=0, bucket_capacity=4,
+    ).start()
+    from gol_tpu.distributed.client import SessionControl
+
+    try:
+        with SessionControl(*server.address) as sc:
+            sc.create("batched", width=side, height=side, seed=99,
+                      density=0.3)
+        from gol_tpu.sessions.manager import seeded_board
+
+        world0 = seeded_board(side, side, 99, 0.3)
+        ctl = Controller(*server.address, want_flips=True, batch=True,
+                         session="batched", batch_turns=64,
+                         batch_flip_events=False)
+        assert ctl.wait_sync(60)
+        seen = 0
+        deadline = time.monotonic() + 120
+        while seen < turns and time.monotonic() < deadline:
+            try:
+                evs = ctl.events.get_batch(4096, timeout=1.0)
+            except queue.Empty:
+                continue
+            if evs is None:
+                break
+            seen += sum(1 for e in evs if isinstance(e, TurnComplete))
+        assert seen >= turns, f"only {seen} turns delivered"
+        # Oracle: the seeded board stepped to the shadow's turn count.
+        synced_at = ctl.board.copy()
+        mgr_turn = server.manager.peek_turn("batched")
+        st = make_stepper(threads=1, height=side, width=side,
+                          devices=[jax.devices()[0]])
+        # The shadow lags the live session; compare at the turn the
+        # client last applied by stepping the oracle to every turn in
+        # a window and requiring one exact match of the flip parity.
+        ctl.detach(30)
+        applied = None
+        w = st.put(world0)
+        for t in range(mgr_turn + 64 + 1):
+            host = st.fetch(w)
+            if np.array_equal((host != 0), (synced_at != 0)):
+                applied = t
+                break
+            w, c = st.step_n(w, 1)
+        assert applied is not None, (
+            "batched session shadow matches no oracle turn — the "
+            "stream is corrupt"
+        )
+        ctl.close()
+    finally:
+        server.shutdown()

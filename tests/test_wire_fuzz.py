@@ -692,3 +692,260 @@ def test_coalesced_boardsync_interleaved_with_buffered_flips():
         ctl.close()
     finally:
         listener.close()
+
+
+# --- k-turn flip batches (_TAG_FBATCH, ISSUE 10) ---
+
+
+def _fbatch_fixture(width=64, height=64, k=6, seed=3):
+    """A valid chunk (counts, bitmaps, values) of per-turn S-sparse
+    rows plus the dense S stacks for ground truth."""
+    total, nb = wire.grid_words(width, height)
+    rng = np.random.default_rng(seed)
+    counts, bitmaps, values, dense = [], [], [], []
+    base_idx = np.sort(rng.choice(total, 20, replace=False))
+    base_val = rng.integers(1, 1 << 8, 20, dtype=np.uint32)
+    for t in range(k):
+        if t in (1, 2, 4):  # identical to the previous turn (settled)
+            idx, val = base_idx, base_val
+        else:
+            idx = np.sort(rng.choice(total, 12, replace=False))
+            val = rng.integers(1, 1 << 8, 12, dtype=np.uint32)
+        counts.append(len(idx))
+        bitmaps.append(wire._indices_to_bitmap(idx, nb))
+        values.append(val)
+        d = np.zeros(total, np.uint32)
+        d[idx] = val
+        dense.append(d)
+    return (np.array(counts), np.stack(bitmaps),
+            np.concatenate(values), dense, total, nb)
+
+
+def _fbatch_frame(first_turn=1, a=0, b=None, ts=5.0, seed=3):
+    counts, bitmaps, values, dense, total, nb = _fbatch_fixture(seed=seed)
+    b = len(counts) if b is None else b
+    dc, dbm, dw = wire.chunk_deltas(counts, bitmaps, values, a, b, total)
+    return (wire.flip_batch_to_frame(first_turn, nb, dc, dbm, dw, ts),
+            dense[a:b], total, nb)
+
+
+def test_fbatch_roundtrip_reconstructs_every_turn():
+    frame, dense, total, nb = _fbatch_frame()
+    msg = wire._parse_frame(frame)
+    assert msg["t"] == "fbatch" and msg["k"] == len(dense)
+    assert msg["nb"] == nb and msg["ts"] == 5.0
+    cur = np.zeros(total, np.uint32)
+    off = bi = 0
+    for t in range(msg["k"]):
+        m = int(msg["counts"][t])
+        if m:
+            idx = wire._bitmap_indices(msg["dbitmaps"][bi])
+            bi += 1
+            cur = cur.copy()
+            cur[idx] ^= msg["dwords"][off:off + m]
+            off += m
+        np.testing.assert_array_equal(cur, dense[t])
+
+
+def test_fbatch_segment_frames_are_self_contained():
+    """Any [a, b) segment decodes standalone — the property that makes
+    BoardSync chain-reset trivial (no cross-frame state exists)."""
+    counts, bitmaps, values, dense, total, nb = _fbatch_fixture()
+    for a, b in ((0, 3), (2, 6), (3, 4), (5, 6)):
+        dc, dbm, dw = wire.chunk_deltas(counts, bitmaps, values,
+                                        a, b, total)
+        frame = wire.flip_batch_to_frame(a + 1, nb, dc, dbm, dw, 0.0)
+        msg = wire._parse_frame(frame)
+        cur = np.zeros(total, np.uint32)
+        off = bi = 0
+        for t in range(msg["k"]):
+            m = int(msg["counts"][t])
+            if m:
+                idx = wire._bitmap_indices(msg["dbitmaps"][bi])
+                bi += 1
+                cur = cur.copy()
+                cur[idx] ^= msg["dwords"][off:off + m]
+                off += m
+            np.testing.assert_array_equal(cur, dense[a + t])
+
+
+def test_fbatch_truncation_sweep_raises_wireerror():
+    frame, _, _, _ = _fbatch_frame()
+    for cut in range(1, len(frame)):
+        try:
+            wire._parse_frame(frame[:cut])
+        except wire.WireError:
+            continue
+        raise AssertionError(
+            f"truncation at byte {cut} decoded without error"
+        )
+
+
+def test_fbatch_seeded_corruption_never_escapes_wireerror():
+    frame, _, _, _ = _fbatch_frame()
+    rng = np.random.default_rng(99)
+    for _ in range(300):
+        buf = bytearray(frame)
+        for _ in range(int(rng.integers(1, 4))):
+            buf[int(rng.integers(1, len(buf)))] = int(rng.integers(256))
+        try:
+            wire._parse_frame(bytes(buf))
+        except wire.WireError:
+            pass  # rejection is the contract; silent decode of a
+            # corrupt frame is possible only when the lie stays
+            # structurally consistent (counts/popcounts/lengths agree)
+
+
+def test_fbatch_lying_turn_count_rejected():
+    """A header k disagreeing with the counts blob length — the wire's
+    first line of defense against misaligned mask slices."""
+    counts, bitmaps, values, dense, total, nb = _fbatch_fixture()
+    dc, dbm, dw = wire.chunk_deltas(counts, bitmaps, values, 0,
+                                    len(counts), total)
+    frame = bytearray(
+        wire.flip_batch_to_frame(1, nb, dc, dbm, dw, 0.0)
+    )
+    # header: <BQIIdIII — k lives at offset 9
+    import struct as _struct
+
+    _struct.pack_into("<I", frame, 9, len(counts) + 2)
+    with pytest.raises(wire.WireError):
+        wire._parse_frame(bytes(frame))
+    _struct.pack_into("<I", frame, 9, 0)  # zero turns is implausible
+    with pytest.raises(wire.WireError):
+        wire._parse_frame(bytes(frame))
+    _struct.pack_into("<I", frame, 9, wire.FBATCH_MAX_TURNS + 1)
+    with pytest.raises(wire.WireError):
+        wire._parse_frame(bytes(frame))
+
+
+def test_fbatch_popcount_mismatch_rejected():
+    """A bitmap row popping a different word count than its counts
+    entry claims must be rejected — accepting it would misalign every
+    later turn's mask slice."""
+    counts, bitmaps, values, dense, total, nb = _fbatch_fixture()
+    dc, dbm, dw = wire.chunk_deltas(counts, bitmaps, values, 0,
+                                    len(counts), total)
+    dbm = dbm.copy()
+    dbm[0, 0] ^= np.uint32(1 << 7)  # flip one bitmap bit
+    frame = wire.flip_batch_to_frame(1, nb, dc, dbm, dw, 0.0)
+    with pytest.raises(wire.WireError, match="popcount"):
+        wire._parse_frame(frame)
+
+
+def test_fbatch_zlib_bomb_bounded():
+    """A counts blob claiming few words while a zlib'd mask blob
+    inflates far past them: decompression must stop at the declared
+    bound, never allocate the bomb."""
+    nb = 2
+    dcounts = np.array([2, 0, 0, 0], np.uint32)
+    dbm = wire._indices_to_bitmap(np.array([0, 5]), nb)[None, :]
+    bomb = zlib.compress(bytes(64 << 20), 9)  # 64 MiB of zeros
+    blobs = [wire._pack_blob(dcounts.tobytes()),
+             wire._pack_blob(dbm.astype(np.uint32).tobytes()),
+             b"\x01" + bomb]
+    frame = wire._FBATCH_HDR.pack(
+        wire._TAG_FBATCH, 1, 4, nb, 0.0,
+        len(blobs[0]), len(blobs[1]), len(blobs[2]),
+    ) + b"".join(blobs)
+    with pytest.raises(wire.WireError):
+        wire._parse_frame(frame)
+
+
+def test_fbatch_unknown_blob_codec_rejected():
+    frame, _, _, _ = _fbatch_frame()
+    buf = bytearray(frame)
+    buf[wire._FBATCH_HDR.size] = 7  # counts blob codec byte
+    with pytest.raises(wire.WireError, match="codec"):
+        wire._parse_frame(bytes(buf))
+
+
+def test_fbatch_unknown_future_tag_still_ignorable():
+    """Tag 8 (one past FBATCH) keeps the forward-compat contract: a
+    peer newer than this code must not kill the reader."""
+    assert wire._parse_frame(bytes([8]) + b"beyond")["t"] == "bin8"
+
+
+def test_fbatch_straddling_board_sync_applies_only_the_suffix():
+    """Scripted server: a batch whose leading turns are already inside
+    the BoardSync raster must apply ONLY the suffix (no double-apply),
+    and a batch entirely behind the sync must be a no-op — the
+    synced_turn gate at batch granularity, bit-exact."""
+    import socket as _socket
+    import threading
+    import time as _time
+
+    from gol_tpu.distributed.client import Controller
+
+    width = height = 64
+    total, nb = wire.grid_words(width, height)
+    rng = np.random.default_rng(21)
+    board10 = (rng.random((height, width)) < 0.3).astype(np.uint8) * 255
+
+    def mk_chunk(k, seed):
+        r = np.random.default_rng(seed)
+        counts, bms, vals, dense = [], [], [], []
+        for _ in range(k):
+            idx = np.sort(r.choice(total, 9, replace=False))
+            # masks with bits only in rows 0..31 (board is 64 tall:
+            # words cover rows [0,32) and [32,64) fully — any bit ok)
+            val = r.integers(1, 1 << 32, 9, dtype=np.uint32)
+            counts.append(9)
+            bms.append(wire._indices_to_bitmap(idx, nb))
+            vals.append(val)
+            d = np.zeros(total, np.uint32)
+            d[idx] = val
+            dense.append(d)
+        return (np.array(counts), np.stack(bms), np.concatenate(vals),
+                dense)
+
+    # batch A: turns 8..13 — 8, 9, 10 are inside the sync (turn 10)
+    cA, bA, vA, dA = mk_chunk(6, 1)
+    # batch B: turns 5..7 — entirely stale
+    cB, bB, vB, dB = mk_chunk(3, 2)
+    dcA, dbmA, dwA = wire.chunk_deltas(cA, bA, vA, 0, 6, total)
+    dcB, dbmB, dwB = wire.chunk_deltas(cB, bB, vB, 0, 3, total)
+
+    listener = _socket.create_server(("127.0.0.1", 0))
+
+    def serve_one():
+        s, _ = listener.accept()
+        try:
+            wire.recv_msg(s, allow_binary=False)  # hello
+            wire.send_msg(s, {"t": "attach-ack", "batch": 32})
+            wire.send_frame(s, wire.board_to_frame(10, board10, 0))
+            wire.send_frame(s, wire.flip_batch_to_frame(
+                8, nb, dcA, dbmA, dwA, _time.time()))
+            wire.send_frame(s, wire.flip_batch_to_frame(
+                5, nb, dcB, dbmB, dwB, _time.time()))
+            wire.send_msg(s, {"t": "bye"})
+            _time.sleep(0.5)
+        finally:
+            s.close()
+
+    threading.Thread(target=serve_one, daemon=True).start()
+    try:
+        ctl = Controller(*listener.getsockname(), want_flips=True,
+                         batch=True, batch_turns=32,
+                         batch_flip_events=False, reconnect=False)
+        deadline = _time.monotonic() + 20
+        while ctl.state != "closed" and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        assert ctl.state == "closed", ctl.state
+        # Expected: board10 XOR S11 XOR S12 XOR S13 (indices 3..5 of
+        # batch A); batch B contributes nothing.
+        want_words = dA[3] ^ dA[4] ^ dA[5]
+        want = np.array(board10)
+        for wi in np.flatnonzero(want_words):
+            x, y0 = wi % width, (wi // width) * 32
+            for bit in range(32):
+                if (int(want_words[wi]) >> bit) & 1:
+                    want[y0 + bit, x] ^= np.uint8(255)
+        np.testing.assert_array_equal(
+            ctl.board, want,
+            err_msg="batch straddling a BoardSync was not gated per "
+                    "turn",
+        )
+        ctl.close()
+    finally:
+        listener.close()
