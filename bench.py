@@ -899,6 +899,202 @@ def measure_sessions_lane(sessions: int = 64, side: int = 256,
     }
 
 
+def _fanout_proxy(target) -> tuple:
+    """Multi-connection counting proxy in front of the ROOT server:
+    every peer (relay or direct observer) dials through it, so
+    `stats["down"]` is the root's TRUE egress — which is how the lane
+    separates root cost from relay fan-out cost in one process."""
+    import socket
+    import threading
+
+    lsock = socket.create_server(("127.0.0.1", 0))
+    stats = {"down": 0}
+
+    def pump(src, dst, key=None):
+        while True:
+            try:
+                data = src.recv(1 << 16)
+            except OSError:
+                break
+            if not data:
+                break
+            if key is not None:
+                stats[key] += len(data)
+            try:
+                dst.sendall(data)
+            except OSError:
+                break
+        for s in (src, dst):
+            with contextlib.suppress(OSError):
+                s.close()
+
+    def serve():
+        while True:
+            try:
+                c, _ = lsock.accept()
+            except OSError:
+                return
+            try:
+                u = socket.create_connection(target, timeout=30)
+            except OSError:
+                c.close()
+                continue
+            threading.Thread(target=pump, args=(c, u),
+                             daemon=True).start()
+            threading.Thread(target=pump, args=(u, c, "down"),
+                             daemon=True).start()
+
+    threading.Thread(target=serve, daemon=True).start()
+    return lsock.getsockname(), stats, lsock
+
+
+def measure_fanout(observers=(1, 50, 500), settle_turns: int = 10_000,
+                   measure_secs: float = 4.0) -> dict:
+    """Broadcast-tier fan-out lane (ISSUE 12; gol_tpu.relay): N raw
+    binary observers watch the settled 512² fixture DIRECT off the
+    root vs through a 2-LEVEL relay chain (root -> relay1 -> relay2,
+    observers split across the relays), sweeping N over `observers`.
+
+    Per point: delivered engine turns, the root's true egress bytes
+    per observer-turn (a counting proxy in front of the root — in the
+    relay scenario the root's only peers are the relays, so this is
+    the number that must stay FLAT as N grows), and the root's
+    `encodes_per_chunk` (encode passes / chunks broadcast — the
+    zero-re-encode invariant: 1.0 however many peers, LOWER_BETTER
+    off a 1.0 baseline in bench_compare). Shed/overflow counters ride
+    along for the PR 7 off-zero infinite-regression rule."""
+    import selectors as _selectors
+    import socket as _socket
+
+    import jax
+
+    from gol_tpu.distributed import EngineServer
+    from gol_tpu.distributed import wire as _wire
+    from gol_tpu.distributed.server import _METRICS as _SRV
+    from gol_tpu.params import Params
+    from gol_tpu.parallel.stepper import make_stepper
+
+    st = make_stepper(threads=1, height=H, width=W,
+                      devices=[jax.devices()[0]])
+    q0, c = st.step_n(st.put(_world(W)), settle_turns)
+    int(c)
+    settled = st.fetch(q0)
+
+    def drive(n_obs: int, relay_levels: int) -> dict:
+        from gol_tpu.relay import RelayNode
+
+        p = Params(turns=10**9, threads=1, image_width=W,
+                   image_height=H, chunk=0, tick_seconds=60.0,
+                   image_dir="images", out_dir="out", cycle_detect=True)
+        server = EngineServer(p, port=0, initial_world=settled).start()
+        proxy_addr, stats, lsock = _fanout_proxy(server.address)
+        relays = []
+        tiers = [proxy_addr]
+        for _ in range(relay_levels):
+            r = RelayNode(tiers[-1], port=0).start()
+            relays.append(r)
+            if not r.synced.wait(60):
+                for rr in reversed(relays):
+                    rr.shutdown()
+                server.shutdown()
+                with contextlib.suppress(OSError):
+                    lsock.close()
+                return {"error": "relay never synced"}
+            tiers.append(r.address)
+        targets = tiers[1:] if relay_levels else [proxy_addr]
+        sel = _selectors.DefaultSelector()
+        socks = []
+        for i in range(n_obs):
+            s = _socket.create_connection(targets[i % len(targets)],
+                                          timeout=30)
+            s.settimeout(30)
+            # One shared max-k across every peer: direct observers
+            # negotiate the batch plane themselves (one encode cohort
+            # at the root); relay-attached ones say it to the relay,
+            # which already negotiated the same k upstream.
+            _wire.send_msg(s, {"t": "hello", "want_flips": True,
+                               "binary": True, "role": "observe",
+                               "batch": 1024})
+            s.setblocking(False)
+            sel.register(s, _selectors.EVENT_READ)
+            socks.append(s)
+        # Settle the attach storm (500 direct observers = 500 board
+        # syncs the engine must publish first) — wait, draining, until
+        # the stream demonstrably flows again, then measure cleanly.
+        mark = server.engine.completed_turns
+        grace = time.time() + 120
+        while (server.engine.completed_turns < mark + 1000
+               and time.time() < grace):
+            for key, _ in sel.select(0.2):
+                try:
+                    while key.fileobj.recv(1 << 16):
+                        pass
+                except (BlockingIOError, InterruptedError):
+                    pass
+                except OSError:
+                    with contextlib.suppress(Exception):
+                        sel.unregister(key.fileobj)
+        b0 = stats["down"]
+        e0, c0 = _SRV.chunk_encodes.value, _SRV.chunks.value
+        s0, o0 = _SRV.shed_frames.value, _SRV.overflows.value
+        t0 = server.engine.completed_turns
+        stop = time.time() + measure_secs
+        while time.time() < stop:
+            for key, _ in sel.select(0.2):
+                try:
+                    while key.fileobj.recv(1 << 16):
+                        pass
+                except (BlockingIOError, InterruptedError):
+                    pass
+                except OSError:
+                    with contextlib.suppress(Exception):
+                        sel.unregister(key.fileobj)
+        turns = server.engine.completed_turns - t0
+        root_bytes = stats["down"] - b0
+        encodes = _SRV.chunk_encodes.value - e0
+        chunks = _SRV.chunks.value - c0
+        shed = _SRV.shed_frames.value - s0
+        overflows = _SRV.overflows.value - o0
+        for s in socks:
+            with contextlib.suppress(OSError):
+                s.close()
+        for r in reversed(relays):
+            r.shutdown()
+        server.shutdown()
+        with contextlib.suppress(OSError):
+            lsock.close()
+        if not turns or not chunks:
+            return {"error": f"no stream in {measure_secs}s"}
+        return {
+            "turns": int(turns),
+            "root_bytes_per_observer_turn": round(
+                root_bytes / turns / max(n_obs, 1), 3
+            ),
+            "root_encodes_per_chunk": round(encodes / chunks, 3),
+            "shed_frames": shed,
+            "overflows": overflows,
+        }
+
+    out = {"board": f"{W}x{H} settled (turn {settle_turns}+)",
+           "tree": "direct vs 2-level relay chain"}
+    for n in observers:
+        out[f"direct_{n}"] = drive(n, 0)
+        out[f"relay2_{n}"] = drive(n, 2)
+    # The headline pair: the biggest sweep's per-observer root cost —
+    # direct pays O(peers), the tree pays O(relays).
+    big = max(observers)
+    d = out.get(f"direct_{big}", {})
+    r = out.get(f"relay2_{big}", {})
+    if "root_bytes_per_observer_turn" in d \
+            and "root_bytes_per_observer_turn" in r \
+            and r["root_bytes_per_observer_turn"]:
+        out["root_bytes_ratio_direct_vs_relay"] = round(
+            d["root_bytes_per_observer_turn"]
+            / r["root_bytes_per_observer_turn"], 1
+        )
+    return out
+
+
 def _lane(fn, *a, **kw):
     """Run one bench lane with the device plane bracketed: a dict lane
     result gains {"device_plane": {compiles, compile_seconds, split,

@@ -38,9 +38,16 @@ from gol_tpu.analysis.core import Finding, ModuleContext
 
 CHECK = "blocking-io-timeout"
 
-_SCOPE_PREFIX = "gol_tpu/distributed/"
-#: The one sanctioned raw-recv site: (path suffix, enclosing scope).
-_RECV_PRIMITIVE = ("wire.py", "_recv_exact")
+_SCOPE_PREFIX = ("gol_tpu/distributed/", "gol_tpu/relay/")
+#: Sanctioned raw-recv sites: (path suffix, enclosing scope). The
+#: relay tier adds two — the WS plane's exact-read primitive and its
+#: header-delimited upgrade reader (both deadline-disciplined the
+#: wire._recv_exact way).
+_RECV_PRIMITIVES = (
+    ("wire.py", "_recv_exact"),
+    ("ws.py", "_read_exact"),
+    ("ws.py", "handshake"),
+)
 _TIMEOUT_OPTS = {"SO_RCVTIMEO", "SO_SNDTIMEO"}
 
 
@@ -91,14 +98,16 @@ def run(ctx: ModuleContext) -> Iterator[Finding]:
         fn = node.func
         name = _tail(fn)
         if name in ("recv", "recv_into") and isinstance(fn, ast.Attribute):
-            if (ctx.rel.endswith(_RECV_PRIMITIVE[0])
-                    and ctx.scope_of(node) == _RECV_PRIMITIVE[1]):
+            if any(ctx.rel.endswith(suffix)
+                   and ctx.scope_of(node) == scope
+                   for suffix, scope in _RECV_PRIMITIVES):
                 continue
             yield ctx.finding(
                 CHECK, node,
-                f"raw socket .{name}() outside the wire read primitive "
-                f"({_RECV_PRIMITIVE[0]}::{_RECV_PRIMITIVE[1]}) — read "
-                "through wire.recv_msg on a deadlined socket instead",
+                f"raw socket .{name}() outside the sanctioned wire "
+                "read primitives (wire._recv_exact / ws._read_exact) "
+                "— read through wire.recv_msg on a deadlined socket "
+                "instead",
             )
         elif name == "create_connection":
             if len(node.args) >= 2 or any(
@@ -120,13 +129,15 @@ def run(ctx: ModuleContext) -> Iterator[Finding]:
                 f"'{_tail(fn.value)}' anywhere in this module — use "
                 "create_connection(timeout=...) or settimeout first",
             )
-        elif name == "recv_msg" and node.args:
+        elif name in ("recv_msg", "recv_frame") and node.args:
+            if ctx.rel.endswith("distributed/wire.py"):
+                continue  # the wire plane's own internal plumbing
             target = _tail(node.args[0])
             if target in deadlined:
                 continue
             yield ctx.finding(
                 CHECK, node,
-                f"wire.recv_msg on '{target}' but this module never "
+                f"wire.{name} on '{target}' but this module never "
                 "applies a read deadline to that socket (settimeout / "
                 "SO_RCVTIMEO) — a dead peer would block this thread "
                 "unboundedly",

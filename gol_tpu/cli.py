@@ -135,6 +135,27 @@ def build_parser() -> argparse.ArgumentParser:
                          "shape/rule bucket (a full bucket doubles, "
                          "which recompiles; churn within capacity "
                          "never does; default 16)")
+    ap.add_argument("--relay", default=None, metavar="HOST:PORT",
+                    help="run as a RELAY NODE (gol_tpu.relay): attach "
+                         "to the upstream server/relay at HOST:PORT as "
+                         "one batching binary client and re-serve its "
+                         "stream on --serve [HOST:]PORT to any number "
+                         "of observers, forwarding identical frame "
+                         "bytes with zero re-encode; reconnect and "
+                         "clock sync compose per hop (docs/RELAY.md)")
+    ap.add_argument("--ws-port", type=int, default=None,
+                    dest="ws_port", metavar="PORT",
+                    help="with --relay: also serve browser observers "
+                         "over RFC-6455 WebSocket on this port — the "
+                         "identical binary frames inside WS binary "
+                         "messages (subprotocol gol-tpu-wire)")
+    ap.add_argument("--writer-pool-threads", type=int, default=2,
+                    dest="writer_pool_threads", metavar="N",
+                    help="with --serve/--relay: selector event-loop "
+                         "threads draining every peer's outbound "
+                         "frames (thousands of sockets per thread; "
+                         "default 2, 0 restores a writer thread per "
+                         "connection)")
     ap.add_argument("--connect", default=None, metavar="HOST:PORT",
                     help="run as a controller attached to a remote engine")
     ap.add_argument("--session", default=None, metavar="ID",
@@ -368,8 +389,23 @@ def main(argv: Optional[list[str]] = None) -> int:
             "error: --resume applies to the engine (local or --serve), "
             "not to a --connect controller"
         )
-    if args.session is not None and args.connect is None:
-        raise SystemExit("error: --session requires --connect")
+    if args.session is not None and args.connect is None \
+            and args.relay is None:
+        raise SystemExit("error: --session requires --connect "
+                         "(or --relay, to fan a named session out)")
+    if args.relay is not None and args.sessions:
+        raise SystemExit(
+            "error: --relay attaches to a session server with "
+            "--session ID; --sessions starts one"
+        )
+    if args.ws_port is not None and args.relay is None:
+        # Before ANY serve-mode dispatch: a silently ignored WS port
+        # would leave an operator believing browsers are served.
+        raise SystemExit(
+            "error: --ws-port requires --relay (a root engine serves "
+            "browsers through a co-located relay: start one with "
+            "--relay HOST:PORT --serve PORT --ws-port N)"
+        )
     if args.sessions:
         # Multi-tenant serve mode: state lives per session under
         # out/sessions/, so the singleton snapshot discovery below
@@ -382,6 +418,19 @@ def main(argv: Optional[list[str]] = None) -> int:
                 "use --resume latest (or none)"
             )
         return _serve_sessions(args, params, resume_path == "latest")
+    if args.relay is not None:
+        # Relay node: no engine of its own — resume/snapshot flags
+        # make no sense here, and the downstream address is --serve.
+        if args.serve is None:
+            raise SystemExit(
+                "error: --relay needs --serve [HOST:]PORT for its "
+                "downstream listener"
+            )
+        if resume_path is not None:
+            raise SystemExit(
+                "error: --resume applies to an engine, not a relay"
+            )
+        return _relay(args)
     if resume_path == "latest":
         from gol_tpu.checkpoint import latest_snapshot
 
@@ -526,7 +575,8 @@ def _serve(args, params: Params, resume_path: Optional[str] = None) -> int:
                           drain_secs=args.drain_secs,
                           batch_turns=(args.batch_turns
                                        if args.batch_turns is not None
-                                       else 1024))
+                                       else 1024),
+                          writer_pool_threads=args.writer_pool_threads)
     print(f"engine serving on {server.address[0]}:{server.address[1]}")
     # Sidecar BEFORE the engine/broadcast threads: a failed port bind
     # aborts while nothing needing teardown is running (a bind failure
@@ -573,7 +623,8 @@ def _serve_sessions(args, params: Params, resume: bool) -> int:
                            drain_secs=args.drain_secs,
                            batch_turns=(args.batch_turns
                                         if args.batch_turns is not None
-                                        else 1024))
+                                        else 1024),
+                           writer_pool_threads=args.writer_pool_threads)
     print(f"session engine serving on "
           f"{server.address[0]}:{server.address[1]}")
     if resume:
@@ -601,6 +652,55 @@ def _serve_sessions(args, params: Params, resume: bool) -> int:
         print(f"session engine error: {server.engine.error!r}",
               file=sys.stderr)
         return 1
+    return 0
+
+
+def _relay(args) -> int:
+    """Relay node (gol_tpu.relay; docs/RELAY.md): attach upstream as
+    one batching binary client, re-serve the stream to N observers
+    (TCP on --serve, browsers on --ws-port) with zero re-encode.
+    Same exposure rules as --serve: loopback unless an explicit HOST,
+    --secret authenticates the upstream attach AND every downstream."""
+    from gol_tpu.relay import RelayNode
+
+    up = _addr(args.relay)
+    host, port = _addr(args.serve, default_host="127.0.0.1")
+    try:
+        relay = RelayNode(
+            up, host, port,
+            secret=args.secret,
+            session=args.session,
+            batch_turns=(args.batch_turns
+                         if args.batch_turns is not None else 1024),
+            heartbeat_secs=args.hb_secs,
+            evict_secs=args.evict_secs,
+            max_peers=args.max_peers,
+            high_water=args.high_water,
+            drain_secs=args.drain_secs,
+            writer_pool_threads=args.writer_pool_threads,
+            ws_port=args.ws_port,
+            reconnect_window=args.reconnect_secs,
+        )
+    except ValueError as e:
+        raise SystemExit(f"error: {e}") from None
+    print(f"relay serving on {relay.address[0]}:{relay.address[1]} "
+          f"(upstream {up[0]}:{up[1]})")
+    if relay.ws_address is not None:
+        print(f"websocket gateway on "
+              f"{relay.ws_address[0]}:{relay.ws_address[1]}")
+    metrics = _start_metrics(args, health=relay.health)
+    from gol_tpu.obs import flight as _flight
+
+    _flight.set_state_provider(relay.health)
+    relay.start()
+    try:
+        while not relay.wait(timeout=1.0):
+            pass
+    except KeyboardInterrupt:
+        relay.shutdown()
+    finally:
+        if metrics is not None:
+            metrics.close()
     return 0
 
 

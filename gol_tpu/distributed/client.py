@@ -680,45 +680,14 @@ class Controller:
         pinned by the fuzz suite's scripted-server test)."""
         if self.board is None:
             raise wire.WireError("batch frame before any board sync")
-        h, w = self.board.shape
-        total, nb = wire.grid_words(w, h)
-        if msg["nb"] != nb:
-            raise wire.WireError(
-                f"batch bitmap rows of {msg['nb']} words, this board "
-                f"needs {nb}"
-            )
-        counts = msg["counts"].astype(np.int64)
         k, first = int(msg["k"]), int(msg["first_turn"])
-        dbm, dwords = msg["dbitmaps"], msg["dwords"]
-        if total % 32 and dbm.size and np.any(
-                dbm[:, -1] >> np.uint32(total % 32)):
-            raise wire.WireError("batch bitmap bit outside the board grid")
-        t0 = max(0, self.synced_turn - first + 1)
+        t0 = apply_fbatch_raster(self.board, msg, self.synced_turn)
         if t0 >= k:
             return  # whole batch already inside the synced raster
-        nzt = np.flatnonzero(counts)  # turns with a nonzero delta row
-        offs = np.zeros(len(nzt) + 1, np.int64)
-        np.cumsum(counts[nzt], out=offs[1:])
-        reps = k - np.maximum(nzt, t0)
-        sel = np.flatnonzero((reps > 0) & (reps % 2 == 1))
-        if sel.size:
-            acc = np.zeros(total, np.uint32)
-            for i in sel:
-                idx = wire._bitmap_indices(dbm[i])
-                acc[idx] ^= dwords[offs[i]:offs[i + 1]]
-            fw = np.flatnonzero(acc)
-            if fw.size:
-                bits = (acc[fw, None]
-                        >> np.arange(32, dtype=np.uint32)) & 1
-                rr, bb = np.nonzero(bits)
-                x = fw[rr] % w
-                y = (fw[rr] // w) * 32 + bb
-                if y.size and int(y.max()) >= h:
-                    raise wire.WireError(
-                        "batch mask bit past the board height"
-                    )
-                self.board[y, x] ^= np.uint8(255)
         if not self._batch_flip_events:
+            # The high-rate watching mode (the 10⁵ turns/s path):
+            # per-turn TurnComplete only — none of the reconstruction
+            # state below is needed here.
             self.events.put_many(
                 [TurnComplete(first + t) for t in range(t0, k)]
             )
@@ -726,6 +695,11 @@ class Controller:
         # Exact per-turn surfacing: reconstruct each turn's flip set
         # from the delta chain (the slow-but-faithful mode; identical
         # to the unbatched event stream, pinned by test).
+        counts = msg["counts"].astype(np.int64)
+        total, nb = wire.grid_words(self.board.shape[1],
+                                    self.board.shape[0])
+        dbm, dwords = msg["dbitmaps"], msg["dwords"]
+        w, h = self.board.shape[1], self.board.shape[0]
         evs: list = []
         cur = np.zeros(total, np.uint32)
         bi = 0
@@ -883,6 +857,61 @@ class Controller:
         # configured directory) before the caller tears down.
         flight.dump("connection-lost")
         self.close()
+
+
+def apply_fbatch_raster(board: np.ndarray, msg: dict,
+                        floor_turn: int) -> int:
+    """Advance a shadow raster by one parsed _TAG_FBATCH frame in ONE
+    vectorized XOR pass, applying only turns PAST `floor_turn` (frames
+    are self-contained, so a frame straddling a resync applies just
+    its suffix — the gated prefix is already inside the synced
+    raster). Turn i's flips ride as D[i] = S[i] XOR S[i-1] (D[0] =
+    S[0]), so the net change over applied turns t0..k-1 is the XOR of
+    exactly the D rows appearing an ODD number of times in
+    Σ_{t>=t0} S[t] — D[j] appears (k - max(j, t0)) times. Shared by
+    the Controller and the relay tier (whose shadow is what new
+    downstream observers board-sync from). Returns t0, the first
+    applied row index (>= k when the whole frame was gated off);
+    raises WireError on any frame/board inconsistency."""
+    h, w = board.shape
+    total, nb = wire.grid_words(w, h)
+    if msg["nb"] != nb:
+        raise wire.WireError(
+            f"batch bitmap rows of {msg['nb']} words, this board "
+            f"needs {nb}"
+        )
+    counts = msg["counts"].astype(np.int64)
+    k, first = int(msg["k"]), int(msg["first_turn"])
+    dbm, dwords = msg["dbitmaps"], msg["dwords"]
+    if total % 32 and dbm.size and np.any(
+            dbm[:, -1] >> np.uint32(total % 32)):
+        raise wire.WireError("batch bitmap bit outside the board grid")
+    t0 = max(0, floor_turn - first + 1)
+    if t0 >= k:
+        return t0  # whole batch already inside the synced raster
+    nzt = np.flatnonzero(counts)  # turns with a nonzero delta row
+    offs = np.zeros(len(nzt) + 1, np.int64)
+    np.cumsum(counts[nzt], out=offs[1:])
+    reps = k - np.maximum(nzt, t0)
+    sel = np.flatnonzero((reps > 0) & (reps % 2 == 1))
+    if sel.size:
+        acc = np.zeros(total, np.uint32)
+        for i in sel:
+            idx = wire._bitmap_indices(dbm[i])
+            acc[idx] ^= dwords[offs[i]:offs[i + 1]]
+        fw = np.flatnonzero(acc)
+        if fw.size:
+            bits = (acc[fw, None]
+                    >> np.arange(32, dtype=np.uint32)) & 1
+            rr, bb = np.nonzero(bits)
+            x = fw[rr] % w
+            y = (fw[rr] // w) * 32 + bb
+            if y.size and int(y.max()) >= h:
+                raise wire.WireError(
+                    "batch mask bit past the board height"
+                )
+            board[y, x] ^= np.uint8(255)
+    return t0
 
 
 #: The name the coursework spec uses for this half of the split.

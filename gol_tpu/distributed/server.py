@@ -51,6 +51,7 @@ from gol_tpu import obs
 from gol_tpu.checkpoint import snapshot_turn
 from gol_tpu.obs import flight, tracing
 from gol_tpu.distributed import wire
+from gol_tpu.relay.writerpool import PoolFull, WriterPool
 from gol_tpu.engine.distributor import Engine
 from gol_tpu.events import (
     BoardSync,
@@ -142,27 +143,70 @@ class _ServerMetrics:
             "gol_tpu_server_peer_evicted_total",
             "Peers evicted for missing the heartbeat deadline",
         )
+        self.chunks = obs.counter(
+            "gol_tpu_server_broadcast_chunks_total",
+            "k-turn FlipChunk events fanned out by the broadcaster",
+        )
+        self.chunk_encodes = obs.counter(
+            "gol_tpu_server_chunk_encodes_total",
+            "FBATCH encode passes (one per chunk per distinct "
+            "negotiated max-k — encode-once fan-out means this tracks "
+            "chunks, not chunks x peers; the relay smoke's gate)",
+        )
 
 
 _METRICS = _ServerMetrics()
 
 
-def install_lag_gauge(conn: "_Conn") -> None:
-    """Per-peer backpressure visibility: how many frames behind this
-    peer's writer queue is. Bounded-cardinality discipline: the label
-    is the connection token and `remove_lag_gauge` evicts it at
-    detach, so the registry is O(attached peers), never O(ever-seen)."""
-    conn.lag_metric = obs.gauge(
+#: Labeled children the per-peer lag family exposes before collapsing
+#: the rest into an {peer="other"} aggregate — at relay-scale peer
+#: counts one labeled series per connection would be a scrape-payload
+#: and registry-cardinality problem, and nobody reads the 400th-worst
+#: peer's lag anyway.
+PEER_LAG_TOPK = 16
+
+
+def _lag_family() -> "obs.TopKGauge":
+    return obs.registry().topk_gauge(
         "gol_tpu_server_peer_lag_frames",
-        "Writer-queue depth (frames behind) per attached peer "
-        "(label evicted at detach)", {"peer": str(conn.token)},
+        "Writer-queue depth (frames behind) per attached peer — "
+        "bounded exposition: top-K worst labeled, the rest one "
+        "'other' aggregate; children evicted at detach",
+        label="peer", cap=PEER_LAG_TOPK,
     )
 
 
+class _LagHandle:
+    """Per-connection view onto the bounded lag family: .set() like
+    the old per-peer Gauge, so every call site is unchanged."""
+
+    __slots__ = ("_family", "_child")
+
+    def __init__(self, family, child: str):
+        self._family = family
+        self._child = child
+
+    def set(self, v: float) -> None:
+        self._family.set_child(self._child, v)
+
+    def remove(self) -> None:
+        self._family.remove_child(self._child)
+
+
+def install_lag_gauge(conn: "_Conn") -> None:
+    """Per-peer backpressure visibility: how many frames behind this
+    peer's writer queue is. Bounded-cardinality discipline: children
+    key on the connection token inside ONE TopKGauge entry (top-K
+    worst labeled + an 'other' aggregate), and `remove_lag_gauge`
+    evicts the child at detach, so both the registry and the
+    exposition stay bounded under churn."""
+    conn.lag_metric = _LagHandle(_lag_family(), str(conn.token))
+
+
 def remove_lag_gauge(conn: "_Conn") -> None:
+    if conn.lag_metric is not None:
+        conn.lag_metric.remove()
     conn.lag_metric = None
-    obs.registry().remove("gol_tpu_server_peer_lag_frames",
-                          {"peer": str(conn.token)})
 
 
 class _Conn:
@@ -204,6 +248,10 @@ class _Conn:
     #: that drains inside the deadline is resynced instead.
     DRAIN_SECS = 10.0
 
+    #: Hard cap on a peer's outbound queue, in frames — the control
+    #: plane's headroom above high_water lives under it (see _enqueue).
+    QUEUE_DEPTH = 1024
+
     def __init__(self, sock: socket.socket, want_flips: bool,
                  compact: bool = False, binary: bool = False,
                  levels: bool = False, role: str = "drive",
@@ -211,7 +259,8 @@ class _Conn:
                  batch: int = 0,
                  io_timeout: Optional[float] = None,
                  high_water: Optional[int] = None,
-                 drain_secs: Optional[float] = None):
+                 drain_secs: Optional[float] = None,
+                 pool: Optional[WriterPool] = None):
         #: "drive" (exclusive slot, verbs accepted) or "observe"
         #: (read-only: BoardSync + events, verbs rejected) — r5
         #: multi-observer serving (VERDICT r4 next #7).
@@ -283,15 +332,19 @@ class _Conn:
         #: still owed those flips).
         self.synced_turn = -1
         self._lock = threading.Lock()
-        # Outbound frames ride a bounded per-connection queue drained
-        # by this connection's OWN writer thread (started at attach):
-        # the broadcaster fans out to driver + observers sequentially,
-        # and a single wedged peer (SIGSTOP, blackholed path) blocking
-        # a direct sendall would stall every OTHER peer's stream for
-        # up to the 30s send timeout per frame. With the queue, a peer
-        # more than QUEUE_DEPTH frames behind is declared dead
-        # wait-free and detached by its own writer.
-        QUEUE_DEPTH = 1024
+        # Outbound frames ride a bounded per-connection queue: on the
+        # WRITER POOL (gol_tpu.relay.writerpool — the default for both
+        # servers and the relay tier: thousands of non-blocking
+        # sockets per event-loop thread) when `pool` is given, else
+        # drained by this connection's own writer thread (the legacy
+        # embedder path). Either way the broadcaster fans out wait-
+        # free: a single wedged peer (SIGSTOP, blackholed path) can
+        # only fill its own bounded queue, never stall another peer's
+        # stream, and a peer more than QUEUE_DEPTH frames behind is
+        # declared dead without blocking anyone.
+        QUEUE_DEPTH = self.QUEUE_DEPTH
+        self._pool = pool
+        self._handle = None  # PoolHandle once start_writer ran (pooled)
         self._out: "queue.Queue[bytes | None]" = queue.Queue(QUEUE_DEPTH)
         self._dead = threading.Event()
         self._writer: Optional[threading.Thread] = None
@@ -346,7 +399,7 @@ class _Conn:
             "BoardSync on drain)", self.token, self.high_water,
         )
         tracing.event("server.degrade", "lifecycle", role=self.role,
-                      token=self.token, queued=self._out.qsize())
+                      token=self.token, queued=self.queued())
         flight.note("server.degrade", role=self.role, token=self.token)
 
     def mark_recovered(self) -> None:
@@ -372,15 +425,15 @@ class _Conn:
         (queue above LOW_WATER) past `drain_secs` is the one overflow
         case left — declared dead exactly like the old queue-full
         death, without ever blocking the broadcaster."""
-        if self._writer is None:
+        if not self.writer_started:
             return True  # pre-attach: nothing to shed yet
         if not self.degraded:
-            if self._out.qsize() < self.high_water:
+            if self.queued() < self.high_water:
                 return True
             self.mark_degraded()
         _METRICS.shed_frames.inc()
         if (time.monotonic() - self.degraded_since > self.drain_secs
-                and self._out.qsize() > self.LOW_WATER):
+                and self.queued() > self.LOW_WATER):
             self._dead.set()
             if self.count_overflow():
                 _METRICS.overflows.inc()
@@ -403,11 +456,57 @@ class _Conn:
         """A degraded peer whose writer queue has drained to LOW_WATER
         is ready for its coalescing BoardSync."""
         return (self.degraded and not self.resync_pending
-                and self._out.qsize() <= self.LOW_WATER)
+                and self.queued() <= self.LOW_WATER)
+
+    @property
+    def writer_started(self) -> bool:
+        """Post-handshake: frames queue instead of sending directly
+        (the old `_writer is not None` test, pool-aware)."""
+        return self._writer is not None or self._handle is not None
+
+    def queued(self) -> int:
+        """Frames pending in this peer's writer queue — the number the
+        degradation thresholds (high_water / LOW_WATER) gate on,
+        whichever backend drains it."""
+        if self._handle is not None:
+            return self._handle.qsize()
+        return self._out.qsize()
+
+    def _wrap(self, payload: bytes) -> bytes:
+        """Frame one payload for this peer's transport (the writer
+        pool queues fully-framed bytes). The WS gateway's conns
+        override this with RFC-6455 binary framing."""
+        return wire.frame_bytes(payload)
+
+    def _send_now(self, payload: bytes) -> None:
+        """Blocking direct send on the caller's thread (pre-attach
+        handshake replies only) — transport-framed, serialized against
+        everything else by `_lock`. Emits the same per-frame
+        `wire.send` mark as every other send path, so handshake
+        replies don't vanish from merged timelines."""
+        with self._lock:
+            self.sock.sendall(self._wrap(payload))
+        tracing.event("wire.send", "wire", bytes=len(payload))
 
     def start_writer(self, on_error) -> None:
         """Begin queue-drained sending; `on_error(conn)` fires (from
-        the writer thread) when the peer's socket fails."""
+        the pool's loop thread, or the legacy writer thread) when the
+        peer's socket fails."""
+        if self._pool is not None:
+            try:
+                self._handle = self._pool.register(
+                    self.sock,
+                    on_error=lambda _h: (self._dead.set(),
+                                         on_error(self)),
+                    max_frames=self.QUEUE_DEPTH,
+                )
+            except RuntimeError:
+                # Pool already closed (attach racing shutdown): the
+                # peer is as dead as its server — surface the wire
+                # error the accept paths already handle.
+                self._dead.set()
+                raise wire.WireError("writer pool is closed") from None
+            return
         self._writer = threading.Thread(
             target=self._write_loop, args=(on_error,),
             name="gol-conn-writer", daemon=True,
@@ -440,17 +539,29 @@ class _Conn:
         self.last_tx = time.monotonic()
         _METRICS.frames.inc()
         _METRICS.frame_bytes.inc(len(payload))
-        if self._writer is None:
+        if not self.writer_started:
             # Pre-attach (handshake replies): direct, no queue yet.
-            with self._lock:
-                wire.send_frame(self.sock, payload)
+            self._send_now(payload)
+            return
+        if self._handle is not None:
+            try:
+                self._handle.enqueue(self._wrap(payload))
+            except BrokenPipeError:
+                self._dead.set()
+                raise wire.WireError("peer is gone") from None
+            except PoolFull:
+                # Even the shedding headroom is gone (control frames
+                # past the full queue bound): declare the peer dead
+                # without ever blocking the broadcaster.
+                self._dead.set()
+                if self.count_overflow():
+                    _METRICS.overflows.inc()
+                raise wire.WireError("peer send queue overflow") \
+                    from None
             return
         try:
             self._out.put_nowait(payload)
         except queue.Full:
-            # Even the shedding headroom is gone (control frames past
-            # the full QUEUE_DEPTH): declare the peer dead without
-            # ever blocking the broadcaster.
             self._dead.set()
             if self.count_overflow():
                 _METRICS.overflows.inc()
@@ -470,6 +581,15 @@ class _Conn:
         payload = json.dumps(msg, separators=(",", ":")).encode()
         _METRICS.frames.inc()
         _METRICS.frame_bytes.inc(len(payload))
+        if self._handle is not None:
+            # Pool mode: jump the backlog instead of bypassing the
+            # queue — the pool serializes the socket, so a true bypass
+            # could interleave into a frame mid-send. Front placement
+            # keeps the turnaround prompt (nothing queued overtakes
+            # it), which is the whole point of the probe echo.
+            with contextlib.suppress(BrokenPipeError, PoolFull):
+                self._handle.enqueue(self._wrap(payload), front=True)
+            return
         with self._lock:
             wire.send_frame(self.sock, payload)
 
@@ -482,13 +602,18 @@ class _Conn:
         and then exits. Pair with `join_writer`; `_drain_conns` fans
         the sentinels out to every peer FIRST so wedged writers drain
         concurrently instead of serializing shutdown."""
+        if self._handle is not None:
+            self._handle.request_finish()
+            return
         if self._writer is None:
             return
         with contextlib.suppress(queue.Full):
             self._out.put_nowait(None)
 
     def join_writer(self, timeout: float) -> None:
-        if self._writer is not None:
+        if self._handle is not None:
+            self._handle.join(timeout)
+        elif self._writer is not None:
             self._writer.join(timeout)
 
     def finish(self, timeout: Optional[float] = None) -> None:
@@ -505,12 +630,25 @@ class _Conn:
 
     def close(self) -> None:
         self._dead.set()
+        if self._handle is not None:
+            self._handle.kill()
         with contextlib.suppress(queue.Full):
-            self._out.put_nowait(None)  # release the writer
+            self._out.put_nowait(None)  # release the legacy writer
         with contextlib.suppress(OSError):
             self.sock.shutdown(socket.SHUT_RDWR)
         with contextlib.suppress(OSError):
             self.sock.close()
+
+
+def publish_listen_addr(address) -> None:
+    """One info-style gauge naming this process's serving address —
+    how `obs.console` joins a relay's `upstream` label to the endpoint
+    actually scraped, so the fan-out tree renders from metrics alone."""
+    obs.gauge(
+        "gol_tpu_server_listen_addr",
+        "Serving address of this process (info gauge, value 1)",
+        {"addr": f"{address[0]}:{address[1]}"},
+    ).set(1)
 
 
 def _clamp_batch(hello: dict, cap: int) -> int:
@@ -583,9 +721,17 @@ class EngineServer:
         drain_secs: Optional[float] = None,
         retry_after_secs: float = 1.0,
         batch_turns: int = 1024,
+        writer_pool_threads: int = 2,
         **engine_kwargs,
     ):
         self.params = params
+        #: Selector-based writer event loop (gol_tpu.relay.writerpool):
+        #: every attached peer's outbound frames ride one of these few
+        #: threads — thousands of sockets per thread instead of one
+        #: writer thread per connection (ROADMAP item 1's event-loop
+        #: half). 0 restores the legacy thread-per-connection writers.
+        self.pool = (WriterPool(writer_pool_threads, "gol-srv-writer")
+                     if writer_pool_threads > 0 else None)
         #: Server-side ceiling on a peer's hello "batch" request (the
         #: max turns one flip-batch frame may carry; CLI
         #: --batch-turns). 0 disables batch negotiation entirely —
@@ -638,6 +784,7 @@ class EngineServer:
         )
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
+        publish_listen_addr(self.address)
         self._conn: Optional[_Conn] = None
         #: Read-only observers fanned out from the same event stream —
         #: the controller ⇄ broker ⇄ workers topology's natural "one
@@ -670,9 +817,17 @@ class EngineServer:
         if stop_engine:
             self.engine.stop()
         with contextlib.suppress(OSError):
+            # SHUT_RDWR first: on Linux, close() alone does NOT wake a
+            # thread parked in accept() — the zombie accept holds the
+            # LISTEN socket alive and the port stays bound, so an
+            # in-process restart on the same address gets EADDRINUSE.
+            self._listener.shutdown(socket.SHUT_RDWR)
+        with contextlib.suppress(OSError):
             self._listener.close()
         self._drain_conns()
         self.engine.join(timeout=60)
+        if self.pool is not None:
+            self.pool.close()
         self.done.set()
 
     #: Per-peer writer-drain budget at teardown. Writers drain
@@ -799,7 +954,8 @@ class EngineServer:
                          delta=bool(hello.get("delta", False)),
                          batch=_clamp_batch(hello, self.batch_turns),
                          high_water=self.high_water,
-                         drain_secs=self.drain_secs)
+                         drain_secs=self.drain_secs,
+                         pool=self.pool)
             if role == "observe":
                 # Observers fan out freely — only the DRIVER slot is
                 # exclusive (its verbs steer the run).
@@ -840,7 +996,7 @@ class EngineServer:
             # with its wall clock, so the peer can estimate the
             # emit-stamp offset instead of documenting the skew. Legacy
             # peers ignore the unknown key.
-            ack = {"t": "attach-ack", "clock": True}
+            ack = {"t": "attach-ack", "clock": True, "depth": 0}
             if conn.batch:
                 # Confirm the clamped max-k, so the peer knows the
                 # granularity its frames will arrive at.
@@ -855,7 +1011,11 @@ class EngineServer:
             except (wire.WireError, OSError):
                 self._detach(conn)
                 continue
-            conn.start_writer(self._detach)
+            try:
+                conn.start_writer(self._detach)
+            except wire.WireError:
+                self._detach(conn)
+                continue
             tracing.event("server.attach", "lifecycle", role=role,
                           token=conn.token)
             flight.note("server.attach", role=role, token=conn.token)
@@ -1027,7 +1187,7 @@ class EngineServer:
             now = time.monotonic()
             turn = self.engine.completed_turns
             for conn in self._all_conns():
-                if conn._writer is None:
+                if not conn.writer_started:
                     # Mid-handshake: the attach-ack (which carries the
                     # hb cadence and must be the peer's FIRST message)
                     # is sent before start_writer — never overtake it.
@@ -1049,12 +1209,12 @@ class EngineServer:
                             token=conn.token,
                         )
                     elif (now - conn.degraded_since > conn.drain_secs
-                          and conn._out.qsize() > conn.LOW_WATER):
+                          and conn.queued() > conn.LOW_WATER):
                         log.warning(
                             "evicting peer %d: wedged %.1fs past the "
                             "drain deadline (%d frames queued)",
                             conn.token, now - conn.degraded_since,
-                            conn._out.qsize(),
+                            conn.queued(),
                         )
                         if conn.count_overflow():
                             _METRICS.overflows.inc()
@@ -1160,9 +1320,10 @@ class EngineServer:
         chunk here; shedding (offer_stream) gates whole batches."""
         k = len(ev.counts)
         last = ev.completed_turns
+        _METRICS.chunks.inc()
         depth = 0
         for c in conns:
-            q = c._out.qsize()
+            q = c.queued()
             depth = max(depth, q)
             if c.lag_metric is not None:
                 c.lag_metric.set(q)
@@ -1366,7 +1527,7 @@ class EngineServer:
                 # BoardSync at the engine's next dispatch boundary.
                 depth = 0
                 for c in conns:
-                    q = c._out.qsize()
+                    q = c.queued()
                     depth = max(depth, q)
                     if c.lag_metric is not None:
                         c.lag_metric.set(q)
@@ -1426,6 +1587,7 @@ def encode_batch_frames(counts, bitmaps, words, first_turn: int,
     broadcaster and the per-session sinks; observes the per-frame
     batch-size histogram."""
     total, nb = wire.grid_words(width, height)
+    _METRICS.chunk_encodes.inc()
     k = len(counts)
     frames = []
     for a in range(0, k, bsize):
@@ -1475,7 +1637,7 @@ class _SessionSink:
         granularity, encode gated after offer_stream."""
         conn = self._conn
         if conn.lag_metric is not None:
-            conn.lag_metric.set(conn._out.qsize())
+            conn.lag_metric.set(conn.queued())
         if conn.drained():
             conn.resync_pending = True
             mgr = self._server.manager
@@ -1540,7 +1702,7 @@ class _SessionSink:
     def on_turn(self, sid: str, turn: int) -> None:
         conn = self._conn
         if conn.lag_metric is not None:
-            conn.lag_metric.set(conn._out.qsize())
+            conn.lag_metric.set(conn.queued())
         if conn.drained():
             # Degraded peer drained inside the deadline: coalesce the
             # missed backlog into ONE fresh BoardSync. We are on the
@@ -1619,10 +1781,16 @@ class SessionServer:
         drain_secs: Optional[float] = None,
         retry_after_secs: float = 1.0,
         batch_turns: int = 1024,
+        writer_pool_threads: int = 2,
     ):
         from gol_tpu.sessions import SessionEngine, SessionManager
 
         self.params = params
+        #: The same writer event loop EngineServer rides (ROADMAP item
+        #: 1): session peers' frames drain through a few selector
+        #: threads, not one thread per connection.
+        self.pool = (WriterPool(writer_pool_threads, "gol-sess-writer")
+                     if writer_pool_threads > 0 else None)
         self.batch_turns = max(0, batch_turns)
         self.heartbeat_secs = max(0.0, heartbeat_secs)
         self.evict_secs = (
@@ -1660,6 +1828,7 @@ class SessionServer:
                                     idle_chunk=idle_chunk)
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
+        publish_listen_addr(self.address)
         self._conn_lock = threading.Lock()
         self._conns: "list[_Conn]" = []
         #: sid -> driving connection (one driver per session).
@@ -1689,6 +1858,12 @@ class SessionServer:
             return
         self._shutdown.set()
         with contextlib.suppress(OSError):
+            # SHUT_RDWR first: on Linux, close() alone does NOT wake a
+            # thread parked in accept() — the zombie accept holds the
+            # LISTEN socket alive and the port stays bound, so an
+            # in-process restart on the same address gets EADDRINUSE.
+            self._listener.shutdown(socket.SHUT_RDWR)
+        with contextlib.suppress(OSError):
             self._listener.close()
         # Close sinks through the manager first (each attached peer
         # gets its bye in-stream), then stop the dispatch loop.
@@ -1708,6 +1883,8 @@ class SessionServer:
         for conn in conns:
             conn.join_writer(max(0.1, deadline - time.monotonic()))
             conn.close()
+        if self.pool is not None:
+            self.pool.close()
         self.done.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -1795,7 +1972,8 @@ class SessionServer:
                      delta=bool(hello.get("delta", False)),
                      batch=_clamp_batch(hello, self.batch_turns),
                      high_water=self.high_water,
-                     drain_secs=self.drain_secs)
+                     drain_secs=self.drain_secs,
+                     pool=self.pool)
         if sid is not None and role == "drive":
             with self._conn_lock:
                 busy = sid in self._drivers
@@ -1815,7 +1993,8 @@ class SessionServer:
             _METRICS.peers.set(len(self._conns))
         _METRICS.attaches[role].inc()
         install_lag_gauge(conn)
-        ack = {"t": "attach-ack", "clock": True, "sessions": True}
+        ack = {"t": "attach-ack", "clock": True, "sessions": True,
+               "depth": 0}
         if conn.batch:
             ack["batch"] = conn.batch
         if sid is not None:
@@ -1827,7 +2006,11 @@ class SessionServer:
         except (wire.WireError, OSError):
             self._drop_conn(conn)
             return
-        conn.start_writer(self._drop_conn)
+        try:
+            conn.start_writer(self._drop_conn)
+        except wire.WireError:
+            self._drop_conn(conn)
+            return
         tracing.event("server.attach", "lifecycle", role=role,
                       token=conn.token, session=sid)
         flight.note("server.attach", role=role, token=conn.token,
@@ -1863,13 +2046,20 @@ class SessionServer:
                 self._drop_conn(conn)
                 return
             with self._conn_lock:
-                if conn not in self._conns:
-                    # The reader dropped the peer ('q', death) while
-                    # we were attaching: undo the sink registration.
-                    with contextlib.suppress(Exception):
-                        self.manager.detach(sid, sink)
-                else:
+                undo = conn not in self._conns
+                if not undo:
                     self._sinks[conn] = (sid, sink)
+            if undo:
+                # The reader dropped the peer ('q', death) while we
+                # were attaching: undo the sink registration — OUTSIDE
+                # _conn_lock. manager.detach blocks on the engine verb
+                # queue, and the engine thread may simultaneously be
+                # tearing a sink down through on_close -> _drop_conn,
+                # which needs _conn_lock: holding it across the verb
+                # deadlocks the whole serving plane (seen live as a
+                # ~60s stall until the verb deadline expired).
+                with contextlib.suppress(Exception):
+                    self.manager.detach(sid, sink)
 
     def _drop_conn(self, conn: _Conn, detach_sink: bool = True) -> None:
         """Remove one peer everywhere (idempotent; any thread). With
@@ -2102,7 +2292,7 @@ class SessionServer:
                 conns = list(self._conns)
                 sids = dict((c, s[0]) for c, s in self._sinks.items())
             for conn in conns:
-                if conn._writer is None:
+                if not conn.writer_started:
                     continue
                 if conn.degraded:
                     # Degradation owns this peer's verdict (the
@@ -2112,7 +2302,7 @@ class SessionServer:
                     # engine thread (the sink's on_turn — it needs the
                     # device); this loop only enforces the deadline.
                     if (now - conn.degraded_since > conn.drain_secs
-                            and conn._out.qsize() > conn.LOW_WATER):
+                            and conn.queued() > conn.LOW_WATER):
                         log.warning(
                             "evicting session peer %d: wedged %.1fs "
                             "past the drain deadline", conn.token,

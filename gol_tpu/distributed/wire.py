@@ -160,12 +160,20 @@ def _decompress(data: bytes, limit: Optional[int] = None) -> bytes:
     return out
 
 
+def frame_bytes(payload: bytes) -> bytes:
+    """Length-prefix one raw payload — the on-wire form of a frame.
+    The writer pool queues these (already framed, so a pool thread
+    never touches the encoding layer); `send_frame` is the blocking
+    twin for direct sends."""
+    if len(payload) > MAX_FRAME:
+        raise WireError(f"frame too large: {len(payload)} bytes")
+    return _LEN.pack(len(payload)) + payload
+
+
 def send_frame(sock: socket.socket, payload: bytes) -> None:
     """Length-prefix and send one raw payload (binary frame or encoded
     JSON) — the single sender both planes share."""
-    if len(payload) > MAX_FRAME:
-        raise WireError(f"frame too large: {len(payload)} bytes")
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    sock.sendall(frame_bytes(payload))
     # One instant mark per frame at THE send chokepoint both planes
     # share — the wire hop of the session timeline (gol_tpu.obs.tracing;
     # a no-op flag read when the plane is off).
@@ -197,6 +205,23 @@ def recv_msg(sock: socket.socket,
     heartbeat logic to judge; a deadline that expires MID-frame is a
     broken peer, not idleness, and raises WireError (resuming a
     half-read frame is impossible — the stream position is lost)."""
+    payload = recv_frame(sock)
+    if payload is None:
+        return None
+    msg = parse_payload(payload, allow_binary=allow_binary)
+    # The receive-side twin of send_frame's mark: frame size + decoded
+    # kind, so a merged timeline shows each hop's traffic inline.
+    tracing.event("wire.recv", "wire", bytes=len(payload), t=msg.get("t"))
+    return msg
+
+
+def recv_frame(sock: socket.socket) -> Optional[bytes]:
+    """Next RAW frame payload (length prefix stripped, nothing
+    parsed), or None on clean EOF at a frame boundary — the relay
+    tier's read primitive: a relay forwards these bytes verbatim
+    downstream (zero re-encode) and parses its own copy separately.
+    Deadline semantics are exactly recv_msg's (idle expiry →
+    TimeoutError, mid-frame → WireError)."""
     header = _recv_exact(sock, _LEN.size, allow_eof=True)
     if header is None:
         return None
@@ -204,24 +229,25 @@ def recv_msg(sock: socket.socket,
     if n > MAX_FRAME:
         raise WireError(f"frame too large: {n} bytes")
     try:
-        payload = _recv_exact(sock, n, allow_eof=False)
+        return _recv_exact(sock, n, allow_eof=False)
     except TimeoutError:
         raise WireError(
             "receive deadline expired mid-frame (header without payload)"
         ) from None
+
+
+def parse_payload(payload: bytes, allow_binary: bool = True) -> dict:
+    """One raw frame payload -> the message dict (JSON or parsed
+    binary frame) — recv_msg's decode half, shared with consumers
+    that keep the raw bytes (the relay)."""
     if payload[:1] == b"{":
         try:
-            msg = json.loads(payload.decode())
+            return json.loads(payload.decode())
         except (ValueError, UnicodeDecodeError) as e:
             raise WireError(f"malformed JSON frame: {e}") from None
-    elif not allow_binary:
+    if not allow_binary:
         raise WireError("unexpected binary frame on a control-only link")
-    else:
-        msg = _parse_frame(payload)
-    # The receive-side twin of send_frame's mark: frame size + decoded
-    # kind, so a merged timeline shows each hop's traffic inline.
-    tracing.event("wire.recv", "wire", bytes=n, t=msg.get("t"))
-    return msg
+    return _parse_frame(payload)
 
 
 def _recv_exact(sock: socket.socket, n: int, allow_eof: bool) -> Optional[bytes]:

@@ -50,6 +50,7 @@ from gol_tpu.obs.registry import (
     Gauge,
     Histogram,
     Registry,
+    TopKGauge,
     atomic_write_text,
     counter,
     enabled,
@@ -70,6 +71,7 @@ __all__ = [
     "MetricsServer",
     "REGISTRY",
     "Registry",
+    "TopKGauge",
     "atomic_write_text",
     "counter",
     "enabled",

@@ -49,11 +49,14 @@ from gol_tpu.obs.registry import (
 
 __all__ = [
     "Endpoint",
+    "build_tree",
     "fleet_snapshot",
     "histogram_buckets",
+    "label_value",
     "main",
     "parse_prometheus",
     "render",
+    "render_tree",
     "sum_series",
 ]
 
@@ -125,6 +128,19 @@ def sum_series(metrics: Series, name: str,
 def max_series(metrics: Series, name: str) -> Optional[float]:
     vals = [v for key, v in metrics.items() if _name_of(key) == name]
     return max(vals) if vals else None
+
+
+def label_value(metrics: Series, name: str,
+                label: str) -> Optional[str]:
+    """The `label` value of the first series of one family — for
+    info-style gauges (`gol_tpu_relay_node_info{listen,upstream}`,
+    `gol_tpu_server_listen_addr{addr}`) whose labels ARE the data."""
+    for key in metrics:
+        if _name_of(key) == name:
+            v = _labels_of(key).get(label)
+            if v is not None:
+                return v
+    return None
 
 
 def histogram_buckets(metrics: Series, name: str) -> list:
@@ -201,7 +217,25 @@ class Endpoint:
         lat = histogram_buckets(
             metrics, "gol_tpu_client_turn_latency_seconds"
         )
+        rtt = sum_series(metrics, "gol_tpu_relay_upstream_rtt_seconds")
         return {
+            # Topology identity (the relay tier's sidecar labels): how
+            # the fan-out tree is joined from scrapes alone.
+            "listen": (
+                label_value(metrics, "gol_tpu_relay_node_info",
+                            "listen")
+                or label_value(metrics, "gol_tpu_server_listen_addr",
+                               "addr")
+            ),
+            "upstream": label_value(metrics, "gol_tpu_relay_node_info",
+                                    "upstream"),
+            "depth": max_series(metrics, "gol_tpu_relay_depth"),
+            "relay_peers": sum_series(metrics, "gol_tpu_relay_peers"),
+            "ws_peers": sum_series(metrics, "gol_tpu_relay_ws_peers"),
+            "hop_latency_s": None if rtt is None else rtt / 2.0,
+            "hop_clock_offset_s": sum_series(
+                metrics, "gol_tpu_relay_clock_offset_seconds"
+            ),
             "endpoint": self.spec,
             "up": True,
             "turn": max_series(metrics, "gol_tpu_engine_committed_turn"),
@@ -238,9 +272,84 @@ class Endpoint:
         }
 
 
+def build_tree(rows: List[dict]) -> List[dict]:
+    """Join scraped endpoints into the fan-out topology: a relay's
+    `upstream` label matches its parent's `listen` label (roots export
+    `gol_tpu_server_listen_addr`, relays `gol_tpu_relay_node_info`).
+    Returns the forest of root nodes — each node carries depth, peer
+    counts (TCP + WS) and the per-hop added latency (half the hop's
+    min clock-probe RTT). Endpoints whose upstream is not scraped
+    become roots of their own subtree (partial scrapes stay useful);
+    an accidental relay cycle cannot recurse (visited set)."""
+    by_listen = {r["listen"]: r for r in rows
+                 if r.get("up") and r.get("listen")}
+    children: Dict[str, List[dict]] = {}
+    roots = []
+    for r in by_listen.values():
+        up = r.get("upstream")
+        if up and up in by_listen and up != r["listen"]:
+            children.setdefault(up, []).append(r)
+        else:
+            roots.append(r)
+    visited = set()
+
+    def node(r) -> dict:
+        visited.add(r["listen"])
+        kids = [c for c in sorted(children.get(r["listen"], []),
+                                  key=lambda x: x["listen"])
+                if c["listen"] not in visited]
+        return {
+            "endpoint": r["endpoint"],
+            "listen": r["listen"],
+            "upstream": r.get("upstream"),
+            "depth": r.get("depth"),
+            "peers": (r.get("relay_peers")
+                      if r.get("upstream") is not None
+                      else r.get("peers")),
+            "ws_peers": r.get("ws_peers"),
+            "hop_latency_s": r.get("hop_latency_s"),
+            "hop_clock_offset_s": r.get("hop_clock_offset_s"),
+            "children": [node(c) for c in kids],
+        }
+
+    forest = [node(r) for r in
+              sorted(roots, key=lambda x: x["listen"])]
+    # Pure cycles (A -> B -> A) have no root at all: promote their
+    # members so every scraped node appears exactly once.
+    for r in sorted(by_listen.values(), key=lambda x: x["listen"]):
+        if r["listen"] not in visited:
+            forest.append(node(r))
+    return forest
+
+
+def render_tree(tree: List[dict], out=None) -> None:
+    out = out or sys.stdout
+
+    def line(n, indent):
+        peers = n.get("peers")
+        ws = n.get("ws_peers")
+        bits = [f"{_num(peers)} peers" if peers is not None else "?"]
+        if ws:
+            bits.append(f"{_num(ws)} ws")
+        if n.get("hop_latency_s") is not None and n.get("upstream"):
+            bits.append(f"+{_num(n['hop_latency_s'], 's')}/hop")
+        tag = ("root" if not n.get("upstream")
+               else f"depth {_num(n.get('depth'))}")
+        out.write(f"{'  ' * indent}{'└─ ' if indent else ''}"
+                  f"{n['listen']}  [{tag}]  {', '.join(bits)}\n")
+        for c in n["children"]:
+            line(c, indent + 1)
+
+    if tree:
+        out.write("fan-out tree:\n")
+        for n in tree:
+            line(n, 0)
+
+
 def fleet_snapshot(endpoints: List[Endpoint]) -> dict:
     """Scrape every endpoint once; returns {"rows": [...], "total":
-    {...}, "down": [spec, ...]}. The TOTAL row merges latency
+    {...}, "down": [spec, ...], "tree": [...]} — `tree` is the relay
+    fan-out forest (build_tree). The TOTAL row merges latency
     histograms across endpoints BEFORE taking percentiles."""
     # Concurrent scrapes: one black-holed endpoint (a hanging TCP
     # connect eats its whole 5s timeout) must not freeze the healthy
@@ -280,7 +389,8 @@ def fleet_snapshot(endpoints: List[Endpoint]) -> dict:
             for q, p in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99))
         } if merged_lat else None,
     }
-    return {"rows": rows, "total": total, "down": down}
+    return {"rows": rows, "total": total, "down": down,
+            "tree": build_tree(rows)}
 
 
 # --- rendering -----------------------------------------------------------
@@ -368,6 +478,9 @@ def render(snap: dict, out=None, clear: bool = False) -> None:
             f"{c:>{width}}" if key != "endpoint" else f"{c:<{width}}"
             for (key, _, width, _), c in zip(_COLUMNS, cells)
         ) + "\n")
+    tree = snap.get("tree") or []
+    if any(n["children"] or n.get("upstream") for n in tree):
+        render_tree(tree, out)
     viol = snap["total"].get("violations")
     if viol:
         w(f"!! INVARIANT VIOLATIONS across the fleet: {int(viol)}\n")

@@ -53,6 +53,7 @@ __all__ = [
     "Histogram",
     "Registry",
     "REGISTRY",
+    "TopKGauge",
     "atomic_write_text",
     "counter",
     "enabled",
@@ -309,6 +310,73 @@ class Histogram(_Metric):
         return quantile_from_buckets(self.cumulative_buckets(), q)
 
 
+class TopKGauge(_Metric):
+    """Bounded-cardinality labeled gauge family — ONE registry entry
+    whose exposition emits at most `cap` labeled children (the top-cap
+    by value, the "worst" peers an operator actually wants named) plus
+    a single `{label="other"}` aggregate (max over the rest, with an
+    `<name>_other_children` companion so the hidden population is
+    visible). Per-PEER labels at relay-scale peer counts would
+    otherwise mint one registry child per connection: thousands of
+    series per scrape for peers whose lag is 0. Children live in a
+    plain dict — `set_child`/`remove_child` are O(1); ranking happens
+    at exposition time only. The registry stays O(cap) on the wire and
+    O(live children) in memory, and teardown (`remove_child`) keeps
+    the dict bounded under churn (pinned by the 1000-peer test)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help, labels, label: str = "peer",
+                 cap: int = 16):
+        super().__init__(name, help, labels)
+        if cap < 1:
+            raise ValueError("cap must be >= 1")
+        self.label = label
+        self.cap = cap
+        self._children: Dict[str, float] = {}
+
+    def set_child(self, child, v: float) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            self._children[str(child)] = float(v)
+
+    def remove_child(self, child) -> bool:
+        with self._lock:
+            return self._children.pop(str(child), None) is not None
+
+    def child_count(self) -> int:
+        return len(self._children)
+
+    def _ranked(self):
+        with self._lock:
+            items = list(self._children.items())
+        items.sort(key=lambda kv: (-kv[1], kv[0]))
+        return items[: self.cap], items[self.cap:]
+
+    def sample_lines(self):
+        top, rest = self._ranked()
+        for k, v in sorted(top):
+            yield (f"{self.name}"
+                   f"{_fmt_labels(self.labels, [(self.label, k)])}"
+                   f" {_fmt_value(v)}")
+        if rest:
+            other = max(v for _, v in rest)
+            yield (f"{self.name}"
+                   f"{_fmt_labels(self.labels, [(self.label, 'other')])}"
+                   f" {_fmt_value(other)}")
+            yield (f"{self.name}_other_children"
+                   f"{_fmt_labels(self.labels)} {len(rest)}")
+
+    def snapshot_value(self):
+        top, rest = self._ranked()
+        out = {"children": dict(top)}
+        if rest:
+            out["other"] = max(v for _, v in rest)
+            out["other_children"] = len(rest)
+        return out
+
+
 def quantile_from_buckets(buckets, q: float) -> Optional[float]:
     """`histogram_quantile` over cumulative `le` buckets: `buckets` is
     [(upper_bound, cumulative_count), ...] sorted by bound, +Inf last
@@ -411,6 +479,14 @@ class Registry:
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
         return self._get_or_create(Histogram, name, help, labels,
                                    buckets=buckets)
+
+    def topk_gauge(self, name: str, help: str = "",
+                   labels: Optional[dict] = None, *,
+                   label: str = "peer", cap: int = 16) -> TopKGauge:
+        """Bounded per-entity gauge family (see TopKGauge): exposition
+        cardinality is O(cap) however many children are live."""
+        return self._get_or_create(TopKGauge, name, help, labels,
+                                   label=label, cap=cap)
 
     def remove(self, name: str, labels: Optional[dict] = None) -> bool:
         """Evict one labeled series (e.g. a destroyed session's child

@@ -61,7 +61,12 @@ LOWER_BETTER = re.compile(
     # a lane whose compile count moves off a zero baseline started
     # recompiling mid-measurement (exactly what the recompile lint
     # exists to prevent), which is an infinite regression here.
-    r"|\bp(?:50|95|99)$|compiles)", re.I
+    r"|\bp(?:50|95|99)$|compiles"
+    # Broadcast tier (ISSUE 12): the fan-out lane's encodes-per-chunk
+    # sits at its 1.0 floor under zero-re-encode fan-out — any upward
+    # drift means the root started re-encoding per peer again (its
+    # shed/overflow deltas ride the off-zero rule above).
+    r"|encodes_per_chunk)", re.I
 )
 
 

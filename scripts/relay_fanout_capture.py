@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Merge the broadcast-tier fan-out lane into BENCH_DETAIL.json — the
+`wire_batch_capture.py` pattern applied to ISSUE 12's acceptance lane.
+
+Runs `bench.measure_fanout` — a real EngineServer on the settled 512²
+fixture behind a root-egress counting proxy, an observer sweep
+(1/50/500) attached DIRECT vs through a 2-level relay chain — with
+the device plane bracketed, and writes the result under
+
+    BENCH_DETAIL.json["fanout_512x512"]
+
+stamping the substrate platform. Gates (bench_compare picks these up
+by name): `root_encodes_per_chunk` LOWER_BETTER off its 1.0 floor,
+`root_bytes_per_observer_turn` LOWER_BETTER, shed/overflow deltas on
+the off-zero infinite-regression rule.
+
+Usage: python scripts/relay_fanout_capture.py   (CPU-safe; ~2 min)
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    import jax
+
+    from gol_tpu.obs import device
+
+    device.install_compile_watcher()
+
+    import bench
+
+    entry = bench._lane(bench.measure_fanout)
+    entry["platform"] = jax.devices()[0].platform
+
+    detail_path = REPO / "BENCH_DETAIL.json"
+    detail = json.loads(detail_path.read_text())
+    detail["fanout_512x512"] = entry
+    detail_path.write_text(json.dumps(detail, indent=1))
+    print(json.dumps(entry, indent=1))
+    big = entry.get("relay2_500", {})
+    ok = big.get("root_encodes_per_chunk", 99) <= 1.2
+    print(f"fanout_512x512: root encodes/chunk @500 via relay = "
+          f"{big.get('root_encodes_per_chunk')} "
+          f"({'OK' if ok else 'NOT MET'})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

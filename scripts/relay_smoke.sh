@@ -1,0 +1,237 @@
+#!/usr/bin/env bash
+# Relay smoke (ISSUE 12 / ROADMAP item 1 acceptance): boot a real root
+# --serve, chain TWO --relay nodes off it (a 2-level tree), drive 500+
+# concurrent raw observers through the relays on one host, and assert
+# on live /metrics that
+#   - the root's encode count tracks CHUNKS, not chunks x peers
+#     (zero re-encode fan-out: gol_tpu_server_chunk_encodes_total ~=
+#     gol_tpu_server_broadcast_chunks_total);
+#   - a leaf observer's board at each tier is BIT-IDENTICAL to a
+#     direct-attach client of the same run (compared after pausing
+#     the engine so every stream quiesces at one turn);
+#   - the root's CPU proxy (gol_tpu_writer_pool_busy_seconds_total)
+#     stays flat as the observer count DOUBLES 250 -> 500 (added
+#     leaves land on the relays, never on the root).
+#
+# Usage: scripts/relay_smoke.sh   (CPU-safe; ~2-3 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+LOG_ROOT=$(mktemp) LOG_R1=$(mktemp) LOG_R2=$(mktemp)
+OUT=$(mktemp -d)
+cleanup() {
+    for p in "${PID_R2:-}" "${PID_R1:-}" "${PID_ROOT:-}"; do
+        [ -n "$p" ] && kill "$p" 2>/dev/null || true
+    done
+    for p in "${PID_R2:-}" "${PID_R1:-}" "${PID_ROOT:-}"; do
+        [ -n "$p" ] && wait "$p" 2>/dev/null || true
+    done
+    rm -rf "$LOG_ROOT" "$LOG_R1" "$LOG_R2" "$OUT"
+}
+trap cleanup EXIT
+
+wait_addr() {  # $1 log, $2 sed pattern -> prints host:port
+    local addr=""
+    for _ in $(seq 1 240); do
+        addr=$(sed -n "$2" "$1" | head -1)
+        [ -n "$addr" ] && break
+        sleep 0.5
+    done
+    if [ -z "$addr" ]; then
+        echo "relay smoke: FAILED — no address in $1:" >&2
+        cat "$1" >&2
+        exit 1
+    fi
+    echo "$addr"
+}
+
+python -m gol_tpu --serve 127.0.0.1:0 -noVis -t 2 -w 512 -h 512 \
+    -turns 1000000000 --images fixtures/images --out "$OUT" \
+    --platform cpu --metrics-port 0 >"$LOG_ROOT" 2>&1 &
+PID_ROOT=$!
+ROOT=$(wait_addr "$LOG_ROOT" 's#^engine serving on \(.*\)$#\1#p')
+ROOT_MX=$(wait_addr "$LOG_ROOT" \
+    's#^metrics serving on \(http://[^/]*\)/metrics$#\1#p')
+echo "root at $ROOT (metrics $ROOT_MX)"
+
+python -m gol_tpu --relay "$ROOT" --serve 127.0.0.1:0 --platform cpu \
+    --metrics-port 0 >"$LOG_R1" 2>&1 &
+PID_R1=$!
+R1=$(wait_addr "$LOG_R1" 's#^relay serving on \([^ ]*\) .*$#\1#p')
+R1_MX=$(wait_addr "$LOG_R1" \
+    's#^metrics serving on \(http://[^/]*\)/metrics$#\1#p')
+echo "relay1 at $R1 (metrics $R1_MX)"
+
+python -m gol_tpu --relay "$R1" --serve 127.0.0.1:0 --platform cpu \
+    --metrics-port 0 >"$LOG_R2" 2>&1 &
+PID_R2=$!
+R2=$(wait_addr "$LOG_R2" 's#^relay serving on \([^ ]*\) .*$#\1#p')
+R2_MX=$(wait_addr "$LOG_R2" \
+    's#^metrics serving on \(http://[^/]*\)/metrics$#\1#p')
+echo "relay2 at $R2 (metrics $R2_MX)"
+
+JAX_PLATFORMS=cpu python - "$ROOT" "$R1" "$R2" "$ROOT_MX" "$R1_MX" \
+    "$R2_MX" <<'PYEOF'
+import selectors
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from gol_tpu.distributed import Controller, wire
+
+
+def addr(spec):
+    h, _, p = spec.rpartition(":")
+    return h, int(p)
+
+
+ROOT, R1, R2 = addr(sys.argv[1]), addr(sys.argv[2]), addr(sys.argv[3])
+ROOT_MX, R1_MX, R2_MX = sys.argv[4], sys.argv[5], sys.argv[6]
+
+
+def metric(base, name):
+    text = urllib.request.urlopen(base + "/metrics",
+                                  timeout=15).read().decode()
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            head = line.split(" ")[0]
+            if head == name or head.startswith(name + "{"):
+                total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+# Full clients: one direct at the root (the oracle view), one leaf on
+# each relay tier.
+# batch_turns matches the relays' negotiated max-k (1024, the server
+# default) so the root serves ONE encode cohort — a second k would
+# legitimately double the encode count (one pass per distinct k).
+direct = Controller(*ROOT, want_flips=True, batch=True,
+                    batch_turns=1024, observe=True,
+                    batch_flip_events=False)
+leaf1 = Controller(*R1, want_flips=True, batch=True, batch_turns=256,
+                   observe=True, batch_flip_events=False)
+leaf2 = Controller(*R2, want_flips=True, batch=True, batch_turns=256,
+                   observe=True, batch_flip_events=False)
+assert direct.wait_sync(120) and leaf1.wait_sync(120) \
+    and leaf2.wait_sync(120), "tier sync failed"
+print("direct + 2 leaf clients synced")
+
+# Raw observer horde: hello then drain bytes forever (no parsing —
+# these exist to load the tree, and relay degradation keeps the slow
+# ones alive by shedding).
+sel = selectors.DefaultSelector()
+horde = []
+
+
+def drain_loop():
+    while True:
+        for key, _ in sel.select(0.2):
+            try:
+                while key.fileobj.recv(1 << 16):
+                    pass
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError:
+                try:
+                    sel.unregister(key.fileobj)
+                except (KeyError, ValueError):
+                    pass
+
+
+threading.Thread(target=drain_loop, daemon=True).start()
+
+
+def attach_horde(address, n):
+    for _ in range(n):
+        s = socket.create_connection(address, timeout=30)
+        s.settimeout(30)
+        wire.send_msg(s, {"t": "hello", "want_flips": True,
+                          "binary": True, "role": "observe"})
+        s.setblocking(False)
+        sel.register(s, selectors.EVENT_READ)
+        horde.append(s)
+
+
+def busy_delta(secs):
+    b0 = metric(ROOT_MX, "gol_tpu_writer_pool_busy_seconds_total")
+    time.sleep(secs)
+    return metric(ROOT_MX, "gol_tpu_writer_pool_busy_seconds_total") - b0
+
+
+attach_horde(R1, 125)
+attach_horde(R2, 125)
+print("250 observers attached (125 per relay)")
+d250 = busy_delta(6.0)
+attach_horde(R1, 125)
+attach_horde(R2, 125)
+print("500 observers attached")
+d500 = busy_delta(6.0)
+print(f"root writer-pool busy: {d250:.4f}s @250 obs, "
+      f"{d500:.4f}s @500 obs")
+# Flatness: the root serves 2 relays + 1 direct client regardless of
+# leaf count — doubling observers must not double root CPU (generous
+# 2x + epsilon bound; the absolute numbers are fractions of a second).
+assert d500 <= 2.0 * d250 + 0.25, (
+    f"root CPU proxy scaled with observers: {d250:.4f} -> {d500:.4f}"
+)
+
+peers1 = metric(R1_MX, "gol_tpu_relay_peers")
+peers2 = metric(R2_MX, "gol_tpu_relay_peers")
+assert peers1 >= 250 and peers2 >= 250, (peers1, peers2)
+
+# Encode-once: root encode passes track chunks, not chunks x peers.
+chunks = metric(ROOT_MX, "gol_tpu_server_broadcast_chunks_total")
+encodes = metric(ROOT_MX, "gol_tpu_server_chunk_encodes_total")
+assert chunks > 0, "no chunk broadcasts at the root"
+assert encodes <= 1.2 * chunks + 4, (
+    f"root re-encoded per peer: {encodes} encodes vs {chunks} chunks"
+)
+print(f"encode-once OK: {encodes:.0f} encodes / {chunks:.0f} chunks")
+
+# Fan-out topology is visible to the fleet console.
+from gol_tpu.obs import console as con
+
+snap = con.fleet_snapshot([con.Endpoint(b) for b in
+                           (ROOT_MX, R1_MX, R2_MX)])
+tree = snap["tree"]
+assert len(tree) == 1, f"expected one root, got {tree}"
+assert len(tree[0]["children"]) == 1
+assert len(tree[0]["children"][0]["children"]) == 1
+assert tree[0]["children"][0]["depth"] == 1
+assert tree[0]["children"][0]["children"][0]["depth"] == 2
+print("console tree OK: root -> relay1 -> relay2")
+
+# Bit-identity: pause the engine (driver verb), let every stream
+# quiesce, then each tier's board must equal the direct client's.
+driver = Controller(*ROOT, want_flips=False)
+assert driver.wait_sync(60)
+driver.send_key("p")
+prev = None
+for _ in range(120):
+    time.sleep(0.5)
+    cur = (direct.sync_turn, np.count_nonzero(direct.board),
+           np.count_nonzero(leaf1.board), np.count_nonzero(leaf2.board))
+    if cur == prev:
+        break
+    prev = cur
+np.testing.assert_array_equal(
+    leaf1.board != 0, direct.board != 0,
+    err_msg="depth-1 leaf diverges from the direct client",
+)
+np.testing.assert_array_equal(
+    leaf2.board != 0, direct.board != 0,
+    err_msg="depth-2 leaf diverges from the direct client",
+)
+print("bit-identity OK at both relay tiers")
+
+driver.send_key("k")  # clean global shutdown: bye cascades down
+time.sleep(2)
+print("RELAY SMOKE PASS")
+PYEOF
+
+echo "relay smoke: PASS"

@@ -149,3 +149,85 @@ def test_once_mode_exit_codes_and_down_endpoint(capsys):
         assert "DOWN" in out and spec in out
     finally:
         srv.close()
+
+
+# --- relay fan-out tree (ISSUE 12) --------------------------------------
+
+
+def _node_registry(listen, upstream=None, depth=None, peers=0,
+                   rtt=None):
+    r = Registry()
+    if upstream is None:
+        r.gauge("gol_tpu_server_listen_addr",
+                labels={"addr": listen}).set(1)
+        r.gauge("gol_tpu_server_peers").set(peers)
+    else:
+        r.gauge("gol_tpu_relay_node_info",
+                labels={"listen": listen, "upstream": upstream}).set(1)
+        r.gauge("gol_tpu_relay_depth").set(depth)
+        r.gauge("gol_tpu_relay_peers").set(peers)
+        if rtt is not None:
+            r.gauge("gol_tpu_relay_upstream_rtt_seconds").set(rtt)
+    return r
+
+
+def test_tree_built_from_scraped_labels_and_json_shape():
+    """build_tree joins relays to parents by listen/upstream labels;
+    --once --json carries the tree so CI can assert its shape (the
+    relay smoke drives the live version)."""
+    servers, eps = [], []
+    specs = [
+        ("10.0.0.1:8030", None, None, 2),        # root, 2 relay peers
+        ("10.0.0.1:9001", "10.0.0.1:8030", 1, 250),
+        ("10.0.0.1:9002", "10.0.0.1:9001", 2, 250),
+        ("10.0.0.7:9009", "10.0.0.9:404", 3, 5),  # orphan upstream
+    ]
+    try:
+        for listen, upstream, depth, peers in specs:
+            srv = MetricsServer(
+                registry=_node_registry(listen, upstream, depth, peers,
+                                        rtt=0.004)
+            ).start()
+            servers.append(srv)
+            eps.append(console.Endpoint(
+                f"{srv.address[0]}:{srv.address[1]}"
+            ))
+        snap = console.fleet_snapshot(eps)
+        tree = snap["tree"]
+        # Two roots: the real one and the orphan (its upstream is not
+        # a scraped endpoint — partial scrapes stay useful).
+        assert {n["listen"] for n in tree} == {"10.0.0.1:8030",
+                                               "10.0.0.7:9009"}
+        root = next(n for n in tree if n["listen"] == "10.0.0.1:8030")
+        assert root["upstream"] is None and root["peers"] == 2
+        (r1,) = root["children"]
+        assert r1["listen"] == "10.0.0.1:9001"
+        assert r1["depth"] == 1 and r1["peers"] == 250
+        assert r1["hop_latency_s"] == pytest.approx(0.002)
+        (r2,) = r1["children"]
+        assert r2["listen"] == "10.0.0.1:9002" and r2["depth"] == 2
+        assert r2["children"] == []
+        # JSON round-trip: the whole snapshot (incl. tree) serializes.
+        rendered = io.StringIO()
+        console.render(snap, out=rendered)
+        assert "fan-out tree:" in rendered.getvalue()
+        assert "10.0.0.1:9002" in rendered.getvalue()
+        json.dumps(snap["tree"])
+    finally:
+        for srv in servers:
+            srv.close()
+
+
+def test_tree_survives_relay_cycles():
+    """An accidental A -> B -> A cycle must not recurse the builder."""
+    rows = [
+        {"up": True, "endpoint": "a", "listen": "h:1", "upstream": "h:2"},
+        {"up": True, "endpoint": "b", "listen": "h:2", "upstream": "h:1"},
+    ]
+    tree = console.build_tree(rows)
+    assert tree, "cycle collapsed to nothing"
+
+    def count(nodes):
+        return sum(1 + count(n["children"]) for n in nodes)
+
+    assert count(tree) == 2

@@ -1,0 +1,798 @@
+"""gol_tpu.relay — the broadcast tier (ISSUE 12, docs/RELAY.md):
+
+- WRITER POOL: ordering, priority frames, drain-then-finish, bounded
+  overflow, dead-peer error path — the selector event loop both
+  servers now ride instead of a writer thread per connection.
+- RELAY NODE: a 2-level tree (root -> relay -> relay -> leaf) delivers
+  a bit-identical final board (invariants ON) with zero re-encode
+  (root encode count == chunks, not chunks x peers), per-hop depth in
+  the attach-acks, bye propagation at run end.
+- DEGRADATION on the relay: a wedged downstream sheds whole frames on
+  the pool's queues, is made whole by ONE coalescing BoardSync from
+  the relay's shadow raster, and nothing else dies.
+- PER-HOP clock: a downstream probe's echo carries the relay's clock
+  PLUS its upstream offset, so offsets sum along the path.
+- WEBSOCKET gateway: a stdlib RFC-6455 client receives the identical
+  binary frames inside WS messages, pings carry the heartbeat plane.
+- BOUNDED per-peer metrics: the TopKGauge lag family stays O(cap)
+  through a 1000-peer attach/detach churn.
+"""
+
+import contextlib
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import obs
+from gol_tpu.distributed import wire
+from gol_tpu.params import Params
+from gol_tpu.relay import PoolFull, WriterPool
+from gol_tpu.relay import ws as wsp
+
+
+@pytest.fixture(autouse=True)
+def _invariants_on(monkeypatch):
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.analysis.invariants import violations_total
+
+    before = violations_total()
+    yield
+    assert violations_total() - before == 0, (
+        "a runtime invariant broke during a relay scenario"
+    )
+
+
+def _world(seed=7, w=64, h=64, density=0.3):
+    rng = np.random.default_rng(seed)
+    return ((rng.random((h, w)) < density).astype(np.uint8) * 255)
+
+
+def _params(tmp_path, turns=10 ** 9, w=64, h=64):
+    return Params(turns=turns, threads=1, image_width=w, image_height=h,
+                  out_dir=str(tmp_path / "out"), tick_seconds=60.0)
+
+
+def _wait(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+# --- writer pool ---------------------------------------------------------
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(30)
+    b.settimeout(30)
+    return a, b
+
+
+def _rx(sock, n):
+    # MSG_WAITALL is a no-op on timeout (non-blocking-fd) sockets —
+    # loop to an exact read.
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        assert chunk, "peer closed mid-read"
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def test_pool_preserves_frame_order_and_priority():
+    a, b = _pair()
+    pool = WriterPool(threads=1)
+    try:
+        h = pool.register(a)
+        for i in range(64):
+            h.enqueue(struct.pack(">I", i))
+        got = [struct.unpack(">I", _rx(b, 4))[0]
+               for _ in range(64)]
+        assert got == list(range(64))
+        # front=True jumps everything still queued (the clock echo).
+        h.enqueue(b"AAAA")
+        h.enqueue(b"BBBB", front=True)
+        data = _rx(b, 8)
+        assert data in (b"BBBBAAAA", b"AAAABBBB")  # race on empty queue
+    finally:
+        pool.close()
+        a.close()
+        b.close()
+
+
+def test_pool_finish_drains_then_sets_finished():
+    a, b = _pair()
+    pool = WriterPool(threads=1)
+    try:
+        h = pool.register(a)
+        payloads = [bytes([i]) * 100 for i in range(50)]
+        for p in payloads:
+            h.enqueue(p)
+        h.request_finish()
+        h.join(10)
+        assert h.finished.is_set()
+        got = _rx(b, 5000)
+        assert got == b"".join(payloads), "finish dropped queued frames"
+        assert h.qsize() == 0
+    finally:
+        pool.close()
+        a.close()
+        b.close()
+
+
+def test_pool_overflow_raises_without_blocking():
+    a, b = _pair()
+    pool = WriterPool(threads=1)
+    try:
+        h = pool.register(b, max_frames=8)
+        # Nobody reads from `a` and the payloads dwarf the socket
+        # buffer, so the queue must fill and overflow wait-free.
+        with pytest.raises(PoolFull):
+            for _ in range(64):
+                h.enqueue(b"x" * 262144)
+    finally:
+        pool.close()
+        a.close()
+        b.close()
+
+
+def test_pool_dead_peer_fires_on_error_once():
+    a, b = _pair()
+    pool = WriterPool(threads=1)
+    errs = []
+    try:
+        h = pool.register(a, on_error=lambda hh: errs.append(hh))
+        b.close()
+        deadline = time.monotonic() + 10
+        while not errs and time.monotonic() < deadline:
+            try:
+                h.enqueue(b"y" * 65536)
+            except (BrokenPipeError, PoolFull):
+                break
+            time.sleep(0.02)
+        _wait(lambda: errs or h.dead, 10, "pool error callback")
+        assert len(errs) <= 1, "on_error fired more than once"
+    finally:
+        pool.close()
+        a.close()
+
+
+def test_pool_many_sockets_one_thread():
+    """Thousands-of-sockets shape: 64 peers on ONE loop thread all
+    drain correctly (the census gauge tracks registration)."""
+    pool = WriterPool(threads=1)
+    pairs = [_pair() for _ in range(64)]
+    try:
+        handles = [pool.register(a) for a, _ in pairs]
+        assert pool.sockets() == 64
+        for i, h in enumerate(handles):
+            for j in range(8):
+                h.enqueue(struct.pack(">II", i, j))
+        for i, (_, b) in enumerate(pairs):
+            for j in range(8):
+                assert struct.unpack(
+                    ">II", _rx(b, 8)
+                ) == (i, j)
+    finally:
+        pool.close()
+        for a, b in pairs:
+            a.close()
+            b.close()
+
+
+# --- bounded per-peer metric cardinality ---------------------------------
+
+
+def test_topk_gauge_bounded_under_thousand_peer_churn():
+    """The ISSUE's cardinality pin: 1000 attach/detach cycles through
+    the peer-lag family keep BOTH the exposition (<= cap + other) and
+    the registry (one entry) bounded, and a full detach leaves zero
+    children behind."""
+    reg = obs.Registry()
+    fam = reg.topk_gauge("lag_test", "x", label="peer", cap=16)
+    for i in range(1000):
+        fam.set_child(f"p{i}", float(i % 37))
+        if i >= 100:
+            fam.remove_child(f"p{i - 100}")  # rolling churn window
+    assert fam.child_count() == 100
+    lines = list(fam.sample_lines())
+    assert len(lines) <= 16 + 2, lines  # top-K + other + other_count
+    assert sum(1 for m in reg.metrics() if m.name == "lag_test") == 1
+    text = reg.prometheus_text()
+    assert text.count("lag_test{") <= 17
+    assert 'peer="other"' in text
+    # The 'other' aggregate is the max of the hidden population.
+    top_vals = sorted((float(i % 37) for i in range(900, 1000)),
+                      reverse=True)
+    import re
+
+    m = re.search(r'lag_test\{peer="other"\} (\S+)', text)
+    assert m and float(m.group(1)) == top_vals[16]
+    for i in range(900, 1000):
+        fam.remove_child(f"p{i}")
+    assert fam.child_count() == 0
+    assert list(fam.sample_lines()) == []
+
+
+def test_server_lag_family_evicts_children_at_detach(tmp_path):
+    """1000-peer churn against the REAL server family helpers: the
+    process registry ends exactly where it started."""
+    from gol_tpu.distributed.server import (
+        _lag_family,
+        install_lag_gauge,
+        remove_lag_gauge,
+    )
+
+    fam = _lag_family()
+    before = fam.child_count()
+
+    class _C:  # the two attributes the helpers touch
+        def __init__(self, token):
+            self.token = token
+            self.lag_metric = None
+
+    conns = []
+    for i in range(1000):
+        c = _C(10_000 + i)
+        install_lag_gauge(c)
+        c.lag_metric.set(i)
+        conns.append(c)
+    assert fam.child_count() == before + 1000
+    text = obs.registry().prometheus_text()
+    assert text.count("gol_tpu_server_peer_lag_frames{") <= 17
+    for c in conns:
+        remove_lag_gauge(c)
+    assert fam.child_count() == before
+
+
+# --- relay tree end-to-end -----------------------------------------------
+
+
+def _oracle(world, turns):
+    from gol_tpu.parallel.stepper import make_stepper
+
+    s = make_stepper(threads=1, height=world.shape[0],
+                     width=world.shape[1])
+    out, _ = s.step_n(s.put(world), int(turns))
+    return np.asarray(s.fetch(out), np.uint8)
+
+
+def test_two_level_relay_tree_bit_identical_final(tmp_path):
+    """The acceptance shape at test scale: root -> relay(depth 1) ->
+    relay(depth 2) -> leaf; the run ENDS (finite turns), the bye
+    propagates down every hop, and the leaf's final board — advanced
+    exclusively by forwarded FBATCH bytes — is bit-identical to the
+    fused-stepper oracle AND to a direct-attach client of the same
+    run. Root encode count stays == chunk count (zero re-encode)."""
+    from gol_tpu.distributed import Controller, EngineServer
+    from gol_tpu.distributed.server import _METRICS
+    from gol_tpu.relay import RelayNode
+
+    world = _world(11)
+    turns = 240
+    enc0 = _METRICS.chunk_encodes.value
+    chk0 = _METRICS.chunks.value
+    srv = EngineServer(_params(tmp_path, turns=turns), port=0,
+                       batch_turns=32, initial_world=world).start()
+    r1 = RelayNode(srv.address, port=0).start()
+    assert r1.synced.wait(30)
+    r2 = RelayNode(r1.address, port=0).start()
+    assert r2.synced.wait(30)
+    assert (r1.depth, r2.depth) == (1, 2)
+    direct = Controller(*srv.address, want_flips=True, batch=True,
+                        batch_turns=32, observe=True, reconnect=False)
+    leaf = Controller(*r2.address, want_flips=True, batch=True,
+                      batch_turns=32, observe=True, reconnect=False)
+    assert direct.wait_sync(30) and leaf.wait_sync(30)
+    try:
+        # Run to completion: every stream must end CLEANLY (bye
+        # propagated hop by hop), no reconnect storms.
+        _wait(lambda: leaf.events.closed and direct.events.closed,
+              90, "clean end-of-run at every tier")
+        want = _oracle(world, turns)
+        np.testing.assert_array_equal(
+            direct.board != 0, want != 0,
+            err_msg="direct-attach client diverges from the oracle",
+        )
+        np.testing.assert_array_equal(
+            leaf.board != 0, want != 0,
+            err_msg="2-hop relay leaf diverges from the oracle",
+        )
+        encodes = _METRICS.chunk_encodes.value - enc0
+        chunks = _METRICS.chunks.value - chk0
+        assert chunks > 0
+        # One encode per chunk per distinct negotiated k — the relay
+        # and the direct client negotiated the same k, so encode
+        # count tracks chunks, NOT chunks x peers.
+        assert encodes <= chunks + 2, (encodes, chunks)
+    finally:
+        leaf.close()
+        direct.close()
+        r2.shutdown()
+        r1.shutdown()
+        srv.shutdown()
+
+
+def _raw_relay_attach(address, want_flips=True, binary=True,
+                      rcvbuf=4096, **extra):
+    s = socket.create_connection(address, timeout=30)
+    with contextlib.suppress(OSError):
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+    s.settimeout(30)
+    wire.send_msg(s, {"t": "hello", "want_flips": want_flips,
+                      "binary": binary, "role": "observe", **extra})
+    return s, wire.recv_msg(s, allow_binary=False)
+
+
+def test_wedged_relay_downstream_degrades_then_resumes_bit_exact(
+        tmp_path):
+    """The acceptance pin: a downstream that stops reading DEGRADES on
+    the pool's queues (sheds whole batches, counter moves) instead of
+    dying or wedging a pool thread; on drain ONE coalescing BoardSync
+    from the relay's shadow makes it whole — bit-identical to the
+    shadow it was synced from — and the stream continues exactly."""
+    from gol_tpu.distributed import EngineServer
+    from gol_tpu.distributed.server import _METRICS
+    from gol_tpu.relay import RelayNode
+    from gol_tpu.distributed.client import apply_fbatch_raster
+
+    deg0 = _METRICS.degradations.value
+    rec0 = _METRICS.recoveries.value
+    # 128²: active boards + tiny high_water = degradation in under a
+    # second of not reading (and a drainable backlog after the pause).
+    world = _world(5, w=128, h=128)
+    srv = EngineServer(_params(tmp_path, w=128, h=128), port=0,
+                       batch_turns=16, initial_world=world).start()
+    relay = RelayNode(srv.address, port=0, high_water=16,
+                      drain_secs=120.0, heartbeat_secs=0.2).start()
+    assert relay.synced.wait(30)
+    s, ack = _raw_relay_attach(relay.address)
+    assert ack and ack.get("t") == "attach-ack", ack
+    try:
+        # Read to the attach sync, then STALL.
+        msg = wire.recv_msg(s)
+        while msg.get("t") != "board":
+            msg = wire.recv_msg(s)
+        turn, shadow = wire.msg_to_board(msg)
+        shadow = np.array(shadow, np.uint8)
+        _wait(lambda: _METRICS.degradations.value > deg0, 60,
+              "degradation entry on the relay")
+        # UNSTALL: drain; the coalescing sync must arrive and match
+        # the relay's shadow bit-for-bit at its stamped turn; frames
+        # after it keep applying cleanly (nothing double-applied).
+        resynced = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            msg = wire.recv_msg(s)
+            assert msg is not None
+            t = msg.get("t")
+            if t == "board":
+                turn, shadow = wire.msg_to_board(msg)
+                shadow = np.array(shadow, np.uint8)
+                if _METRICS.recoveries.value > rec0:
+                    resynced = True
+                    break
+            elif t == "fbatch":
+                apply_fbatch_raster(shadow, msg, turn)
+                turn = max(turn, msg["first_turn"] + msg["k"] - 1)
+        assert resynced, "no coalescing BoardSync after the drain"
+
+        # PAUSE the engine so the stream quiesces (the slow reader
+        # can never catch a live 192² firehose — that is the point of
+        # degradation), then drain the whole backlog. The delivered
+        # history may hold MORE degradation cycles (sync, frames,
+        # sync, ...): a board frame re-syncs, an fbatch advances
+        # contiguously — feeding them in order must land EXACTLY on
+        # the relay's shadow, or something double-applied.
+        srv._keys.put("p")
+        # Re-open the receive window for the drain: the 4KB rcvbuf
+        # exists to force the stall, not to make the comparison crawl.
+        with contextlib.suppress(OSError):
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
+        settled = relay.turn
+        for _ in range(100):
+            time.sleep(0.05)
+            if relay.turn == settled and relay.turn > 0:
+                break
+            settled = relay.turn
+        view = {"turn": turn, "board": shadow}
+
+        def feed(sock, v, msg):
+            t = msg.get("t")
+            if t == "board":
+                tt, b = wire.msg_to_board(msg)
+                v["turn"], v["board"] = tt, np.array(b, np.uint8)
+            elif t == "fbatch":
+                apply_fbatch_raster(v["board"], msg, v["turn"])
+                v["turn"] = max(v["turn"],
+                                msg["first_turn"] + msg["k"] - 1)
+
+        deadline = time.monotonic() + 120
+        s.settimeout(2.0)
+        while view["turn"] < relay.turn \
+                and time.monotonic() < deadline:
+            try:
+                msg = wire.recv_msg(s)
+            except TimeoutError:
+                continue
+            assert msg is not None, "stream ended mid-drain"
+            feed(s, view, msg)
+        assert view["turn"] == relay.turn, (view["turn"], relay.turn)
+        np.testing.assert_array_equal(
+            view["board"] != 0, relay.board != 0,
+            err_msg="recovered stream diverges from the relay shadow",
+        )
+        # And a fresh observer of the quiesced relay sees the same
+        # raster over the wire.
+        s2, ack2 = _raw_relay_attach(relay.address)
+        assert ack2.get("t") == "attach-ack"
+        m2 = wire.recv_msg(s2)
+        while m2.get("t") != "board":
+            m2 = wire.recv_msg(s2)
+        t2, fresh = wire.msg_to_board(m2)
+        assert t2 == view["turn"]
+        np.testing.assert_array_equal(
+            view["board"] != 0, np.array(fresh, np.uint8) != 0,
+            err_msg="recovered stream diverges from a fresh observer",
+        )
+        s2.close()
+    finally:
+        s.close()
+        relay.shutdown()
+        srv.shutdown()
+
+
+def test_relay_reconnects_upstream_and_resyncs_downstream(tmp_path):
+    """PR 3 composes per hop: the upstream link dies ABRUPTLY (no
+    bye — the crash shape; a clean bye deliberately propagates the
+    end-of-run instead), the relay re-dials with backoff,
+    re-handshakes, and every downstream is made whole by the
+    forwarded BoardSync — the leaf sees a second board frame on the
+    SAME connection."""
+    from gol_tpu.relay import RelayNode
+
+    listener, t, stop, conns = _scripted_upstream()
+    relay = RelayNode(listener.getsockname(), port=0,
+                      reconnect_window=60.0, reconnect_seed=1).start()
+    try:
+        assert relay.synced.wait(30)
+        leaf, ack = _raw_relay_attach(relay.address)
+        assert ack.get("t") == "attach-ack"
+        m = wire.recv_msg(leaf)
+        while m.get("t") != "board":
+            m = wire.recv_msg(leaf)
+        # Abrupt upstream death: hard-close the accepted socket.
+        with contextlib.suppress(OSError):
+            conns[0].shutdown(socket.SHUT_RDWR)
+        conns[0].close()
+        _wait(lambda: relay.reconnects >= 1, 60,
+              "relay upstream reconnect")
+        # The re-handshake's BoardSync fans out as a resync: the SAME
+        # leaf connection receives a second board frame.
+        saw_resync = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                m = wire.recv_msg(leaf)
+            except TimeoutError:
+                continue
+            assert m is not None, "leaf stream died across the hop"
+            if m.get("t") == "board":
+                saw_resync = True
+                break
+        assert saw_resync, "no downstream resync after the reconnect"
+        leaf.close()
+    finally:
+        stop.set()
+        listener.close()
+        relay.shutdown()
+
+
+def test_clock_offsets_sum_along_the_path(tmp_path):
+    """A downstream probe's echo is the relay's clock PLUS its
+    upstream offset — synthetic 5s skew on the hop shows up exactly
+    once in the echo."""
+    from gol_tpu.distributed import EngineServer
+    from gol_tpu.relay import RelayNode
+
+    srv = EngineServer(_params(tmp_path), port=0,
+                       initial_world=_world(2)).start()
+    relay = RelayNode(srv.address, port=0).start()
+    assert relay.synced.wait(30)
+    s, ack = _raw_relay_attach(relay.address)
+    assert ack.get("clock") is True
+    try:
+        # The REAL probe run must complete against the upstream (the
+        # first probe rides the dialing socket — _up_sock is not yet
+        # installed when it fires): on loopback the estimate snaps to
+        # 0.0, and an unmeasured None here means the chain never
+        # started.
+        _wait(lambda: relay.clock_offset is not None, 30,
+              "upstream clock probe run")
+        assert relay.upstream_rtt is not None
+        relay.clock_offset = 5.0  # synthetic upstream skew
+        t0 = time.time()
+        wire.send_msg(s, {"t": "clk", "t0": t0})
+        while True:
+            msg = wire.recv_msg(s)
+            if msg.get("t") == "clk" and msg.get("t0") == t0:
+                break
+        skewed = float(msg["ts"]) - time.time()
+        assert 4.0 < skewed < 6.0, (
+            f"echo ts is {skewed:+.3f}s from local — the 5s upstream "
+            "offset did not sum into the hop"
+        )
+    finally:
+        s.close()
+        relay.shutdown()
+        srv.shutdown()
+
+
+def test_relay_rejects_incapable_hellos_cleanly(tmp_path):
+    """The capability floor (binary frames) is a reasoned reject,
+    never a silent incompatible stream; a flip-LESS binary observer
+    (the -noVis leaf) is SERVED — board sync, heartbeats, turn/alive
+    events — without ever receiving the raster stream it didn't
+    subscribe to."""
+    from gol_tpu.distributed import EngineServer
+    from gol_tpu.relay import RelayNode
+
+    srv = EngineServer(_params(tmp_path), port=0,
+                       initial_world=_world(2)).start()
+    relay = RelayNode(srv.address, port=0,
+                      heartbeat_secs=0.2).start()
+    assert relay.synced.wait(30)
+    try:
+        s = socket.create_connection(relay.address, timeout=30)
+        s.settimeout(30)
+        wire.send_msg(s, {"t": "hello", "role": "observe",
+                          "want_flips": True, "binary": False})
+        r = wire.recv_msg(s, allow_binary=False)
+        assert r == {"t": "error", "reason": "relay-binary-only"}, r
+        s.close()
+        # Flip-less binary observer: admitted, synced, beaconed — and
+        # NO flip-plane frames in its stream.
+        nf, ack = _raw_relay_attach(relay.address, want_flips=False)
+        assert ack.get("t") == "attach-ack", ack
+        saw_board = saw_hb = False
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not (saw_board
+                                                   and saw_hb):
+            m = wire.recv_msg(nf)
+            assert m.get("t") not in ("fbatch", "flips", "dflips"), (
+                "flip-plane frame reached a flip-less observer"
+            )
+            saw_board = saw_board or m.get("t") == "board"
+            saw_hb = saw_hb or m.get("t") == "hb"
+        assert saw_board and saw_hb
+        nf.close()
+    finally:
+        relay.shutdown()
+        srv.shutdown()
+
+
+def test_loop_to_self_upstream_refused(tmp_path):
+    from gol_tpu.relay import RelayNode
+
+    probe = socket.create_server(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    with pytest.raises(ValueError, match="loops back"):
+        RelayNode(("127.0.0.1", port), port=port)
+
+
+# --- WebSocket gateway ---------------------------------------------------
+
+
+def _ws_connect(address, hello=None):
+    s = socket.create_connection(address, timeout=30)
+    s.settimeout(30)
+    key = "dGhlIHNhbXBsZSBub25jZQ=="
+    s.sendall((
+        "GET /stream HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+        "Connection: Upgrade\r\n"
+        f"Sec-WebSocket-Key: {key}\r\n"
+        "Sec-WebSocket-Protocol: gol-tpu-wire\r\n"
+        "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        chunk = s.recv(4096)
+        assert chunk, "gateway closed during handshake"
+        resp += chunk
+    head = resp.split(b"\r\n", 1)[0]
+    assert b"101" in head, resp
+    assert wsp.accept_key(key).encode() in resp
+    if hello is not None:
+        s.sendall(wsp.encode_frame(
+            wsp.OP_TEXT, json.dumps(hello).encode(), mask=True
+        ))
+    return s
+
+
+def test_ws_gateway_streams_identical_frames(tmp_path):
+    """A stdlib WS client: handshake, hello, then the IDENTICAL
+    binary payloads a TCP observer gets — board + fbatch frames
+    reconstruct the oracle's final board bit-exactly; server pings
+    carry the heartbeat plane and our pongs keep us attached."""
+    from gol_tpu.distributed import EngineServer
+    from gol_tpu.distributed.client import apply_fbatch_raster
+    from gol_tpu.relay import RelayNode
+
+    world = _world(13)
+    turns = 160
+    srv = EngineServer(_params(tmp_path, turns=turns), port=0,
+                       batch_turns=16, initial_world=world).start()
+    relay = RelayNode(srv.address, port=0, ws_port=0,
+                      heartbeat_secs=0.2).start()
+    assert relay.synced.wait(30)
+    s = _ws_connect(relay.ws_address,
+                    {"t": "hello", "want_flips": True, "binary": True,
+                     "hb": True, "batch": 16})
+    board, turn, pings, closed = None, -1, 0, False
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                op, payload = wsp.read_message(s, require_mask=False)
+            except (wsp.WSError, OSError):
+                break
+            if op == wsp.OP_PING:
+                pings += 1
+                s.sendall(wsp.encode_frame(wsp.OP_PONG, payload or b"",
+                                           mask=True))
+                continue
+            if op == wsp.OP_CLOSE:
+                closed = True
+                break
+            if op not in (wsp.OP_BINARY, wsp.OP_TEXT):
+                continue
+            msg = wire.parse_payload(payload)
+            t = msg.get("t")
+            if t == "board":
+                turn, b = wire.msg_to_board(msg)
+                board = np.array(b, np.uint8)
+            elif t == "fbatch" and board is not None:
+                apply_fbatch_raster(board, msg, turn)
+                turn = max(turn, msg["first_turn"] + msg["k"] - 1)
+            elif t == "bye":
+                closed = True
+                break
+        assert board is not None and turn == turns, (turn, turns)
+        assert closed, "stream did not end cleanly at the final turn"
+        assert pings >= 0  # beacons ride idle gaps; pinned separately
+        np.testing.assert_array_equal(
+            board != 0, _oracle(world, turns) != 0,
+            err_msg="WS-reconstructed board diverges from the oracle",
+        )
+    finally:
+        s.close()
+        relay.shutdown()
+        srv.shutdown()
+
+
+def _scripted_upstream():
+    """A fake quiet root: accepts the relay, acks, sends one board,
+    then stays silent — the idle stream on which heartbeat beacons
+    (WS pings downstream) actually fire, and whose accepted sockets
+    the reconnect test can kill abruptly. Returns (listener, thread,
+    stop_event, conns)."""
+    listener = socket.create_server(("127.0.0.1", 0))
+    stop = threading.Event()
+    conns: list = []
+
+    def serve():
+        while not stop.is_set():
+            try:
+                s, _ = listener.accept()
+            except OSError:
+                return
+            conns.append(s)
+            try:
+                s.settimeout(30)
+                wire.recv_msg(s, allow_binary=False)  # hello
+                wire.send_msg(s, {"t": "attach-ack", "clock": True,
+                                  "depth": 0, "batch": 16})
+                s.sendall(wire.frame_bytes(wire.board_to_frame(
+                    0, _world(1), 0
+                )))
+                while not stop.wait(0.2):
+                    try:
+                        s.settimeout(0.05)
+                        m = wire.recv_msg(s, allow_binary=False)
+                    except TimeoutError:
+                        continue  # idle: keep serving
+                    except (wire.WireError, OSError):
+                        break  # link died: back to accept
+                    if m is None:
+                        break
+                    if m.get("t") == "clk":
+                        wire.send_msg(s, {"t": "clk",
+                                          "t0": m.get("t0"),
+                                          "ts": time.time()})
+            except Exception:
+                pass
+            finally:
+                with contextlib.suppress(OSError):
+                    s.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return listener, t, stop, conns
+
+
+def test_ws_ping_pong_heartbeat_plane(tmp_path):
+    """Beacons ride idle gaps: against a quiet upstream, the gateway
+    sends WS pings on the heartbeat cadence; a ponging client stays
+    attached well past the eviction window, a mute one is evicted."""
+    from gol_tpu.relay import RelayNode
+
+    listener, t, stop, _conns = _scripted_upstream()
+    relay = RelayNode(listener.getsockname(), port=0, ws_port=0,
+                      heartbeat_secs=0.2).start()
+    try:
+        assert relay.synced.wait(30)
+        s = _ws_connect(relay.ws_address,
+                        {"t": "hello", "want_flips": True,
+                         "binary": True, "hb": True})
+        pings = 0
+        deadline = time.monotonic() + 3.0  # 5 eviction windows
+        while time.monotonic() < deadline:
+            try:
+                op, payload = wsp.read_message(s, require_mask=False)
+            except (wsp.WSError, OSError, TimeoutError):
+                pytest.fail("ponging WS client lost its link")
+            if op == wsp.OP_PING:
+                pings += 1
+                s.sendall(wsp.encode_frame(wsp.OP_PONG, payload or b"",
+                                           mask=True))
+        assert pings >= 3, f"only {pings} pings in 3s at 0.2s cadence"
+        # Now go mute: the hb plane must evict us.
+        evicted = False
+        s.settimeout(10)
+        try:
+            for _ in range(200):
+                op, _payload = wsp.read_message(s, require_mask=False)
+                if op == wsp.OP_CLOSE:
+                    evicted = True
+                    break
+        except (wsp.WSError, OSError, TimeoutError):
+            evicted = True  # reset/EOF: the eviction closed us
+        assert evicted, "mute WS client was never evicted"
+        s.close()
+    finally:
+        stop.set()
+        listener.close()
+        relay.shutdown()
+
+
+def test_ws_gateway_rejects_bad_upgrade(tmp_path):
+    from gol_tpu.distributed import EngineServer
+    from gol_tpu.relay import RelayNode
+
+    srv = EngineServer(_params(tmp_path), port=0,
+                       initial_world=_world(2)).start()
+    relay = RelayNode(srv.address, port=0, ws_port=0).start()
+    assert relay.synced.wait(30)
+    try:
+        # A plain-HTTP GET (no websocket headers) is refused and the
+        # gateway lives on.
+        s = socket.create_connection(relay.ws_address, timeout=10)
+        s.settimeout(10)
+        s.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+        assert s.recv(4096) in (b"",) or True  # closed, no upgrade
+        s.close()
+        good = _ws_connect(relay.ws_address,
+                           {"t": "hello", "want_flips": True,
+                            "binary": True})
+        good.close()
+    finally:
+        relay.shutdown()
+        srv.shutdown()

@@ -949,3 +949,276 @@ def test_fbatch_straddling_board_sync_applies_only_the_suffix():
         ctl.close()
     finally:
         listener.close()
+
+
+# --- relay hello / forwarded-frame fuzz (gol_tpu.relay, ISSUE 12) ---
+
+
+def _quiet_upstream(world_seed=1):
+    """Scripted quiet root for relay fuzz: ack + one board, then echo
+    clk and answer hb until stopped. Returns (listener, stop, conns)."""
+    import contextlib
+    import threading
+    import time as _time
+
+    listener = socket.create_server(("127.0.0.1", 0))
+    stop = threading.Event()
+    conns = []
+    rng = np.random.default_rng(world_seed)
+    world = (rng.random((48, 48)) < 0.3).astype(np.uint8) * 255
+
+    def serve():
+        while not stop.is_set():
+            try:
+                s, _ = listener.accept()
+            except OSError:
+                return
+            conns.append(s)
+            try:
+                s.settimeout(30)
+                wire.recv_msg(s, allow_binary=False)
+                wire.send_msg(s, {"t": "attach-ack", "clock": True,
+                                  "depth": 0, "batch": 16})
+                s.sendall(wire.frame_bytes(
+                    wire.board_to_frame(0, world, 0)
+                ))
+                while not stop.wait(0.1):
+                    try:
+                        s.settimeout(0.05)
+                        m = wire.recv_msg(s, allow_binary=False)
+                    except TimeoutError:
+                        continue
+                    except (wire.WireError, OSError):
+                        break
+                    if m is None:
+                        break
+                    if m.get("t") == "clk":
+                        wire.send_msg(s, {"t": "clk", "t0": m.get("t0"),
+                                          "ts": _time.time()})
+            except Exception:
+                pass
+            finally:
+                with contextlib.suppress(OSError):
+                    s.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+    return listener, stop, conns
+
+
+@pytest.fixture()
+def fuzz_relay():
+    from gol_tpu.relay import RelayNode
+
+    listener, stop, conns = _quiet_upstream()
+    relay = RelayNode(listener.getsockname(), port=0, ws_port=0,
+                      heartbeat_secs=0.5).start()
+    assert relay.synced.wait(30)
+    yield relay, conns
+    stop.set()
+    listener.close()
+    relay.shutdown()
+
+
+def _attach_observer(address, **extra):
+    s = socket.create_connection(address, timeout=30)
+    s.settimeout(30)
+    wire.send_msg(s, {"t": "hello", "want_flips": True, "binary": True,
+                      "role": "observe", **extra})
+    return s, wire.recv_msg(s, allow_binary=False)
+
+
+def test_relay_hello_lying_max_k_attacks(fuzz_relay):
+    """Hostile `batch` re-advertisements (huge, negative, bool,
+    string, float) never crash the relay or negotiate an impossible
+    frame size: the ack's batch is the relay's own honest upstream
+    granularity, bounded by FBATCH_MAX_TURNS, whatever the peer
+    claimed."""
+    relay, _ = fuzz_relay
+    for lie in (1 << 62, -5, True, "all-of-them", 3.14, None,
+                wire.FBATCH_MAX_TURNS * 16):
+        s, ack = _attach_observer(relay.address, batch=lie)
+        assert ack and ack.get("t") == "attach-ack", (lie, ack)
+        assert 0 < ack["batch"] <= wire.FBATCH_MAX_TURNS, (lie, ack)
+        assert ack.get("depth") == 1
+        s.close()
+    # Hostile role values degrade to observer semantics, not crashes.
+    s, ack = _attach_observer(relay.address, role={"x": 1})
+    assert ack.get("t") == "attach-ack"
+    s.close()
+
+
+def test_relay_survives_truncated_forwarded_frames(fuzz_relay):
+    """A corrupt/truncated frame from the UPSTREAM kills that link,
+    never the relay: the supervised reader re-dials, re-handshakes,
+    and the downstream observer sees a resync board on the SAME
+    connection (the 'truncated forwarded frames' attack of ISSUE 12
+    lands on the hop that received it, not on the tree below)."""
+    relay, conns = fuzz_relay
+    s, ack = _attach_observer(relay.address)
+    m = wire.recv_msg(s)
+    while m.get("t") != "board":
+        m = wire.recv_msg(s)
+    up = conns[-1]
+    # Mid-frame truncation: a length prefix promising 4096 bytes,
+    # then 10 bytes and a hard close.
+    with __import__("contextlib").suppress(OSError):
+        up.sendall(struct.pack(">I", 4096) + b"\x07garbage...")
+        up.close()
+    deadline = time.monotonic() + 30
+    saw_resync = False
+    while time.monotonic() < deadline:
+        try:
+            m = wire.recv_msg(s)
+        except TimeoutError:
+            continue
+        assert m is not None, "downstream stream died with its relay"
+        if m.get("t") == "board":
+            saw_resync = True
+            break
+    assert saw_resync, "no resync after the upstream reconnect"
+    assert len(conns) >= 2, "relay never re-dialed its upstream"
+    s.close()
+
+
+def test_relay_rejects_binary_frames_on_downstream_control_link(
+        fuzz_relay):
+    """The downstream reader is control-only (hellos, verbs, pongs):
+    a peer pushing a bulk binary frame at the relay is detached
+    cleanly, and the relay serves the next peer."""
+    relay, _ = fuzz_relay
+    s, ack = _attach_observer(relay.address)
+    assert ack.get("t") == "attach-ack"
+    s.sendall(wire.frame_bytes(wire.flips_to_frame(1, [[1, 1]])))
+    s.settimeout(10)
+    with pytest.raises((wire.WireError, OSError, ConnectionError,
+                        TimeoutError)):
+        while True:
+            if wire.recv_msg(s) is None:
+                raise ConnectionError("clean EOF")
+    s.close()
+    s2, ack2 = _attach_observer(relay.address)
+    assert ack2.get("t") == "attach-ack"
+    s2.close()
+
+
+# --- WebSocket framing abuse (gol_tpu.relay.ws, ISSUE 12) ---
+
+
+def _ws_upgrade(address):
+    from gol_tpu.relay import ws as wsp
+
+    s = socket.create_connection(address, timeout=30)
+    s.settimeout(30)
+    key = "ZnV6ei1jbGllbnQta2V5IQ=="
+    s.sendall((
+        "GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+        "Connection: Upgrade\r\n"
+        f"Sec-WebSocket-Key: {key}\r\n"
+        "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        chunk = s.recv(4096)
+        assert chunk, "gateway closed during upgrade"
+        resp += chunk
+    assert b"101" in resp.split(b"\r\n", 1)[0]
+    return s, wsp
+
+
+def _ws_hello(s, wsp):
+    import json as _json
+
+    s.sendall(wsp.encode_frame(
+        wsp.OP_TEXT,
+        _json.dumps({"t": "hello", "want_flips": True,
+                     "binary": True}).encode(),
+        mask=True,
+    ))
+    # Read to the attach-ack so the peer is fully admitted.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        op, payload = wsp.read_message(s, require_mask=False)
+        if op == wsp.OP_BINARY and payload[:1] == b"{":
+            import json as _json2
+
+            if _json2.loads(payload).get("t") == "attach-ack":
+                return
+    raise AssertionError("no attach-ack over WS")
+
+
+def _expect_clean_detach(s, wsp):
+    """The fuzzed WS peer must be detached CLEANLY: a close frame or
+    EOF/reset — never a hung connection, and never a dead gateway."""
+    s.settimeout(10)
+    try:
+        for _ in range(64):
+            op, _ = wsp.read_message(s, require_mask=False)
+            if op == wsp.OP_CLOSE:
+                return
+    except (Exception,):
+        return  # EOF / reset: also a clean server-side detach
+    raise AssertionError("fuzzed WS peer was never detached")
+
+
+@pytest.mark.parametrize("abuse", [
+    "unmasked-data",
+    "oversized-length",
+    "fragmented-ping",
+    "oversized-control",
+    "unknown-opcode",
+    "orphan-continuation",
+    "interleaved-data",
+])
+def test_ws_framing_abuse_detaches_cleanly(fuzz_relay, abuse):
+    relay, _ = fuzz_relay
+    s, wsp = _ws_upgrade(relay.ws_address)
+    _ws_hello(s, wsp)
+    if abuse == "unmasked-data":
+        # RFC 6455 §5.1: server MUST fail the connection.
+        s.sendall(wsp.encode_frame(wsp.OP_TEXT, b'{"t":"hb"}',
+                                   mask=False))
+    elif abuse == "oversized-length":
+        # 64-bit length far past MAX_MESSAGE, no payload.
+        s.sendall(struct.pack("!BBQ", 0x82, 0x80 | 127, 1 << 40)
+                  + b"\x00" * 4)
+    elif abuse == "fragmented-ping":
+        s.sendall(wsp.encode_frame(wsp.OP_PING, b"x", fin=False,
+                                   mask=True))
+    elif abuse == "oversized-control":
+        s.sendall(struct.pack("!BBH", 0x89, 0x80 | 126, 500)
+                  + b"\x00" * 4 + b"p" * 500)
+    elif abuse == "unknown-opcode":
+        s.sendall(wsp.encode_frame(0x3, b"??", mask=True))
+    elif abuse == "orphan-continuation":
+        s.sendall(wsp.encode_frame(0x0, b"tail", mask=True))
+    elif abuse == "interleaved-data":
+        s.sendall(wsp.encode_frame(wsp.OP_TEXT, b"part", fin=False,
+                                   mask=True))
+        s.sendall(wsp.encode_frame(wsp.OP_TEXT, b"again", mask=True))
+    _expect_clean_detach(s, wsp)
+    s.close()
+    # The gateway survives: a well-behaved client attaches after.
+    s2, wsp2 = _ws_upgrade(relay.ws_address)
+    _ws_hello(s2, wsp2)
+    s2.close()
+
+
+def test_ws_fragmented_hello_accepted(fuzz_relay):
+    """LEGAL fragmentation must work: a hello split across two
+    continuation fragments is one message."""
+    import json as _json
+
+    relay, _ = fuzz_relay
+    s, wsp = _ws_upgrade(relay.ws_address)
+    payload = _json.dumps({"t": "hello", "want_flips": True,
+                           "binary": True}).encode()
+    s.sendall(wsp.encode_frame(wsp.OP_TEXT, payload[:7], fin=False,
+                               mask=True))
+    s.sendall(wsp.encode_frame(0x0, payload[7:], mask=True))
+    deadline = time.monotonic() + 10
+    acked = False
+    while time.monotonic() < deadline and not acked:
+        op, body = wsp.read_message(s, require_mask=False)
+        if op == wsp.OP_BINARY and body[:1] == b"{":
+            acked = _json.loads(body).get("t") == "attach-ack"
+    assert acked, "fragmented hello was not assembled"
+    s.close()
