@@ -832,6 +832,95 @@ def measure_wire_watched_batch(sweep=(16, 64, 256, 1024),
     return out
 
 
+def measure_activity(side: int = 32768, tile: int = 1024,
+                     turns: int = 64, soup_side: int = 512,
+                     seed: int = 7) -> dict:
+    """Activity-driven stepping lane (ISSUE 13 acceptance): a
+    localized soup on a side² board, tiled vs dense A/B with an
+    IN-LANE bit-identity gate — the committed tiled world must equal
+    the dense packed stepper's, bit for bit, or the lane reports the
+    mismatch instead of a speedup (the dryrun-oracle discipline
+    applied to the activity plane).
+
+    Both sides step the same 32-turn chunks with a per-chunk count
+    realization; each side's first chunk (its compile) is excluded
+    from the sustained rate, its turns are not — the A/B compares
+    steady-state dispatch cost on identical turn histories. The lane
+    records the activity plane's own accounting (active tiles, tile
+    steps/rides, paged bytes) so bench_compare gates
+    `active_tiles`/`paged_bytes` LOWER and `speedup` HIGHER."""
+    import numpy as np
+
+    from gol_tpu.parallel import tiled as tiled_mod
+    from gol_tpu.parallel.stepper import make_stepper
+
+    chunk = 32
+    assert turns % chunk == 0 and turns >= 2 * chunk
+    rng = np.random.default_rng(seed)
+    board = np.zeros((side, side), np.uint8)
+    r0 = c0 = (side - soup_side) // 2
+    board[r0:r0 + soup_side, c0:c0 + soup_side] = (
+        (rng.random((soup_side, soup_side)) < 0.35) * 255
+    ).astype(np.uint8)
+
+    def run(stepper):
+        world = stepper.put(board)
+        per_chunk = []
+        count = 0
+        for _ in range(turns // chunk):
+            t0 = time.perf_counter()
+            world, count = stepper.step_n(world, chunk)
+            count = int(count)  # realize: the chunk really ran
+            per_chunk.append(time.perf_counter() - t0)
+        sustained = (turns - chunk) / max(sum(per_chunk[1:]), 1e-9)
+        return world, count, sustained, sum(per_chunk)
+
+    dense = make_stepper(threads=1, height=side, width=side,
+                         backend="packed")
+    dw, dcount, dense_tps, dense_wall = run(dense)
+
+    m = tiled_mod._METRICS
+    before = {
+        "steps": m.tile_steps.value, "rides": m.tile_rides.value,
+        "skips": m.tile_skips.value,
+        "paged": sum(c.value for c in m.paged.values()),
+    }
+    tiled = make_stepper(threads=1, height=side, width=side, tile=tile)
+    tw, tcount, tiled_tps, tiled_wall = run(tiled)
+
+    bit_identical = (dcount == tcount and np.array_equal(
+        dense.fetch(dw), tiled.fetch(tw)
+    ))
+    out = {
+        "board": f"{side}x{side}",
+        "tile": tile,
+        "turns": turns,
+        "soup": f"{soup_side}x{soup_side}@({r0},{c0})",
+        "alive": tcount,
+        "dense_turns_per_sec": round(dense_tps, 3),
+        "tiled_turns_per_sec": round(tiled_tps, 3),
+        "speedup": round(tiled_tps / max(dense_tps, 1e-9), 2),
+        "dense_wall_s": round(dense_wall, 2),
+        "tiled_wall_s": round(tiled_wall, 2),
+        "tiles_total": tiled.tiled.gr * tiled.tiled.gc,
+        "active_tiles": int(m.active.value),
+        "resident_tiles": int(m.resident.value),
+        "tile_steps": int(m.tile_steps.value - before["steps"]),
+        "tile_rides": int(m.tile_rides.value - before["rides"]),
+        "tile_skips": int(m.tile_skips.value - before["skips"]),
+        "paged_bytes": int(
+            sum(c.value for c in m.paged.values()) - before["paged"]
+        ),
+        "bit_identical": bit_identical,
+    }
+    if not bit_identical:
+        out["error"] = (
+            "ORACLE MISMATCH: tiled committed world diverged from the "
+            "dense packed stepper"
+        )
+    return out
+
+
 def measure_sessions_lane(sessions: int = 64, side: int = 256,
                           k: int = 16, rounds: int = 4) -> dict:
     """The multi-session lane (ROADMAP open item 3 / ISSUE 7
@@ -1363,6 +1452,12 @@ def main() -> None:
         detail["sessions_64x256"] = _lane(measure_sessions_lane)
     except Exception as e:
         detail["sessions_64x256"] = {"error": repr(e)}
+    # Activity-driven stepping (ISSUE 13): localized soup on a 32k²
+    # board, tiled vs dense A/B with the in-lane bit-identity gate.
+    try:
+        detail["activity_32768_soup"] = _lane(measure_activity)
+    except Exception as e:
+        detail["activity_32768_soup"] = {"error": repr(e)}
     detail["first_alive_report_s"] = first_report
     # The pallas-packed vs XLA-packed-fori_loop ratio the README quotes.
     try:

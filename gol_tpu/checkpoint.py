@@ -86,6 +86,16 @@ def read_session_manifest(out_dir: str | os.PathLike) -> Optional[dict]:
     }
 
 
+def manifest_parked(meta) -> bool:
+    """True when a manifest session entry records a PARKED (hibernated)
+    session — checkpointed, device rows freed, rehydrated bit-exactly
+    on the next attach (docs/SESSIONS.md "Hibernation"). The one
+    spelling of the flag, shared by the manager's writer and resume
+    discovery: a parked entry carries `parked: true` plus the `turn`
+    its snapshot encodes, alongside the ordinary recipe fields."""
+    return bool(isinstance(meta, dict) and meta.get("parked"))
+
+
 def tombstone_path(out_dir: str | os.PathLike, sid: str) -> str:
     """Per-session destroy marker `<out>/sessions/<sid>/.tombstone` —
     written BEFORE the manifest rewrite, so every crash window between

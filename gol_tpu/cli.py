@@ -76,6 +76,15 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="SEC",
                     help="auto-checkpoint the board to out/ every SEC "
                          "seconds (0 = off)")
+    ap.add_argument("--tile", type=int, default=0, metavar="T",
+                    help="activity-driven tiled stepping: split the "
+                         "board into T x T macro-tiles (T a multiple "
+                         "of 32 dividing both axes) and dispatch only "
+                         "tiles a change's light cone touched; the "
+                         "board stays host-resident, so size stops "
+                         "being an HBM bound (0 = off; -t does not "
+                         "apply — the dispatch set is the parallelism; "
+                         "see docs/PERF.md 'Activity-driven stepping')")
     ap.add_argument("--cycle-detect", action="store_true",
                     dest="cycle_detect",
                     help="exact cycle fast-forward: once the board "
@@ -135,6 +144,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "shape/rule bucket (a full bucket doubles, "
                          "which recompiles; churn within capacity "
                          "never does; default 16)")
+    ap.add_argument("--park-idle-secs", type=float, default=None,
+                    dest="park_idle_secs", metavar="SEC",
+                    help="with --serve --sessions: HIBERNATE sessions "
+                         "idle (no watcher, no driver) this long — "
+                         "checkpoint via the session manifest, free "
+                         "the device slot, rehydrate bit-exactly on "
+                         "the next attach; 0 parks at the first idle "
+                         "sweep (default: never park; see "
+                         "docs/SESSIONS.md 'Hibernation')")
     ap.add_argument("--relay", default=None, metavar="HOST:PORT",
                     help="run as a RELAY NODE (gol_tpu.relay): attach "
                          "to the upstream server/relay at HOST:PORT as "
@@ -377,6 +395,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         autosave_turns=args.autosave_turns,
         autosave_seconds=args.autosave_secs,
         cycle_detect=args.cycle_detect,
+        tile=args.tile,
     )
 
     # Checkpoint restart (local or --serve): boot from a snapshot,
@@ -405,6 +424,20 @@ def main(argv: Optional[list[str]] = None) -> int:
             "error: --ws-port requires --relay (a root engine serves "
             "browsers through a co-located relay: start one with "
             "--relay HOST:PORT --serve PORT --ws-port N)"
+        )
+    if args.park_idle_secs is not None and not args.sessions:
+        raise SystemExit(
+            "error: --park-idle-secs applies to --serve --sessions "
+            "(hibernation is a session-plane policy)"
+        )
+    if args.tile and (args.sessions or args.relay is not None):
+        # Buckets step dense stacks and relays own no board: a
+        # silently ignored --tile would leave an operator believing a
+        # 32k-scale geometry runs activity-driven when it would OOM
+        # or run dense.
+        raise SystemExit(
+            "error: --tile applies to single-board engines (local or "
+            "--serve), not --sessions buckets or relays"
         )
     if args.sessions:
         # Multi-tenant serve mode: state lives per session under
@@ -624,7 +657,8 @@ def _serve_sessions(args, params: Params, resume: bool) -> int:
                            batch_turns=(args.batch_turns
                                         if args.batch_turns is not None
                                         else 1024),
-                           writer_pool_threads=args.writer_pool_threads)
+                           writer_pool_threads=args.writer_pool_threads,
+                           park_idle_secs=args.park_idle_secs)
     print(f"session engine serving on "
           f"{server.address[0]}:{server.address[1]}")
     if resume:

@@ -1103,6 +1103,16 @@ class SessionControl:
                           idempotent=True)
         return {"path": r.get("path"), "turn": r.get("turn")}
 
+    def park(self, sid: str) -> dict:
+        """Hibernate a session (docs/SESSIONS.md "Hibernation"):
+        checkpoint + free its device slot; the next attach (a
+        Controller with session=sid) rehydrates it bit-exactly.
+        Idempotent under retry — a rid-retried park whose first
+        attempt landed answers ok."""
+        r = self._checked({"t": "session", "op": "park", "id": sid},
+                          idempotent=True)
+        return {"id": r.get("id"), "turn": r.get("turn")}
+
     def close(self) -> None:
         if self._sock is None:
             return

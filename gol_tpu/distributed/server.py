@@ -1782,6 +1782,7 @@ class SessionServer:
         retry_after_secs: float = 1.0,
         batch_turns: int = 1024,
         writer_pool_threads: int = 2,
+        park_idle_secs: Optional[float] = None,
     ):
         from gol_tpu.sessions import SessionEngine, SessionManager
 
@@ -1811,6 +1812,7 @@ class SessionServer:
             bucket_capacity=bucket_capacity,
             autosave_turns=params.autosave_turns,
             max_sessions=max_sessions,
+            park_idle_secs=park_idle_secs,
         )
         #: Idempotency replay window (docs/SESSIONS.md "Idempotent
         #: verbs"): request-id -> the successful session-r reply it
@@ -1955,7 +1957,7 @@ class SessionServer:
         role = ("observe" if hello.get("role") == "observe" else "drive")
         sid = hello.get("session")
         if sid is not None and (
-            not valid_session_id(sid) or self.manager.get(sid) is None
+            not valid_session_id(sid) or not self.manager.known(sid)
         ):
             with contextlib.suppress(Exception):
                 wire.send_msg(
@@ -2026,12 +2028,13 @@ class SessionServer:
             name="gol-sess-reader", daemon=True,
         ).start()
         if sid is not None:
-            s = self.manager.get(sid)
-            b = s.bucket if s is not None else None
-            sink = _SessionSink(self, conn, sid,
-                                b.width if b else 0,
-                                b.height if b else 0)
+            geom = self.manager.peek_geometry(sid) or (0, 0)
+            sink = _SessionSink(self, conn, sid, geom[0] or 0,
+                                geom[1] or 0)
             try:
+                # A parked session rehydrates inside attach — the
+                # board sync below then carries the revived state
+                # (docs/SESSIONS.md "Hibernation").
                 self.manager.attach(sid, sink)
             except (wire.WireError, OSError):
                 # The peer died during its own board sync: its slot is
@@ -2039,10 +2042,18 @@ class SessionServer:
                 # thread must survive.
                 self._drop_conn(conn)
                 return
-            except (SessionError, TimeoutError):
-                # Destroyed between the hello check and the attach.
+            except (SessionError, TimeoutError) as e:
+                # Destroyed between the hello check and the attach —
+                # or a rehydration the resident budget refused: the
+                # real reason (with a retry hint on transient ones)
+                # lets the client back off instead of giving up.
+                reason = (str(e) if isinstance(e, SessionError)
+                          else "busy")
+                err = {"t": "error", "reason": reason}
+                if reason in ("max-sessions", "busy"):
+                    err["retry_after"] = self.retry_after_secs
                 with contextlib.suppress(Exception):
-                    conn.send({"t": "error", "reason": "unknown-session"})
+                    conn.send(err)
                 self._drop_conn(conn)
                 return
             with self._conn_lock:
@@ -2183,12 +2194,51 @@ class SessionServer:
             # desired end state — absence — holds.
             reply.update(ok=True, id=msg.get("id"), replayed=True)
             return True
+        if op == "park" and reason == "parked":
+            # Parked by the first attempt (or the idle sweep): the
+            # desired end state — hibernated — holds.
+            reply.update(
+                ok=True, id=msg.get("id"),
+                turn=self.manager.peek_turn(msg.get("id")),
+                replayed=True,
+            )
+            return True
         if op == "create" and reason == "exists":
             from gol_tpu.models.rules import get_rule
 
-            s = self.manager.get(msg.get("id"))
+            sid = msg.get("id")
+            s = self.manager.get(sid)
             if s is None:
-                return False
+                # The first attempt's create may have landed and been
+                # hibernated by the idle sweep before the retry
+                # arrived: an IDENTICAL recipe — seed/density
+                # included, exactly the live compare below — still
+                # reads as success; anything else is a real duplicate.
+                meta = self.manager.parked_meta(sid)
+                if meta is None:
+                    return False
+                try:
+                    want_rule = (self.manager.default_rule
+                                 if msg.get("rule") is None
+                                 else get_rule(msg["rule"]))
+                    same = (
+                        meta.get("width") == msg.get("width")
+                        and meta.get("height") == msg.get("height")
+                        and str(meta.get("rule")) == str(want_rule)
+                        and meta.get("seed") == msg.get("seed")
+                        and (meta.get("seed") is None
+                             or meta.get("density")
+                             == float(msg.get("density", 0.25)))
+                    )
+                except (ValueError, TypeError):
+                    return False
+                if not same:
+                    return False
+                info = next(
+                    (i for i in self.manager.list_sessions()
+                     if i["id"] == sid), None)
+                reply.update(ok=True, session=info, replayed=True)
+                return True
             b = s.bucket
             try:
                 want_rule = (self.manager.default_rule
@@ -2252,6 +2302,9 @@ class SessionServer:
             elif op == "checkpoint":
                 r = self.manager.checkpoint(msg.get("id"))
                 reply.update(ok=True, id=msg.get("id"), **r)
+            elif op == "park":
+                r = self.manager.park(msg.get("id"))
+                reply.update(ok=True, **r)
             else:
                 reply.update(ok=False, reason="unknown-op")
         except SessionError as e:

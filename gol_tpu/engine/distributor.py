@@ -372,6 +372,7 @@ class Engine:
             width=params.image_width,
             rule=params.rule,
             backend=params.backend,
+            tile=params.tile,
         )
         self.io = io_service or IOService(params.image_dir, params.out_dir)
         self._own_io = io_service is None
@@ -458,6 +459,15 @@ class Engine:
             CycleDetector(min(cycle_check_seconds, 1.0))
             if params.cycle_detect else None
         )
+        if getattr(self.stepper, "tiled", None) is not None:
+            # Activity-driven tiled backend: the whole-board cycle
+            # machinery stands down. Per-tile period-riding (the ride
+            # cache inside parallel/tiled.py) subsumes it at finer
+            # grain, and the tiled world handle is mutated in place —
+            # a CycleDetector anchor would alias the moving state and
+            # "prove" a period instantly.
+            self._cycles = None
+            self._ride_cycles = None
         # In-flight chunk of the pipelined diff path (see
         # _diff_pipeline_step); engine thread only.
         self._pending_diffs: Optional[dict] = None

@@ -75,6 +75,7 @@ __all__ = [
     "enable_cost_probes",
     "fits",
     "install_compile_watcher",
+    "max_resident_tiles",
     "memory_census",
     "observe_memory",
     "observe_split",
@@ -83,6 +84,7 @@ __all__ = [
     "publish_cost",
     "start_profile",
     "stop_profile",
+    "tile_ext_bytes",
 ]
 
 #: The jax.monitoring key one backend compile fires exactly once.
@@ -398,9 +400,42 @@ def device_budget() -> Optional[int]:
 _BOARD_WORKING_SET = 3
 
 
+def tile_ext_bytes(tile: int, halo_words: int = 1) -> int:
+    """Device bytes of ONE resident macro-tile: the ghost-extended
+    packed block the activity-driven stepper uploads per dispatch —
+    (TILE/32 + 2g) word-rows by (TILE + 64g) lanes of uint32
+    (parallel/tiled.py geometry). The ONE constant both `fits()`'s
+    `resident_tiles` term and `max_resident_tiles` price, so the
+    paging policy and the capacity answer cannot disagree."""
+    if tile <= 0 or tile % 32 or halo_words < 1:
+        raise ValueError(
+            f"tile must be a positive multiple of 32 (got {tile}) "
+            f"with halo_words >= 1 (got {halo_words})"
+        )
+    return (tile // 32 + 2 * halo_words) * (tile + 64 * halo_words) * 4
+
+
+def max_resident_tiles(tile: int,
+                       halo_words: int = 1) -> Optional[int]:
+    """How many ghost-extended macro-tiles one device dispatch slab
+    may hold: the budget over `tile_ext_bytes` times the same
+    ~3x working-set multiple `fits()` charges per resident tile
+    (upload slab + stepped result + transient). None when the backend
+    reports no budget (the tiled stepper then falls back to its own
+    conservative default) — never a guess."""
+    budget = device_budget()
+    if budget is None:
+        return None
+    return max(1, int(budget)
+               // (tile_ext_bytes(tile, halo_words)
+                   * _BOARD_WORKING_SET))
+
+
 def fits(height: int, width: int, *, sessions: int = 1,
          packed: Optional[bool] = None,
-         diff_stack_bytes: Optional[int] = None) -> dict:
+         diff_stack_bytes: Optional[int] = None,
+         resident_tiles: int = 0, tile: int = 0,
+         tile_halo_words: int = 1) -> dict:
     """Will this geometry fit device memory — and how far can it grow?
 
     Pure arithmetic over the census and the board layout (never a
@@ -409,7 +444,19 @@ def fits(height: int, width: int, *, sessions: int = 1,
     them; the working set holds ~3 boards' worth (carry + result +
     stacked diffs' board share) plus the engine's bounded diff-stack
     budget when the caller prices a watched run (`diff_stack_bytes`,
-    e.g. engine.DIFF_STACK_BUDGET).
+    e.g. engine.DIFF_STACK_BUDGET), plus — when the process also runs
+    an activity-driven tiled stepper — `resident_tiles` ghost-extended
+    macro-tile slots (`tile` names their side; `tile_ext_bytes` is the
+    shared per-slot constant, charged at the same ~3x working-set
+    multiple, so this answer and the tiled paging policy
+    (`max_resident_tiles`) cannot disagree).
+
+    Precedence of the budget deductions: the fixed side terms —
+    `diff_stack_bytes`, then the resident-tile slab — come off the
+    budget FIRST; `max_sessions` and `max_board_side` are answered
+    from the remainder. (The budget itself follows `device_budget`:
+    an explicit GOL_TPU_DEVICE_BUDGET_BYTES override wins over the
+    allocator's bytes_limit.)
 
     Returns board_bytes / bucket_bytes / estimated working set,
     `budget_bytes` (None when the backend reports no ceiling — then
@@ -418,13 +465,25 @@ def fits(height: int, width: int, *, sessions: int = 1,
     largest square single board the budget admits."""
     if height <= 0 or width <= 0 or sessions < 1:
         raise ValueError("need positive geometry and sessions >= 1")
+    if resident_tiles < 0:
+        raise ValueError("resident_tiles must be >= 0")
+    if resident_tiles and not tile:
+        raise ValueError(
+            "resident_tiles needs tile= (the macro-tile side) to "
+            "price a slot"
+        )
     if packed is None:
         from gol_tpu.ops.bitlife import packable
 
         packed = packable(height, width)
     board = (height // 32) * width * 4 if packed else height * width
     bucket = board * sessions
-    need = bucket * _BOARD_WORKING_SET + (diff_stack_bytes or 0)
+    tile_bytes = (
+        resident_tiles * tile_ext_bytes(tile, tile_halo_words)
+        * _BOARD_WORKING_SET if resident_tiles else 0
+    )
+    side_terms = (diff_stack_bytes or 0) + tile_bytes
+    need = bucket * _BOARD_WORKING_SET + side_terms
     budget = device_budget()
     out = {
         "height": height,
@@ -433,6 +492,8 @@ def fits(height: int, width: int, *, sessions: int = 1,
         "packed": bool(packed),
         "board_bytes": board,
         "bucket_bytes": bucket,
+        "resident_tiles": resident_tiles,
+        "resident_tile_bytes": tile_bytes,
         "working_set_bytes": need,
         "budget_bytes": budget,
         "fits": None,
@@ -441,7 +502,7 @@ def fits(height: int, width: int, *, sessions: int = 1,
     }
     if budget is None:
         return out
-    usable = budget - (diff_stack_bytes or 0)
+    usable = budget - side_terms
     out["fits"] = need <= budget
     out["headroom_bytes"] = budget - need
     if board > 0 and usable > 0:

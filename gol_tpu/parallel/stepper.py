@@ -141,6 +141,15 @@ class Stepper:
     #: programs themselves stay untouched — the obs-in-jit linter check
     #: enforces that metrics never enter a trace.
     halo_cost: Optional[Callable] = None
+    #: The activity-driven tiled backend's host-side implementation
+    #: (parallel/tiled.TiledStepper) — None on every dense backend.
+    #: Engines read it to stand their whole-board cycle machinery down
+    #: (per-tile riding subsumes it, and the tiled world handle is
+    #: mutated in place, so an anchor reference would alias the moving
+    #: state); tests and the bench reach the activity plane (pool
+    #: census, ride cache) through it. Survives instrument_stepper /
+    #: checked_stepper (both are dataclasses.replace).
+    tiled: Optional[object] = None
 
     def alive_count(self, world) -> int:
         return int(self.alive_count_async(world))
@@ -1181,6 +1190,7 @@ def make_stepper(
     rule: Rule | str = LIFE,
     devices: Optional[list] = None,
     backend: str = "auto",
+    tile: int = 0,
 ) -> Stepper:
     """Build the best stepper for the request, wrapped with per-dispatch
     obs instrumentation (unless GOL_TPU_METRICS=0 — the disabled path
@@ -1188,10 +1198,12 @@ def make_stepper(
     and with the runtime dispatch-linearity checker when
     GOL_TPU_CHECK_INVARIANTS=1 (cli --check-invariants;
     gol_tpu.analysis.invariants) — host-side identity checks only, so
-    the opt-in costs nothing on device."""
+    the opt-in costs nothing on device. `tile` > 0 selects the
+    activity-driven tiled backend (parallel/tiled.py, --tile)."""
     from gol_tpu import obs
 
-    s = _make_stepper(threads, height, width, rule, devices, backend)
+    s = _make_stepper(threads, height, width, rule, devices, backend,
+                      tile)
     if obs.enabled():
         s = instrument_stepper(s)
     from gol_tpu.analysis.invariants import checked_stepper, invariants_enabled
@@ -1208,6 +1220,7 @@ def _make_stepper(
     rule: Rule | str = LIFE,
     devices: Optional[list] = None,
     backend: str = "auto",
+    tile: int = 0,
 ) -> Stepper:
     """Build the best stepper for the request (the dispatch analog of
     ref: gol/distributor.go:93,116 picking serial vs row-farm).
@@ -1217,11 +1230,27 @@ def _make_stepper(
     Sharded runs (threads > 1 with multiple devices) use the packed
     ring-halo path when every strip is a whole number of 32-row words,
     the dense ring-halo path otherwise ("dense" forces the latter;
-    "pallas" applies to single-device only)."""
+    "pallas" applies to single-device only). `tile` > 0 selects the
+    activity-driven tiled backend instead: the dispatch SET (which
+    macro-tiles a change's light cone touched) is the parallelism
+    axis there, so `threads` does not apply and the board stays
+    host-resident (boards past HBM)."""
     if backend not in BACKENDS:
         raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
     rule = get_rule(rule) if isinstance(rule, str) else rule
     multiprocess = devices is None and jax.process_count() > 1
+    if tile:
+        if multiprocess:
+            raise ValueError(
+                "tiled stepping is single-process (the dispatch set is "
+                "its parallelism axis; multi-chip composes at the "
+                "partition-rule layer, not here)"
+            )
+        from gol_tpu.parallel.tiled import tiled_stepper
+
+        devs = devices if devices is not None else jax.devices()
+        return tiled_stepper(rule, height, width, tile,
+                             device=devs[0])
     if isinstance(rule, GenRule):
         # Multi-state rules ride the SAME distribution machinery as the
         # Life family (VERDICT r3 Missing #1): one-hot bit-planes

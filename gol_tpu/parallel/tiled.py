@@ -1,0 +1,565 @@
+"""Activity-driven tiled stepping — macro-tiles, light-cone skips,
+host paging past HBM (ROADMAP open item 3a; docs/PERF.md
+"Activity-driven stepping").
+
+Two production truths the dense stepper ignores: real Life boards are
+mostly settled space, and a dense dispatch pays for every cell every
+turn anyway. This backend tiles the packed universe into fixed
+TILE x TILE macro-tiles and steps, per k-turn chunk, ONLY the tiles
+whose halo-depth light cone touched a live change:
+
+- **Geometry.** The world stays in the bitlife word layout — uint32
+  (H/32, W), 32 vertically-packed cells per word — but lives in HOST
+  memory as one numpy array (the paged universe; a 32k x 32k board is
+  128 MB of host words and never needs to fit HBM). A macro-tile is a
+  (TILE/32, TILE) word sub-array; its ghost-extended block adds `g`
+  word-rows above/below and 32*g lanes left/right — exactly the deep-
+  halo arithmetic of `parallel/packed_halo.py` (one g-word ghost slab
+  buys 32*g exact local turns), applied per tile instead of per ring
+  shard.
+
+- **Light-cone skip.** After a k-turn chunk each tile records whether
+  its interior changed (chunk-BOUNDARY compare on the fused path;
+  any-turn compare on the per-turn diff path, where a mid-chunk
+  oscillation must keep emitting flips). A tile is dispatched next
+  chunk only when a change landed in its 8-neighbourhood (k <= 32*g
+  <= TILE, so the light cone of any change is contained in the
+  adjacent tiles) AND its neighbourhood holds any live cell at all
+  (an all-zero ghost-extended block provably stays zero under any
+  rule without birth-on-0 — which is why B0 rules are rejected, the
+  bucket-padding argument of `make_batch_stepper`). Skipping is EXACT,
+  not approximate: an unchanged ghost-extended input re-stepped the
+  same k turns reproduces the same output, so not re-stepping it
+  commits the identical world — the dryrun oracle and the property
+  tests gate this bit-for-bit against the dense stepper. A chunk size
+  change invalidates the boundary flags (a period-2 island is
+  "unchanged" at k=32 but not at k=31), so the first chunk at a new
+  (mode, k) re-steps everything with live cells.
+
+- **Per-tile cycle riding.** The PR 10 whole-board cycle machinery
+  generalizes tile-wise as memoization: on the fused path each
+  dispatched tile's ghost-extended input is digested (16-byte
+  blake2b) and mapped to its stepped interior. An oscillating island
+  revisits the same ext inputs every period, so after one warm period
+  its tiles replay from the cache with ZERO device dispatches — and
+  its neighbours, seeing the same boundary cycle, ride too. The cache
+  is bounded (global byte budget, FIFO eviction); a digest collision
+  is the only approximation (2^-64-grade — and the in-lane oracle
+  gate in the bench re-checks the committed world against the dense
+  stepper on every capture). The per-turn diff path never consults
+  the cache: a replay cannot reconstruct intermediate turns.
+
+- **Host paging.** Only the dispatched batch ever exists on device:
+  active ext blocks are gathered host-side, stepped as ONE vmapped
+  jit over a pow2-padded slab, and only the interiors come back.
+  The slab size is the residency policy — bounded by
+  `obs.device.max_resident_tiles` (the same `tile_ext_bytes` x
+  working-set arithmetic `fits(resident_tiles=...)` prices, so the
+  paging policy and the capacity answer cannot disagree); an active
+  set larger than the bound pages through in multiple slabs, all
+  gathered from the chunk-start state first so sub-batches stay
+  exact. Cold tiles cost no HBM at all.
+
+Recompile discipline: the slab's (capacity, k) are the only shape-
+bearing statics. Capacity grows pow2 and never shrinks, k is the
+fixed 32*g chunk (plus the run's tail sizes), so a warm pool
+dispatches with zero compiles whatever the active set does — pinned
+by the cache-census test, the bucket discipline of
+`make_batch_stepper` applied tile-wise. Slab padding slots are zero
+tiles (zero stays zero; one program for the whole slab).
+
+Event-plane contract: `step_n_with_diffs` emits the same packed
+(k, H/32, W) XOR stack as every packed backend (skipped tiles
+contribute zero rows — exact, since they did not change), so the
+engine's sparse/compact/FBATCH machinery upstream is untouched.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import os
+import time
+from collections import deque
+from typing import Optional
+
+import jax
+import numpy as np
+
+from gol_tpu import obs
+from gol_tpu.models.rules import GenRule, LIFE, Rule, get_rule
+from gol_tpu.obs import tracing
+# Aliased: the obs-in-jit checker treats every binding of an
+# obs-imported name as obs-rooted (see parallel/stepper.py).
+from gol_tpu.obs import device as obs_device
+from gol_tpu.ops import bitlife
+from gol_tpu.ops.bitlife import WORD
+
+#: Device slab bound when the backend reports no memory budget (CPU
+#: test meshes): 256 ext tiles of the default 1024 geometry is ~150 MB
+#: of transient device arrays — comfortably inside any host the board
+#: itself fits on.
+DEFAULT_MAX_RESIDENT = 256
+
+#: Ride-cache byte budget (host memory holding memoized tile
+#: interiors); GOL_TPU_TILE_RIDE_BUDGET_BYTES overrides, 0 disables.
+RIDE_BUDGET_BYTES = 64 * 1024 * 1024
+
+
+class _TiledMetrics:
+    """Registry handles for the activity plane (gol_tpu.obs). The
+    per-TILE children ride a TopKGauge — one registry entry whose
+    exposition is O(cap) however many tiles a 32k² board holds (the
+    PR 12 bounded-cardinality discipline; pinned by a churn test)."""
+
+    def __init__(self):
+        self.active = obs.gauge(
+            "gol_tpu_engine_active_tiles",
+            "Macro-tiles dispatched (stepped or ridden) in the last "
+            "activity chunk",
+        )
+        self.tiles = obs.gauge(
+            "gol_tpu_engine_tiles_total",
+            "Macro-tiles the current tiled world is split into",
+        )
+        self.resident = obs.gauge(
+            "gol_tpu_engine_resident_tiles",
+            "Device tile slots the warm dispatch slab currently holds "
+            "(the residency the paging policy priced via fits())",
+        )
+        self.dispatches = obs.counter(
+            "gol_tpu_tiled_dispatches_total",
+            "Vmapped tile-slab device dispatches",
+        )
+        self.tile_steps = obs.counter(
+            "gol_tpu_tiled_tile_steps_total",
+            "Tile chunks stepped on device",
+        )
+        self.tile_skips = obs.counter(
+            "gol_tpu_tiled_tile_skips_total",
+            "Tile chunks skipped as settled (outside every light cone)",
+        )
+        self.tile_rides = obs.counter(
+            "gol_tpu_tiled_tile_rides_total",
+            "Tile chunks replayed from the per-tile ride cache "
+            "(zero device dispatches)",
+        )
+        self.paged = {
+            d: obs.counter(
+                "gol_tpu_tiled_paged_bytes_total",
+                "Bytes paged between the host universe and the device "
+                "slab (in = ghost-extended uploads, out = interiors "
+                "fetched back)",
+                {"dir": d},
+            ) for d in ("in", "out")
+        }
+        self.per_tile = obs.registry().topk_gauge(
+            "gol_tpu_engine_tile_active_chunks",
+            "Consecutive chunks each currently-active tile has been "
+            "in the dispatch set (top-K by streak; bounded exposition "
+            "— the activity hotspots an operator actually wants named)",
+            label="tile", cap=16,
+        )
+
+
+_METRICS = _TiledMetrics()
+
+
+def tileable(height: int, width: int, tile: int,
+             halo_words: int = 1) -> bool:
+    """A grid tiles iff the tile divides both axes, is whole words,
+    and holds its own light cone (32*g <= TILE keeps any k-turn
+    change inside the 8-neighbourhood)."""
+    return (
+        tile > 0 and halo_words >= 1
+        and tile % WORD == 0
+        and tile >= WORD * halo_words
+        and height % tile == 0
+        and width % tile == 0
+    )
+
+
+def _dilate8(m: np.ndarray) -> np.ndarray:
+    """Toroidal 8-neighbourhood dilation on the tile grid — the
+    light-cone closure (k <= 32*g <= TILE, so one ring suffices)."""
+    out = m.copy()
+    for dr in (-1, 0, 1):
+        for dc in (-1, 0, 1):
+            if dr or dc:
+                out |= np.roll(np.roll(m, dr, 0), dc, 1)
+    return out
+
+
+class TiledWorld:
+    """The handle a tiled Stepper's entries pass around — the engine
+    treats it opaquely (commit/fetch/snapshot all work), but it is a
+    HOST object: the packed word universe, the per-tile alive counts,
+    and the activity flags. Mutated in place by `_advance` (the same
+    handle comes back from step_n), which is why the whole-board
+    CycleDetector stands down on tiled engines — an anchor reference
+    would alias the moving state."""
+
+    __slots__ = ("words", "alive", "tile_alive", "changed", "last_key")
+
+    def __init__(self, words: np.ndarray, tile_alive: np.ndarray):
+        self.words = words
+        self.tile_alive = tile_alive
+        self.alive = int(tile_alive.sum())
+        #: Per-tile "interior changed during the last chunk" flags —
+        #: boundary-compare on the fused path, any-turn on diffs.
+        self.changed = tile_alive > 0
+        #: (mode, k) of the last chunk: flags are only meaningful
+        #: against the same chunk shape (see module docstring).
+        self.last_key: Optional[tuple] = None
+
+
+class TiledStepper:
+    """Host-side implementation behind the `tiled_stepper` Stepper —
+    exposed as `Stepper.tiled` so engines and tests can reach the
+    activity plane (pool census, ride cache, gather hook)."""
+
+    def __init__(self, rule: "Rule | str" = LIFE, height: int = 512,
+                 width: int = 512, tile: int = 1024, *,
+                 halo_words: int = 1, device=None,
+                 max_resident: Optional[int] = None,
+                 ride_budget_bytes: Optional[int] = None):
+        rule = get_rule(rule) if isinstance(rule, str) else rule
+        if isinstance(rule, GenRule):
+            raise ValueError(
+                "tiled stepping is two-state only (multi-state planes "
+                "would need per-plane ghost slabs — not yet offered)"
+            )
+        if 0 in rule.birth:
+            raise ValueError(
+                f"rule {rule} births on 0 neighbours — empty slab "
+                "padding and all-zero skipped tiles would seethe, so "
+                "B0 rules cannot run the activity-driven path"
+            )
+        if not tileable(height, width, tile, halo_words):
+            raise ValueError(
+                f"grid {height}x{width} does not tile into {tile}² "
+                f"macro-tiles (tile must divide both axes, be a "
+                f"multiple of {WORD}, and hold a {WORD * halo_words}-"
+                "cell light cone)"
+            )
+        self.rule = rule
+        self.height, self.width, self.tile = height, width, tile
+        self.g = halo_words
+        self.tw = tile // WORD                  # word-rows per tile
+        self.hw = height // WORD                # word-rows total
+        self.gr, self.gc = height // tile, width // tile
+        self.ext_h = self.tw + 2 * self.g
+        self.ext_w = tile + 2 * WORD * self.g
+        #: Exact turns one ghost exchange buys — the per-chunk cap.
+        self.max_chunk = WORD * self.g
+        self.device = device or jax.devices()[0]
+        if max_resident is None:
+            max_resident = (obs_device.max_resident_tiles(tile, self.g)
+                            or DEFAULT_MAX_RESIDENT)
+        self.max_resident = max(1, min(int(max_resident),
+                                       self.gr * self.gc))
+        #: Current warm slab capacity: starts at 1, grows pow2 on
+        #: demand (clamped at max_resident), never shrinks — each
+        #: distinct capacity is one compile, so a warm pool re-
+        #: dispatches compile-free whatever the active set does.
+        self._pool_cap = 1
+        if ride_budget_bytes is None:
+            env = os.environ.get("GOL_TPU_TILE_RIDE_BUDGET_BYTES")
+            try:
+                ride_budget_bytes = (int(env) if env
+                                     else RIDE_BUDGET_BYTES)
+            except ValueError:
+                ride_budget_bytes = RIDE_BUDGET_BYTES
+        self.ride_budget = max(0, int(ride_budget_bytes))
+        #: (tile_index, k, ext digest) -> (interior bytes, changed,
+        #: alive) — the per-tile period-riding memo (FIFO-bounded).
+        self._ride: dict = {}
+        self._ride_order: deque = deque()
+        self._ride_bytes = 0
+        #: Per-tile consecutive-active streaks feeding the TopKGauge.
+        self._streaks: dict = {}
+
+        rule_obj = rule
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def _step_ext(stack, k):
+            # One vmapped program over the whole slab: each ghost-
+            # extended block steps k exact local turns with the plain
+            # toroidal packed kernel (its wrap garbage lands in the
+            # ghost ring the validity shrink already wrote off — the
+            # packed_halo deep-block argument, per tile), then only
+            # the interiors leave the device.
+            out = jax.vmap(
+                lambda p: bitlife.step_n_packed_raw(p, k, rule_obj)
+            )(stack)
+            return out[:, self.g:self.g + self.tw,
+                       WORD * self.g:WORD * self.g + self.tile]
+
+        self._step_ext = _step_ext
+        _METRICS.tiles.set(self.gr * self.gc)
+        _METRICS.resident.set(self._pool_cap)
+
+    # --- Stepper entries -------------------------------------------------
+
+    def put(self, host_world) -> TiledWorld:
+        w = np.asarray(host_world, np.uint8)
+        if w.shape != (self.height, self.width):
+            raise ValueError(
+                f"world shape {w.shape} != "
+                f"{(self.height, self.width)}"
+            )
+        words = bitlife.pack_np(w)
+        world = TiledWorld(words, self._tile_pops(words))
+        _METRICS.tiles.set(self.gr * self.gc)
+        return world
+
+    def fetch(self, arr):
+        if isinstance(arr, TiledWorld):
+            return bitlife.unpack_np(arr.words, self.height)
+        return np.asarray(arr)
+
+    def step_n(self, world: TiledWorld, k):
+        k = max(int(k), 0)
+        while k > 0:
+            ks = min(k, self.max_chunk)
+            self._advance(world, ks, "fused")
+            k -= ks
+        return world, world.alive
+
+    def step(self, world: TiledWorld) -> TiledWorld:
+        return self.step_n(world, 1)[0]
+
+    def step_n_with_diffs(self, world: TiledWorld, k):
+        """Per-turn packed XOR stack, exactly the layout every packed
+        backend ships. Turns run one at a time (per-turn exactness is
+        the contract — a mid-chunk oscillation must flip), with the
+        activity skip still pruning settled tiles; the ride cache
+        stands down (a memoized boundary replay cannot reconstruct
+        intermediate turns)."""
+        k = max(int(k), 0)
+        diffs = np.zeros((k, self.hw, self.width), np.uint32)
+        for t in range(k):
+            self._advance(world, 1, "diffs", collect=diffs[t])
+        return world, diffs, world.alive
+
+    def step_with_diff(self, world: TiledWorld):
+        _, diffs, count = self.step_n_with_diffs(world, 1)
+        mask = bitlife.unpack_np(diffs[0], self.height) != 0
+        return world, mask, count
+
+    def alive_count_async(self, world: TiledWorld) -> int:
+        return world.alive
+
+    def cache_sizes(self) -> dict:
+        """Jit-cache census — the zero-recompile acceptance pin (the
+        BatchStepper discipline applied to the tile pool)."""
+        fn = self._step_ext
+        return {"step_ext": (fn._cache_size()
+                             if hasattr(fn, "_cache_size") else None)}
+
+    def activity(self) -> dict:
+        """Host-side snapshot of the activity plane (telemetry/bench)."""
+        return {
+            "tiles": self.gr * self.gc,
+            "pool_cap": self._pool_cap,
+            "max_resident": self.max_resident,
+            "ride_entries": len(self._ride),
+            "ride_bytes": self._ride_bytes,
+        }
+
+    # --- internals -------------------------------------------------------
+
+    def _tile_pops(self, words: np.ndarray) -> np.ndarray:
+        pops = np.bitwise_count(words).astype(np.int64)
+        return pops.reshape(self.gr, self.tw, self.gc,
+                            self.tile).sum(axis=(1, 3))
+
+    def _gather(self, words: np.ndarray, r: int, c: int) -> np.ndarray:
+        """One tile's ghost-extended block, toroidal (corners come from
+        the wrap of both index vectors — the full rectangle, so the
+        diagonal light cone is exact)."""
+        g, tw, T = self.g, self.tw, self.tile
+        rows = np.arange(r * tw - g, (r + 1) * tw + g) % self.hw
+        cols = np.arange(c * T - WORD * g,
+                         (c + 1) * T + WORD * g) % self.width
+        return words[np.ix_(rows, cols)]
+
+    def _write(self, world: TiledWorld, r: int, c: int,
+               interior: np.ndarray, alive_new: int) -> None:
+        tw, T = self.tw, self.tile
+        world.words[r * tw:(r + 1) * tw, c * T:(c + 1) * T] = interior
+        world.alive += alive_new - int(world.tile_alive[r, c])
+        world.tile_alive[r, c] = alive_new
+
+    def _ride_store(self, tidx: int, ks: int, digest: bytes,
+                    interior: np.ndarray, changed: bool,
+                    alive_new: int) -> None:
+        if self.ride_budget <= 0:
+            return
+        key = (tidx, ks, digest)
+        if key in self._ride:
+            return
+        blob = interior.tobytes()
+        while (self._ride_bytes + len(blob) > self.ride_budget
+               and self._ride_order):
+            old = self._ride_order.popleft()
+            gone = self._ride.pop(old, None)
+            if gone is not None:
+                self._ride_bytes -= len(gone[0])
+        if self._ride_bytes + len(blob) > self.ride_budget:
+            return
+        self._ride[key] = (blob, changed, alive_new)
+        self._ride_order.append(key)
+        self._ride_bytes += len(blob)
+
+    def _advance(self, world: TiledWorld, ks: int, mode: str,
+                 collect: Optional[np.ndarray] = None) -> None:
+        """One activity chunk of `ks` turns (ks <= 32*g): select the
+        dispatch set, gather EVERY active ext block from the chunk-
+        start state (paging sub-batches and ride replays must not see
+        each other's writes), replay ride hits, step the rest in
+        resident-bounded slabs, commit interiors + flags."""
+        key = (mode, ks)
+        stale = world.last_key != key
+        world.last_key = key
+        nonzero = world.tile_alive > 0
+        changed_eff = (np.ones_like(world.changed) if stale
+                       else world.changed)
+        # Dispatch-set selection: inside a change's light cone AND
+        # holding (or adjacent to) any live cell — an all-zero ext
+        # block stays zero under any non-B0 rule, chunk size be
+        # damned, which is what makes a fresh 32k² board with one
+        # localized soup cheap from turn 0.
+        active = _dilate8(changed_eff) & _dilate8(nonzero)
+        idxs = np.flatnonzero(active)
+        n_tiles = active.size
+        wall0 = time.time()
+        t0 = time.perf_counter()
+        new_changed = np.zeros_like(world.changed)
+        flat_changed = new_changed.reshape(-1)
+        use_ride = mode == "fused" and self.ride_budget > 0
+        ride_hits = []      # (tidx, r, c, blob, changed, alive)
+        pending = []        # (tidx, r, c, ext, digest)
+        for tidx in idxs:
+            tidx = int(tidx)
+            r, c = divmod(tidx, self.gc)
+            ext = np.ascontiguousarray(self._gather(world.words, r, c))
+            digest = None
+            if use_ride:
+                digest = hashlib.blake2b(
+                    ext.tobytes(), digest_size=16
+                ).digest()
+                hit = self._ride.get((tidx, ks, digest))
+                if hit is not None:
+                    ride_hits.append((tidx, r, c) + hit)
+                    continue
+            pending.append((tidx, r, c, ext, digest))
+        # All chunk-start reads are done — writes may begin.
+        # Ride replays never coexist with a diff collector: the cache
+        # is fused-path-only (use_ride gates on mode), because a
+        # boundary replay cannot reconstruct per-turn rows — a future
+        # change relaxing that must rebuild the per-turn stack, not
+        # emit a whole-chunk XOR as one turn's flips.
+        assert collect is None or not ride_hits
+        for tidx, r, c, blob, ch, alive_new in ride_hits:
+            interior = np.frombuffer(blob, np.uint32).reshape(
+                self.tw, self.tile
+            )
+            self._write(world, r, c, interior, alive_new)
+            flat_changed[tidx] = ch
+        if pending:
+            need = min(len(pending), self.max_resident)
+            while self._pool_cap < need:
+                self._pool_cap *= 2
+            slab = min(self._pool_cap, self.max_resident)
+            self._pool_cap = slab
+            for start in range(0, len(pending), slab):
+                batch = pending[start:start + slab]
+                stack = np.zeros((slab, self.ext_h, self.ext_w),
+                                 np.uint32)
+                for j, (_, _, _, ext, _) in enumerate(batch):
+                    stack[j] = ext
+                with obs_device.cause("tile-dispatch"):
+                    dev = jax.device_put(stack, self.device)
+                    out = np.asarray(self._step_ext(dev, ks))
+                _METRICS.dispatches.inc()
+                _METRICS.paged["in"].inc(stack.nbytes)
+                _METRICS.paged["out"].inc(
+                    len(batch) * self.tw * self.tile * 4
+                )
+                tw, T = self.tw, self.tile
+                for j, (tidx, r, c, _ext, digest) in enumerate(batch):
+                    new_int = out[j]
+                    old_int = world.words[r * tw:(r + 1) * tw,
+                                          c * T:(c + 1) * T]
+                    xor = old_int ^ new_int
+                    ch = bool(xor.any())
+                    if collect is not None and ch:
+                        collect[r * tw:(r + 1) * tw,
+                                c * T:(c + 1) * T] = xor
+                    alive_new = int(np.bitwise_count(new_int).sum())
+                    self._write(world, r, c, new_int, alive_new)
+                    flat_changed[tidx] = ch
+                    if digest is not None:
+                        self._ride_store(tidx, ks, digest, new_int,
+                                         ch, alive_new)
+        world.changed = new_changed
+        # Activity plane: counts this chunk, bounded per-tile streaks.
+        dt = time.perf_counter() - t0
+        _METRICS.active.set(len(idxs))
+        _METRICS.resident.set(self._pool_cap)
+        _METRICS.tile_steps.inc(len(pending))
+        _METRICS.tile_rides.inc(len(ride_hits))
+        _METRICS.tile_skips.inc(n_tiles - len(idxs))
+        obs_device.observe_memory()
+        live = set()
+        for tidx in idxs:
+            tidx = int(tidx)
+            live.add(tidx)
+            streak = self._streaks.get(tidx, 0) + 1
+            self._streaks[tidx] = streak
+            r, c = divmod(tidx, self.gc)
+            _METRICS.per_tile.set_child(f"{r},{c}", streak)
+        for tidx in [t for t in self._streaks if t not in live]:
+            del self._streaks[tidx]
+            r, c = divmod(tidx, self.gc)
+            _METRICS.per_tile.remove_child(f"{r},{c}")
+        tracing.add_span(
+            "engine.tiled_chunk", "engine", wall0, dt,
+            {"turns": ks, "active": len(idxs),
+             "stepped": len(pending), "rides": len(ride_hits),
+             "mode": mode},
+        )
+
+
+def tiled_stepper(rule: "Rule | str" = LIFE, height: int = 512,
+                  width: int = 512, tile: int = 1024, *,
+                  halo_words: int = 1, device=None,
+                  max_resident: Optional[int] = None,
+                  ride_budget_bytes: Optional[int] = None):
+    """Build the activity-driven tiled backend as a Stepper (the
+    `make_stepper(tile=...)` / `--tile` path). Single-device by
+    construction: the dispatch SET is the parallelism axis here —
+    multi-chip sharding composes at the partition-rule layer
+    (ROADMAP open item 4), not inside this backend."""
+    from gol_tpu.parallel.stepper import Stepper
+
+    impl = TiledStepper(
+        rule, height, width, tile, halo_words=halo_words,
+        device=device, max_resident=max_resident,
+        ride_budget_bytes=ride_budget_bytes,
+    )
+    return Stepper(
+        name=f"tiled-{tile}",
+        shards=1,
+        put=impl.put,
+        fetch=impl.fetch,
+        step=impl.step,
+        step_n=impl.step_n,
+        step_with_diff=impl.step_with_diff,
+        alive_count_async=impl.alive_count_async,
+        step_n_with_diffs=impl.step_n_with_diffs,
+        fetch_diffs=np.asarray,
+        packed_diffs=True,
+        tiled=impl,
+    )

@@ -71,6 +71,14 @@ class Params:
     # Off by default: turn numbers leap when it fires, which per-turn
     # consumers may not expect (the detector only runs headless).
     cycle_detect: bool = False
+    # Activity-driven tiled stepping (parallel/tiled.py, --tile):
+    # macro-tile side in cells (a positive multiple of 32 dividing
+    # both board axes). 0 = off (the dense steppers). With a tile the
+    # board is HOST-resident — only tiles a change's light cone
+    # touched are dispatched, settled/empty tiles cost nothing, and
+    # board size stops being an HBM bound (docs/PERF.md
+    # "Activity-driven stepping").
+    tile: int = 0
 
     def __post_init__(self):
         if self.image_width <= 0 or self.image_height <= 0:
@@ -89,6 +97,10 @@ class Params:
             raise ValueError("autosave_turns must be >= 0")
         if self.autosave_seconds < 0:
             raise ValueError("autosave_seconds must be >= 0")
+        if self.tile < 0 or (self.tile and self.tile % 32):
+            raise ValueError(
+                "tile must be 0 (off) or a positive multiple of 32"
+            )
 
     @property
     def input_name(self) -> str:

@@ -86,6 +86,11 @@ class SessionEngine:
                 m._service_requests()
                 if self._stop.is_set():
                     break
+                # Hibernation sweep (docs/SESSIONS.md): sessions idle
+                # past the park policy checkpoint and free their slot
+                # — the fleet is mostly asleep, and the engine only
+                # rounds over buckets with resident tenants.
+                m.park_idle()
                 did = False
                 with m._lock:
                     buckets = [b for b in m._buckets.values() if b.live]

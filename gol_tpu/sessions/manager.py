@@ -96,6 +96,20 @@ class _SessionMetrics:
             "gol_tpu_session_resumes_total",
             "Sessions restored from per-session checkpoints",
         )
+        self.parked = obs.gauge(
+            "gol_tpu_sessions_parked",
+            "Sessions currently hibernated (checkpointed, device "
+            "rows freed; rehydrated bit-exactly on attach)",
+        )
+        self.hibernates = obs.counter(
+            "gol_tpu_session_hibernates_total",
+            "Sessions parked to their checkpoint (idle policy or the "
+            "park verb)",
+        )
+        self.rehydrates = obs.counter(
+            "gol_tpu_session_rehydrates_total",
+            "Parked sessions restored into a bucket slot on attach",
+        )
         paths = ("fused", "diffs", "compact")
         self.dispatches = {
             p: obs.counter(
@@ -183,6 +197,10 @@ class Session:
         self.density = density
         self.birth_ticks = bucket.ticks
         self.created_at = time.time()
+        #: monotonic instant this session last lost its final sink
+        #: (or was created sinkless) — the auto-park policy's idle
+        #: clock; None while anything is attached.
+        self.idle_since: Optional[float] = time.monotonic()
         # Per-session labeled children — evicted at destroy.
         self.turns_metric = obs.counter(
             "gol_tpu_session_turns_total",
@@ -309,6 +327,7 @@ class SessionManager:
                  bucket_capacity: int = 16,
                  autosave_turns: int = 0,
                  max_sessions: Optional[int] = None,
+                 park_idle_secs: Optional[float] = None,
                  device=None):
         if bucket_capacity < 1:
             raise ValueError("bucket_capacity must be >= 1")
@@ -322,11 +341,28 @@ class SessionManager:
         #: degradation"): creates beyond this raise
         #: SessionError("max-sessions") — the server turns that into an
         #: over-budget rejection with a retry_after hint. None = no cap.
+        #: The budget counts RESIDENT sessions only: parked sessions
+        #: hold no device rows, so hibernation turns --max-sessions
+        #: from an HBM bound into an admission-rate bound
+        #: (docs/SESSIONS.md "Hibernation").
         self.max_sessions = max_sessions
+        #: Idle-hibernation policy: sessions with no sink (watcher or
+        #: driver) for this many seconds are parked by `park_idle`
+        #: (the SessionEngine sweeps it every loop round). 0 parks at
+        #: the first idle sweep; None (default) never auto-parks.
+        self.park_idle_secs = park_idle_secs
+        #: Hibernated sessions: sid -> manifest-shaped meta (width/
+        #: height/rule/seed/density + parked/turn). No device rows,
+        #: no bucket slot — just the durable record; `_rehydrate`
+        #: turns an entry back into a live Session on attach.
+        self._parked: "dict[str, dict]" = {}
         self.device = device
         #: True only inside `resume_all`: restoring creates defer the
         #: manifest rewrite to one commit at the end of the resume.
         self._restoring = False
+        #: True only inside `_park_idle`: a parking sweep defers the
+        #: manifest rewrite to one commit at the end (same rationale).
+        self._deferring_manifest = False
         self._buckets: "dict[tuple, _Bucket]" = {}
         self._by_id: "dict[str, Session]" = {}
         self._lock = threading.RLock()
@@ -382,6 +418,83 @@ class SessionManager:
     def destroy(self, sid: str) -> None:
         self._exec(lambda: self._destroy(sid, "destroyed"))
 
+    def park(self, sid: str) -> dict:
+        """Hibernate a session (docs/SESSIONS.md "Hibernation"):
+        checkpoint it (crash-atomic PGM + sidecar), record it parked
+        in the manifest, and free its bucket slot (a traced clear —
+        zero recompiles in a warm bucket). Raises
+        SessionError("watched") while any sink is attached,
+        ("parked") when already hibernated. The next attach
+        rehydrates it bit-exactly."""
+        return self._exec(lambda: self._park(sid))
+
+    def park_idle(self) -> int:
+        """Park every session idle (no sink) past `park_idle_secs` —
+        the SessionEngine sweeps this between dispatch rounds (the
+        _exec routing keeps the device work on the owner thread for
+        any other caller). Returns the number parked; 0 when the
+        policy is off."""
+        if self.park_idle_secs is None or self._closed:
+            return 0
+        return self._exec(self._park_idle)
+
+    def _park_idle(self) -> int:
+        now = time.monotonic()
+        due = [
+            s.id for s in list(self._by_id.values())
+            if not s.bucket.sinks.get(s.id)
+            and s.idle_since is not None
+            and now - s.idle_since >= self.park_idle_secs
+        ]
+        # One manifest commit for the whole sweep, not one per parked
+        # session — a burst of N idle sessions would otherwise rewrite
+        # the N-entry manifest N times under the manager lock (O(N²)
+        # serialization stalling every verb). The crash window stays
+        # bounded-conservative: a session parked in memory but not yet
+        # recorded merely resumes LIVE from its just-written snapshot.
+        n = 0
+        self._deferring_manifest = True
+        try:
+            for sid in due:
+                try:
+                    self._park(sid)
+                    n += 1
+                except (SessionError, OSError):
+                    continue
+        finally:
+            self._deferring_manifest = False
+        if n:
+            with contextlib.suppress(OSError):
+                self._write_manifest()
+        return n
+
+    def is_parked(self, sid: str) -> bool:
+        return sid in self._parked
+
+    def parked_meta(self, sid: str) -> Optional[dict]:
+        """A parked session's manifest-shaped record (width/height/
+        rule/seed/density/turn), or None — the full recipe the
+        server's idempotent create-retry compare needs (the public
+        listing drops seed/density on purpose)."""
+        meta = self._parked.get(sid)
+        return dict(meta) if meta is not None else None
+
+    def known(self, sid: str) -> bool:
+        """Live OR parked — what an attach may name (lock-free dict
+        membership, the peek_turn discipline)."""
+        return sid in self._by_id or sid in self._parked
+
+    def peek_geometry(self, sid: str) -> "Optional[tuple[int, int]]":
+        """(width, height) of a live or parked session, lock-free;
+        None for unknown ids."""
+        s = self._by_id.get(sid)
+        if s is not None:
+            return s.bucket.width, s.bucket.height
+        meta = self._parked.get(sid)
+        if meta is not None:
+            return meta.get("width"), meta.get("height")
+        return None
+
     def checkpoint(self, sid: str) -> dict:
         """Write out/sessions/<sid>/<W>x<H>x<T>.pgm (crash-atomic) plus
         the session.json sidecar; returns {"path", "turn"}."""
@@ -402,8 +515,17 @@ class SessionManager:
 
     def list_sessions(self) -> list:
         with self._lock:
-            return [s.info() for s in
+            live = [s.info() for s in
                     sorted(self._by_id.values(), key=lambda s: s.id)]
+            parked = [
+                {"id": sid, "width": meta.get("width"),
+                 "height": meta.get("height"),
+                 "rule": meta.get("rule"),
+                 "turn": int(meta.get("turn", 0)),
+                 "watchers": 0, "parked": True}
+                for sid, meta in sorted(self._parked.items())
+            ]
+        return sorted(live + parked, key=lambda i: i["id"])
 
     def get(self, sid: str) -> Optional[Session]:
         with self._lock:
@@ -414,9 +536,13 @@ class SessionManager:
         heartbeat beacons): plain GIL-atomic dict/attribute reads,
         never the manager lock — that lock is held across whole bucket
         dispatches, and a beacon that waits on a cold compile defeats
-        its own purpose. May be one dispatch stale; 0 for unknown ids."""
+        its own purpose. May be one dispatch stale; 0 for unknown ids.
+        Parked sessions answer their hibernated turn."""
         s = self._by_id.get(sid)
-        return s.turn if s is not None else 0
+        if s is not None:
+            return s.turn
+        meta = self._parked.get(sid)
+        return int(meta.get("turn", 0)) if meta is not None else 0
 
     def resume_all(self) -> int:
         """Restore the crash-consistent session set under out/sessions/
@@ -460,11 +586,23 @@ class SessionManager:
         # exists to prevent. The pre-crash manifest stays authoritative
         # until the whole set is back; ONE rewrite at the end commits
         # it (and repairs a torn manifest after a directory scan).
+        from gol_tpu.checkpoint import manifest_parked
+
         self._restoring = True
         try:
             for sid, meta in candidates.items():
                 if (not valid_session_id(sid) or sid in self._by_id
+                        or sid in self._parked
                         or is_tombstoned(self.out_dir, sid)):
+                    continue
+                if manifest_parked(meta):
+                    # Hibernated at the crash/restart: restore the
+                    # RECORD, not a slot — the fleet stays mostly
+                    # asleep across restarts, and the next attach
+                    # rehydrates from the snapshot exactly as it
+                    # would have pre-restart.
+                    self._parked[sid] = dict(meta)
+                    restored += 1
                     continue
                 found = latest_any_snapshot(os.path.join(root, sid))
                 board = turn = None
@@ -514,6 +652,7 @@ class SessionManager:
             with self._lock:
                 with contextlib.suppress(OSError):
                     self._write_manifest()
+            _METRICS.parked.set(len(self._parked))
             flight.note("sessions.resume", count=restored)
         return restored
 
@@ -533,6 +672,7 @@ class SessionManager:
             return {
                 "status": "ok",
                 "sessions": len(self._by_id),
+                "parked": len(self._parked),
                 "buckets": len(self._buckets),
                 "ticks": {b.key: b.ticks for b in self._buckets.values()},
             }
@@ -609,7 +749,10 @@ class SessionManager:
                 board: Optional[np.ndarray], start_turn: int,
                 seed: Optional[int] = None,
                 density: float = 0.25) -> dict:
-        if sid in self._by_id:
+        if sid in self._by_id or sid in self._parked:
+            # A parked session still owns its id (it is one attach
+            # away from being live again) — a create over it is a
+            # duplicate, exactly as over a resident one.
             raise SessionError("exists")
         if (self.max_sessions is not None
                 and len(self._by_id) >= self.max_sessions):
@@ -646,6 +789,10 @@ class SessionManager:
         self._clear_session_remnants(sid)
         _METRICS.creates.inc()
         _METRICS.active.set(len(self._by_id))
+        # Device rows changed hands: a (rate-limited) census keeps the
+        # HBM watermark honest even for fleets that park before their
+        # first dispatch (the churn smoke's flatness gauge).
+        device.observe_memory()
         tracing.event("session.create", "lifecycle", session=sid,
                       bucket=b.key, slot=slot, turn=start_turn)
         flight.note("session.create", session=sid, bucket=b.key)
@@ -693,15 +840,42 @@ class SessionManager:
                 meta["seed"] = s.seed
                 meta["density"] = s.density
             sessions[s.id] = meta
+        # Parked sessions are part of the authoritative set: they must
+        # survive a restart AS parked (no slot claimed at resume) and
+        # still rehydrate on attach (docs/SESSIONS.md "Hibernation").
+        for sid, meta in sorted(self._parked.items()):
+            sessions[sid] = dict(meta)
         obs.atomic_write_text(path, json.dumps({"sessions": sessions}))
 
     def _require(self, sid: str) -> Session:
         s = self._by_id.get(sid)
         if s is None:
-            raise SessionError("unknown-session")
+            # A parked session is NOT unknown — verbs that need a
+            # resident board (checkpoint, fetch) answer "parked" so
+            # the caller knows an attach would revive it.
+            raise SessionError(
+                "parked" if sid in self._parked else "unknown-session"
+            )
         return s
 
     def _destroy(self, sid: str, reason: str) -> None:
+        if sid not in self._by_id and sid in self._parked:
+            # Destroying a hibernated session: no slot to free — drop
+            # the record with the same tombstone-first durability
+            # (every kill window leaves it destroyed, never
+            # resurrected). A shutdown-close leaves parked sessions
+            # parked: they must resume.
+            if reason == "shutdown":
+                return
+            del self._parked[sid]
+            self._write_tombstone(sid, reason)
+            self._write_manifest()
+            _METRICS.destroys.inc()
+            _METRICS.parked.set(len(self._parked))
+            tracing.event("session.destroy", "lifecycle", session=sid,
+                          reason=reason, parked=True)
+            flight.note("session.destroy", session=sid, reason=reason)
+            return
         s = self._require(sid)
         b = s.bucket
         for sink in b.sinks.pop(sid, []):
@@ -766,12 +940,123 @@ class SessionManager:
                       turn=turn)
         return {"path": path, "turn": turn}
 
+    def _park(self, sid: str) -> dict:
+        s = self._by_id.get(sid)
+        if s is None:
+            raise SessionError(
+                "parked" if sid in self._parked else "unknown-session"
+            )
+        b = s.bucket
+        if b.sinks.get(sid):
+            raise SessionError("watched")
+        # The checkpoint IS the hibernated state: crash-atomic PGM +
+        # sidecar at the current turn, so a kill anywhere past this
+        # line rehydrates exactly what was parked.
+        saved = self._checkpoint(sid)
+        meta = {"width": b.width, "height": b.height,
+                "rule": str(b.rule), "parked": True,
+                "turn": int(saved["turn"])}
+        if s.seed is not None:
+            meta["seed"] = s.seed
+            meta["density"] = s.density
+        # Free the device rows: a traced slot clear (zero recompiles
+        # in a warm bucket — the create/destroy discipline).
+        b.stack = b.bs.clear_one(b.stack, s.slot)
+        del b.sessions[s.slot]
+        b.free.append(s.slot)
+        del self._by_id[sid]
+        self._parked[sid] = meta
+        # Manifest after the parked record exists in memory: the
+        # rewrite commits the parked flag durably (a kill between the
+        # checkpoint and this rewrite resumes the session LIVE from
+        # its snapshot — bounded conservatism, never loss). The idle
+        # sweep defers it to ONE commit per sweep (see _park_idle).
+        if not self._deferring_manifest:
+            self._write_manifest()
+        for name in PER_SESSION_SERIES:
+            obs.registry().remove(name, {"session": sid})
+        _METRICS.hibernates.inc()
+        _METRICS.parked.set(len(self._parked))
+        _METRICS.active.set(len(self._by_id))
+        device.observe_memory()
+        tracing.event("session.park", "lifecycle", session=sid,
+                      turn=meta["turn"])
+        flight.note("session.park", session=sid, turn=meta["turn"])
+        return {"id": sid, "turn": meta["turn"], "path": saved["path"]}
+
+    def _rehydrate(self, sid: str) -> Session:
+        """Parked -> live: read the hibernated snapshot (manifest
+        recipe as the torn-disk fallback) and re-create the session in
+        its bucket at the recorded turn — bit-exact (PGM snapshots are
+        complete state), traced slot writes only (zero recompiles in a
+        warm bucket). Raises SessionError("max-sessions") when the
+        RESIDENT budget is full — rehydration is an admission, and the
+        caller's retry hint applies."""
+        from gol_tpu.checkpoint import (
+            latest_any_snapshot,
+            session_checkpoint_dir,
+            snapshot_turn,
+        )
+        from gol_tpu.io.pgm import read_pgm
+
+        meta = self._parked[sid]
+        # A parked record may have been resumed from a torn/hostile
+        # manifest: every field access must surface as a SessionError
+        # (the server's attach path answers those; anything else would
+        # kill its accept machinery).
+        try:
+            w, h = int(meta["width"]), int(meta["height"])
+            rule = get_rule(meta.get("rule") or str(self.default_rule))
+            seed = meta.get("seed")
+            density = float(meta.get("density", 0.25))
+            turn = int(meta.get("turn", 0))
+        except (KeyError, TypeError, ValueError):
+            raise SessionError("unrecoverable") from None
+        d = os.path.join(session_checkpoint_dir(self.out_dir), sid)
+        board = None
+        found = latest_any_snapshot(d)
+        if found is not None:
+            path, _w, _h = found
+            with contextlib.suppress(OSError, ValueError):
+                board = read_pgm(path)
+                turn = snapshot_turn(path)
+        if board is None and seed is not None:
+            # Torn snapshot tree: the recipe still rebuilds turn 0
+            # deterministically (bounded loss, never resurrection of
+            # garbage).
+            board = seeded_board(w, h, int(seed), density)
+            turn = 0
+        if board is None or board.shape != (h, w):
+            # (a snapshot of a different geometry than the manifest
+            # claims is a torn tree, not a crash-worthy surprise)
+            raise SessionError("unrecoverable")
+        del self._parked[sid]
+        try:
+            self._create(sid, w, h, rule, board, turn,
+                         seed=seed, density=density)
+        except BaseException:
+            self._parked[sid] = meta  # stay parked on any failure
+            raise
+        _METRICS.rehydrates.inc()
+        _METRICS.parked.set(len(self._parked))
+        tracing.event("session.rehydrate", "lifecycle", session=sid,
+                      turn=turn)
+        flight.note("session.rehydrate", session=sid, turn=turn)
+        return self._by_id[sid]
+
     def _attach(self, sid: str, sink: Sink) -> dict:
-        s = self._require(sid)
+        s = self._by_id.get(sid)
+        if s is None and sid in self._parked:
+            # Attach is the rehydration trigger: a parked session
+            # comes back resident, bit-exact, before the sync below.
+            s = self._rehydrate(sid)
+        elif s is None:
+            raise SessionError("unknown-session")
         b = s.bucket
         board = self._fetch_board(sid)
         sink.on_sync(sid, s.turn, board)
         b.sinks.setdefault(sid, []).append(sink)
+        s.idle_since = None
         s.watchers_metric.set(len(b.sinks[sid]))
         tracing.event("session.attach", "lifecycle", session=sid)
         return s.info()
@@ -785,6 +1070,9 @@ class SessionManager:
             sinks.remove(sink)
         if not sinks:
             s.bucket.sinks.pop(sid, None)
+            # The idle clock starts when the LAST sink leaves — the
+            # auto-park policy's trigger.
+            s.idle_since = time.monotonic()
         s.watchers_metric.set(len(sinks))
         tracing.event("session.detach", "lifecycle", session=sid)
 

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Merge the activity-driven stepping lane into BENCH_DETAIL.json —
+the bounded capture for containers without the TPU attached (the
+`wire_batch_capture.py` pattern applied to ISSUE 13's acceptance
+lane).
+
+Runs `bench.measure_activity` — a localized 512² soup on a 32k x 32k
+board, the tiled activity-driven stepper vs the dense packed stepper
+over identical 32-turn chunk histories, with the IN-LANE bit-identity
+gate (the committed tiled world must equal the dense one bit for bit)
+— with the device plane bracketed (`_lane`), and writes the result
+under
+
+    BENCH_DETAIL.json["activity_32768_soup"]
+
+stamping the substrate platform. No other lane is touched, so
+`bench_compare` against an older capture sees one new key, never a
+fake regression; `active_tiles`/`tile_steps`/`paged_bytes` gate
+LOWER, `speedup` HIGHER, and the lane's `device_plane.compiles` rides
+the off-zero compile gate.
+
+Usage: python scripts/activity_capture.py [SIDE [TILE [TURNS]]]
+       (CPU-safe; the default 32768² lane is a few minutes of
+       single-core dense stepping — the A/B denominator)
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    import jax
+
+    from gol_tpu.obs import device
+
+    device.install_compile_watcher()
+
+    import bench
+
+    side = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    tile = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    turns = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+    entry = bench._lane(bench.measure_activity, side=side, tile=tile,
+                        turns=turns)
+    entry["platform"] = jax.devices()[0].platform
+
+    detail_path = REPO / "BENCH_DETAIL.json"
+    detail = json.loads(detail_path.read_text())
+    detail["activity_32768_soup"] = entry
+    detail_path.write_text(json.dumps(detail, indent=1))
+    print(json.dumps(entry, indent=1))
+    if not entry.get("bit_identical"):
+        print("activity_32768_soup: FAILED — oracle mismatch")
+        return 1
+    ok = entry.get("speedup", 0) >= 10
+    print(f"activity_32768_soup: {entry.get('speedup', 0):.1f}x the "
+          f"dense path, bit-identical "
+          f"({'PASS' if ok else 'BELOW'} the 10x acceptance bar)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

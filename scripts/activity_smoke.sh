@@ -1,0 +1,165 @@
+#!/usr/bin/env bash
+# Hibernation smoke test (gol_tpu.sessions park/rehydrate, ISSUE 13):
+# boot a real `--serve --sessions --park-idle-secs 0` server with the
+# metrics sidecar, churn 1000 sessions through create -> auto-park ->
+# (sampled) attach from one control client, and assert on /metrics
+# that
+#   - the HBM watermark gauge stays FLAT across the churn (sessions
+#     park out of their bucket slots, so 1000 registrations never
+#     grow device memory — --max-sessions is a resident bound),
+#   - the bucket NEVER grows (gol_tpu_session_bucket_grows_total 0),
+#   - hibernate/rehydrate counters moved and parked sessions exist,
+# and that a REHYDRATED session's board sync is bit-exact against its
+# seed-recipe oracle (the chaos-harness discipline).
+# No pytest, no mocks — the operator's view of the hibernation plane.
+#
+# Usage: scripts/activity_smoke.sh [SESSIONS]   (CPU-safe; ~2-4 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SESSIONS=${1:-1000}
+LOG=$(mktemp)
+OUT=$(mktemp -d)
+cleanup() {
+    kill "$PID" 2>/dev/null || true
+    wait "$PID" 2>/dev/null || true
+    rm -rf "$LOG" "$OUT"
+}
+
+# park-idle-secs 0.2: resident sessions accrue real turns before the
+# sweep hibernates them, so the revival bit-check exercises a stepped
+# board, not the seed itself.
+python -m gol_tpu -noVis -w 64 -h 64 --platform cpu \
+    --serve 127.0.0.1:0 --sessions --park-idle-secs 0.2 \
+    --bucket-capacity 32 --max-sessions 32 --out "$OUT" \
+    --metrics-port 0 >"$LOG" 2>&1 &
+PID=$!
+trap cleanup EXIT
+
+BASE=""
+ADDR=""
+for _ in $(seq 1 240); do
+    BASE=$(sed -n 's#^metrics serving on \(http://[^/]*\)/metrics$#\1#p' "$LOG" | head -1)
+    ADDR=$(sed -n 's#^session engine serving on \(.*\)$#\1#p' "$LOG" | head -1)
+    [ -n "$BASE" ] && [ -n "$ADDR" ] && break
+    if ! kill -0 "$PID" 2>/dev/null; then
+        echo "activity smoke: FAILED — server died during startup:" >&2
+        cat "$LOG" >&2
+        exit 1
+    fi
+    sleep 0.5
+done
+if [ -z "$BASE" ] || [ -z "$ADDR" ]; then
+    echo "activity smoke: FAILED — addresses not printed:" >&2
+    cat "$LOG" >&2
+    exit 1
+fi
+HOST=${ADDR%:*}
+PORT=${ADDR##*:}
+
+# The churn driver: create SESSIONS seeded sessions (riding the
+# max-sessions retry hints while the idle sweep parks the previous
+# wave), sample the watermark after the first bucketful, attach a
+# survivor mid-churn and bit-check its rehydrated sync against the
+# seed-recipe oracle.
+JAX_PLATFORMS=cpu python - "$HOST" "$PORT" "$BASE" "$SESSIONS" <<'PYEOF'
+import json, sys, time, urllib.request
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from gol_tpu.distributed import Controller, SessionControl
+from gol_tpu.parallel.stepper import make_stepper
+from gol_tpu.sessions.manager import seeded_board
+
+host, port, base, total = (sys.argv[1], int(sys.argv[2]),
+                           sys.argv[3], int(sys.argv[4]))
+
+
+def metric(name):
+    text = urllib.request.urlopen(base + "/metrics", timeout=15
+                                  ).read().decode()
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[-1])
+    return None
+
+
+ctl = SessionControl(host, port, retry_window=120.0)
+t0 = time.monotonic()
+# First wave: enough churn to fill and recycle the bucket at least
+# twice, then wait for the steady regime (idle sweep parking, census
+# fired) before taking the flatness baseline — the watermark is a
+# PEAK gauge, so the baseline must postdate warm-up.
+first_wave = 64
+for i in range(first_wave):
+    ctl.create(f"churn{i}", width=64, height=64, seed=i)
+watermark_early = None
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    parked = metric("gol_tpu_sessions_parked") or 0
+    watermark_early = metric("gol_tpu_device_hbm_watermark_bytes")
+    if parked >= first_wave - 32 and watermark_early:
+        break
+    time.sleep(0.5)
+assert watermark_early, "no watermark series after the first wave"
+for i in range(first_wave, total):
+    ctl.create(f"churn{i}", width=64, height=64, seed=i)
+print(f"created {total} sessions in {time.monotonic() - t0:.1f}s",
+      flush=True)
+
+# Let the sweep park the tail, then assert the fleet is mostly asleep.
+deadline = time.monotonic() + 60
+while True:
+    parked = metric("gol_tpu_sessions_parked") or 0
+    if parked >= total - 32 or time.monotonic() > deadline:
+        break
+    time.sleep(0.5)
+listing = ctl.list()
+assert len(listing) == total, f"{len(listing)} != {total}"
+n_parked = sum(1 for s in listing if s.get("parked"))
+assert n_parked >= total - 32, f"only {n_parked}/{total} parked"
+
+grows = metric("gol_tpu_session_bucket_grows_total") or 0
+assert grows == 0, f"bucket grew {grows} times under hibernating churn"
+watermark_late = metric("gol_tpu_device_hbm_watermark_bytes")
+assert watermark_early and watermark_late, "no watermark series"
+assert watermark_late <= watermark_early * 1.02, (
+    f"HBM watermark rose under churn: {watermark_early} -> "
+    f"{watermark_late}"
+)
+
+hib = metric("gol_tpu_session_hibernates_total") or 0
+assert hib >= total - 32, f"hibernates={hib}"
+
+# Bit-exact revival: attach a parked mid-churn session; its BoardSync
+# turn T board must equal seeded_board(seed) stepped T turns.
+victim = next(s for s in listing if s.get("parked"))
+seed = int(victim["id"][5:])
+w = Controller(host, port, want_flips=True, batch=True,
+               session=victim["id"])
+assert w.wait_sync(60) and w.board is not None, "no revival sync"
+turn, got = w.sync_turn, w.board.copy()
+oracle = make_stepper(threads=1, height=64, width=64, backend="packed")
+ow = oracle.put(seeded_board(64, 64, seed))
+ow, _ = oracle.step_n(ow, turn)
+assert np.array_equal(oracle.fetch(ow), got), (
+    f"rehydrated {victim['id']} diverged from its recipe oracle at "
+    f"turn {turn}"
+)
+rehydrates = metric("gol_tpu_session_rehydrates_total") or 0
+assert rehydrates >= 1
+w.detach(20)
+w.close()
+ctl.close()
+print(f"CHURN_OK parked={n_parked} hibernates={int(hib)} "
+      f"rehydrates={int(rehydrates)} watermark={int(watermark_late)} "
+      f"revived={victim['id']}@t{turn}")
+PYEOF
+
+kill -INT "$PID"
+for _ in $(seq 1 60); do
+    kill -0 "$PID" 2>/dev/null || break
+    sleep 0.5
+done
+
+echo "activity smoke: OK ($SESSIONS-session churn, HBM flat, bucket never grew, revival bit-exact)"

@@ -66,7 +66,15 @@ LOWER_BETTER = re.compile(
     # sits at its 1.0 floor under zero-re-encode fan-out — any upward
     # drift means the root started re-encoding per peer again (its
     # shed/overflow deltas ride the off-zero rule above).
-    r"|encodes_per_chunk)", re.I
+    r"|encodes_per_chunk"
+    # Activity plane (ISSUE 13): a localized-soup lane's dispatch set
+    # and paging traffic regress UP (more tiles stepped / more bytes
+    # paged for the same workload means the light-cone skip got
+    # worse); `paged_bytes` also matches the generic `bytes` rule,
+    # named here for the activity lane's tile counters. `speedup`
+    # gates HIGHER via the existing rule, and the lane's
+    # device_plane.compiles rides the off-zero compile gate.
+    r"|active_tiles|tile_steps)", re.I
 )
 
 

@@ -1,0 +1,367 @@
+"""Session hibernation (gol_tpu.sessions park/rehydrate, ISSUE 13).
+
+Pins the lifecycle contracts (docs/SESSIONS.md "Hibernation"):
+
+- BIT-EXACT REHYDRATE: park checkpoints via the PR 7 manifest, frees
+  the device slot, and the next attach restores the identical board at
+  the identical turn — across manager restarts too.
+- ZERO RECOMPILES: warm hibernate/rehydrate cycles move no jit cache
+  (slot clear/set are traced — the bucket discipline).
+- HBM-FLAT CHURN: far more sessions than bucket slots churn through
+  create->auto-park without a single bucket growth — --max-sessions
+  counts RESIDENT sessions only.
+- DURABILITY: parked sessions survive restarts AS parked, destroy of a
+  parked session tombstones, create over a parked id is "exists".
+- WIRE: the park verb (idempotent under rid retry), attach-rehydrates,
+  and bounded per-session label eviction at park.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import obs
+from gol_tpu.parallel.stepper import make_stepper
+from gol_tpu.sessions import (
+    SessionEngine,
+    SessionError,
+    SessionManager,
+    Sink,
+)
+from gol_tpu.sessions.manager import seeded_board
+
+
+@pytest.fixture(autouse=True)
+def _invariants_on(monkeypatch):
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.analysis.invariants import violations_total
+
+    before = violations_total()
+    yield
+    grew = violations_total() - before
+    assert grew == 0, (
+        f"gol_tpu_invariant_violations_total grew by {grew} during a "
+        "hibernation test"
+    )
+
+
+class SyncSink(Sink):
+    want_flips = False
+
+    def __init__(self):
+        self.syncs = []
+        self.turns = []
+        self.event = threading.Event()
+
+    def on_sync(self, sid, turn, board):
+        self.syncs.append((turn, board.copy()))
+        self.event.set()
+
+    def on_turn(self, sid, turn):
+        self.turns.append(turn)
+
+
+def _oracle(seed: int, turns: int, side: int = 64) -> np.ndarray:
+    board = seeded_board(side, side, seed)
+    d = make_stepper(threads=1, height=side, width=side,
+                     backend="packed")
+    world = d.put(board)
+    world, _ = d.step_n(world, turns)
+    return d.fetch(world)
+
+
+def test_park_rehydrate_bit_exact(tmp_path):
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    m.create("a", width=64, height=64, seed=5)
+    m.pump(40, chunk=16)
+    parked = m.park("a")
+    assert parked["turn"] == 40
+    assert m.get("a") is None
+    # the slot really is free again
+    listing = {i["id"]: i for i in m.list_sessions()}
+    assert listing["a"]["parked"] is True and listing["a"]["turn"] == 40
+    sink = SyncSink()
+    info = m.attach("a", sink)
+    turn, board = sink.syncs[0]
+    assert turn == 40 and info["turn"] == 40
+    assert np.array_equal(board, _oracle(5, 40))
+    # rehydrated session steps on with its bucket
+    m.pump(8, chunk=8)
+    assert m.get("a").turn == 48
+
+
+def test_park_semantics_and_durability(tmp_path):
+    from gol_tpu.checkpoint import (
+        is_tombstoned,
+        manifest_parked,
+        read_session_manifest,
+    )
+
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    with pytest.raises(SessionError, match="unknown-session"):
+        m.park("ghost")
+    m.create("a", width=64, height=64, seed=1)
+    sink = SyncSink()
+    m.attach("a", sink)
+    with pytest.raises(SessionError, match="watched"):
+        m.park("a")
+    m.detach("a", sink)
+    m.park("a")
+    with pytest.raises(SessionError, match="parked"):
+        m.park("a")
+    with pytest.raises(SessionError, match="parked"):
+        m.checkpoint("a")  # needs a resident board
+    with pytest.raises(SessionError, match="exists"):
+        m.create("a", width=64, height=64, seed=1)  # id still owned
+    manifest = read_session_manifest(str(tmp_path))
+    assert manifest_parked(manifest["a"]) and manifest["a"]["turn"] == 0
+    # restart: the parked record survives AS parked (no slot claimed)
+    m2 = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    assert m2.resume_all() == 1
+    assert m2.get("a") is None and m2.is_parked("a")
+    assert m2.peek_turn("a") == 0
+    sink2 = SyncSink()
+    m2.attach("a", sink2)
+    assert np.array_equal(sink2.syncs[0][1], seeded_board(64, 64, 1))
+    # destroy a parked session: tombstoned, never resurrected
+    m2.detach("a", sink2)
+    m2.park("a")
+    m2.destroy("a")
+    assert is_tombstoned(str(tmp_path), "a")
+    m3 = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    assert m3.resume_all() == 0
+    assert m3.list_sessions() == []
+
+
+def test_warm_hibernate_cycle_zero_recompiles(tmp_path):
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    m.create("warm", width=64, height=64, seed=2)
+    m.create("cycler", width=64, height=64, seed=3)
+    m.pump(4)
+    b = m.get("warm").bucket
+    # one cold cycle warms the traced clear/take/set programs; the
+    # census then pins that further cycles never compile again
+    m.park("cycler")
+    warm_sink = SyncSink()
+    m.attach("cycler", warm_sink)
+    m.detach("cycler", warm_sink)
+    census = b.bs.cache_sizes()
+    for _ in range(3):
+        m.park("cycler")
+        sink = SyncSink()
+        m.attach("cycler", sink)
+        m.detach("cycler", sink)
+        m.pump(4)
+    assert m.get("warm").bucket is b, "rehydrate must reuse the bucket"
+    assert b.bs.cache_sizes() == census, (
+        "a warm hibernate/rehydrate cycle recompiled"
+    )
+
+
+def test_park_evicts_per_session_labels(tmp_path):
+    from gol_tpu.sessions.manager import _METRICS
+
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    n0 = len(obs.registry().metrics())
+    h0 = _METRICS.hibernates.value
+    r0 = _METRICS.rehydrates.value
+    m.create("lbl", width=64, height=64, seed=4)
+    m.pump(4)
+    assert len(obs.registry().metrics()) > n0  # labeled children live
+    m.park("lbl")
+    assert len(obs.registry().metrics()) == n0, (
+        "per-session labels must leave the registry with the slot"
+    )
+    assert _METRICS.hibernates.value == h0 + 1
+    assert _METRICS.parked.value == len(
+        [i for i in m.list_sessions() if i.get("parked")]
+    )
+    sink = SyncSink()
+    m.attach("lbl", sink)
+    assert _METRICS.rehydrates.value == r0 + 1
+    m.destroy("lbl")
+    assert len(obs.registry().metrics()) == n0
+
+
+def test_auto_park_and_attach_revival(tmp_path):
+    """The idle sweep (park_idle_secs=0) hibernates unwatched sessions
+    on the next engine round; an attach revives them mid-run and the
+    stream continues from the parked turn."""
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4,
+                       park_idle_secs=0.0)
+    eng = SessionEngine(m, watched_chunk=4, idle_chunk=8).start()
+    try:
+        m.create("idle", width=64, height=64, seed=6)
+        deadline = time.monotonic() + 30
+        while not m.is_parked("idle"):
+            assert time.monotonic() < deadline, "idle session never parked"
+            time.sleep(0.02)
+        parked_turn = m.peek_turn("idle")
+        sink = SyncSink()
+        info = m.attach("idle", sink)
+        assert sink.event.wait(10)
+        turn, board = sink.syncs[0]
+        assert turn == parked_turn == info["turn"]
+        assert np.array_equal(board, _oracle(6, turn))
+        # watched now: it steps instead of re-parking
+        deadline = time.monotonic() + 30
+        while not sink.turns:
+            assert time.monotonic() < deadline, "revived session idle"
+            time.sleep(0.02)
+        assert not m.is_parked("idle")
+    finally:
+        eng.stop()
+        eng.join(30)
+
+
+def test_churn_stays_hbm_flat(tmp_path):
+    """Far more sessions than slots churn through create->auto-park:
+    the bucket NEVER grows (gol_tpu_session_bucket_grows_total flat)
+    — --max-sessions is a resident bound, registration is disk-bound.
+    A rehydrated survivor is bit-exact against its recipe oracle."""
+    from gol_tpu.sessions.manager import _METRICS
+
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=8,
+                       park_idle_secs=0.0, max_sessions=8)
+    eng = SessionEngine(m, watched_chunk=4, idle_chunk=8).start()
+    grows0 = _METRICS.bucket_grows.value
+    total = 60
+    try:
+        made = 0
+        deadline = time.monotonic() + 120
+        while made < total:
+            assert time.monotonic() < deadline, (
+                f"churn stalled at {made}/{total}"
+            )
+            try:
+                m.create(f"s{made}", width=64, height=64, seed=made)
+            except SessionError as e:
+                # the resident budget is full until the sweep parks —
+                # exactly the admission-rate bound the ISSUE names
+                assert str(e) == "max-sessions"
+                time.sleep(0.02)
+                continue
+            made += 1
+        deadline = time.monotonic() + 60
+        while len(m.health()["ticks"]) and m.health()["sessions"]:
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.02)
+        assert _METRICS.bucket_grows.value == grows0, (
+            "hibernating churn must never grow the bucket"
+        )
+        listing = m.list_sessions()
+        assert len(listing) == total
+        assert sum(1 for i in listing if i.get("parked")) >= total - 8
+        # one survivor rehydrates bit-exactly
+        sink = SyncSink()
+        m.attach("s7", sink)
+        turn, board = sink.syncs[0]
+        assert np.array_equal(board, _oracle(7, turn))
+    finally:
+        eng.stop()
+        eng.join(30)
+
+
+def test_wire_park_verb_and_revival(tmp_path):
+    """Wire lifecycle: SessionControl.park (idempotent), list shows
+    parked, a Controller attach rehydrates and streams from the
+    parked turn, and a parked session survives --resume latest."""
+    from gol_tpu.distributed import Controller, SessionControl, SessionServer
+    from gol_tpu.params import Params
+
+    p = Params(turns=10**9, threads=1, image_width=64, image_height=64,
+               out_dir=str(tmp_path / "out"))
+    srv = SessionServer(p, port=0, watched_chunk=4,
+                        idle_chunk=8).start()
+    try:
+        ctl = SessionControl(*srv.address)
+        ctl.create("w", width=64, height=64, seed=11)
+        time.sleep(0.3)  # accrue turns
+        parked = ctl.park("w")
+        assert parked["id"] == "w" and parked["turn"] >= 0
+        got = [s for s in ctl.list() if s["id"] == "w"]
+        assert got and got[0].get("parked") is True
+        with pytest.raises(SessionError, match="parked"):
+            ctl.checkpoint("w")
+        # attach revives it: BoardSync at (or past) the parked turn
+        w = Controller(*srv.address, want_flips=True, batch=True,
+                       session="w")
+        assert w.wait_sync(30) and w.board is not None
+        assert w.sync_turn >= parked["turn"]
+        assert not srv.manager.is_parked("w")
+        w.detach(20)
+        w.close()
+        ctl.close()
+    finally:
+        srv.shutdown()
+    # restart with resume: the parked state machinery composes with
+    # the PR 7 manifest (park again first so it is parked at kill)
+    srv2 = SessionServer(p, port=0, watched_chunk=4, idle_chunk=8,
+                         resume=True).start()
+    try:
+        ctl2 = SessionControl(*srv2.address)
+        assert any(s["id"] == "w" for s in ctl2.list())
+        ctl2.park("w")
+        assert any(s.get("parked") for s in ctl2.list()
+                   if s["id"] == "w")
+        ctl2.close()
+    finally:
+        srv2.shutdown()
+    srv3 = SessionServer(p, port=0, resume=True)
+    try:
+        assert srv3.manager.is_parked("w")
+    finally:
+        srv3.shutdown()
+
+
+def test_wire_park_rid_replay(tmp_path):
+    """A rid-stamped park retried verbatim answers ok both times (the
+    replay window), and a park retried AFTER the window converges via
+    the state-based 'parked' fallback — at-least-once in, exactly-once
+    in effect (the PR 7 idempotency discipline)."""
+    import socket
+
+    from gol_tpu.distributed import SessionControl, SessionServer
+    from gol_tpu.distributed import wire
+    from gol_tpu.params import Params
+
+    p = Params(turns=10**9, threads=1, image_width=64, image_height=64,
+               out_dir=str(tmp_path / "out"))
+    srv = SessionServer(p, port=0, watched_chunk=4,
+                        idle_chunk=8).start()
+    try:
+        ctl = SessionControl(*srv.address)
+        ctl.create("r", width=64, height=64, seed=12)
+        sock = socket.create_connection(srv.address, timeout=10)
+        sock.settimeout(10)
+        wire.send_msg(sock, {"t": "hello", "sessions": True})
+        assert wire.recv_msg(sock, allow_binary=False)["t"] == "attach-ack"
+
+        def rpc(msg):
+            wire.send_msg(sock, msg)
+            while True:
+                r = wire.recv_msg(sock, allow_binary=False)
+                if r.get("t") == "hb":
+                    wire.send_msg(sock, {"t": "hb"})
+                    continue
+                if r.get("t") == "session-r":
+                    return r
+
+        first = rpc({"t": "session", "op": "park", "id": "r",
+                     "rid": "rid-park-1"})
+        assert first.get("ok"), first
+        again = rpc({"t": "session", "op": "park", "id": "r",
+                     "rid": "rid-park-1"})
+        assert again.get("ok"), again  # verbatim replay
+        fresh = rpc({"t": "session", "op": "park", "id": "r",
+                     "rid": "rid-park-2"})
+        assert fresh.get("ok") and fresh.get("replayed"), fresh
+        bare = rpc({"t": "session", "op": "park", "id": "r"})
+        assert not bare.get("ok") and bare.get("reason") == "parked"
+        sock.close()
+        ctl.close()
+    finally:
+        srv.shutdown()

@@ -1,0 +1,347 @@
+"""Activity-driven tiled stepping (parallel/tiled.py, ISSUE 13).
+
+Pins the tentpole contracts:
+
+- BIT-EQUALITY: random soups swept across tile corners/edges/wrap
+  seams, stepped through mixed chunk sizes, fused AND per-turn diff
+  paths, paging sub-batches and ride-cache replays — all bit-identical
+  to the dense packed oracle, with runtime invariants forced ON.
+- GATE SENSITIVITY: a deliberately-broken ghost gather (a dropped halo
+  carry) is asserted to FAIL the bit-equality gate — the PR 4
+  oracle-verification pattern: the oracle must be able to lose.
+- ZERO RECOMPILES: a warm tile pool re-dispatches with no jit-cache
+  movement and no device-plane compiles whatever the active set does.
+- BOUNDED LABELS: per-tile metric children ride one TopKGauge — the
+  registry never grows under tile churn and the exposition stays
+  O(cap).
+- CAPACITY: fits(resident_tiles=) and max_resident_tiles price the
+  same tile_ext_bytes constant, so the paging policy and the capacity
+  answer cannot disagree.
+"""
+
+import numpy as np
+import pytest
+
+from gol_tpu import obs
+from gol_tpu.parallel import tiled as tiled_mod
+from gol_tpu.parallel.stepper import make_stepper
+from gol_tpu.parallel.tiled import TiledStepper, tileable, tiled_stepper
+
+
+@pytest.fixture(autouse=True)
+def _invariants_on(monkeypatch):
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.analysis.invariants import violations_total
+
+    before = violations_total()
+    yield
+    grew = violations_total() - before
+    assert grew == 0, (
+        f"gol_tpu_invariant_violations_total grew by {grew} during a "
+        "tiled test"
+    )
+
+
+def _soup(seed: int, h: int, w: int, density: float = 0.3) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return ((rng.random((h, w)) < density) * 255).astype(np.uint8)
+
+
+def _oracle(board: np.ndarray, turns: int) -> tuple:
+    h, w = board.shape
+    d = make_stepper(threads=1, height=h, width=w, backend="packed")
+    world = d.put(board)
+    world, count = d.step_n(world, turns)
+    return d.fetch(world), int(count)
+
+
+PULSAR = [
+    (0, 2), (0, 3), (0, 4), (0, 8), (0, 9), (0, 10),
+    (2, 0), (2, 5), (2, 7), (2, 12), (3, 0), (3, 5), (3, 7), (3, 12),
+    (4, 0), (4, 5), (4, 7), (4, 12),
+    (5, 2), (5, 3), (5, 4), (5, 8), (5, 9), (5, 10),
+    (7, 2), (7, 3), (7, 4), (7, 8), (7, 9), (7, 10),
+    (8, 0), (8, 5), (8, 7), (8, 12), (9, 0), (9, 5), (9, 7), (9, 12),
+    (10, 0), (10, 5), (10, 7), (10, 12),
+    (12, 2), (12, 3), (12, 4), (12, 8), (12, 9), (12, 10),
+]
+
+
+def _stamp(board: np.ndarray, cells, at) -> None:
+    r0, c0 = at
+    h, w = board.shape
+    for r, c in cells:
+        board[(r0 + r) % h, (c0 + c) % w] = 255
+
+
+def test_full_soup_matches_dense_through_mixed_chunks():
+    h = w = 128
+    board = _soup(1, h, w)
+    t = make_stepper(threads=1, height=h, width=w, tile=64)
+    assert "tiled" in t.name and t.tiled is not None
+    world = t.put(board)
+    total = 0
+    # Mixed chunk sizes exercise the (mode, k) reactivation rule: a
+    # boundary flag computed at k=32 must never justify a skip at k=5.
+    for k in (1, 3, 32, 5, 64, 2, 32):
+        world, count = t.step_n(world, k)
+        total += k
+    want, want_count = _oracle(board, total)
+    assert int(count) == want_count
+    assert np.array_equal(t.fetch(world), want)
+
+
+@pytest.mark.parametrize("at", [
+    (0, 0),          # grid origin
+    (62, 62),        # straddles the first tile corner (tile=64)
+    (63, 64),        # astride a vertical tile seam
+    (64, 63),        # astride a horizontal tile seam
+    (126, 126),      # straddles the torus wrap corner
+    (30, 126),       # wrap seam, row interior
+])
+def test_soup_across_tile_corners_and_edges(at):
+    """Random soups placed exactly on tile corners/edges/wrap seams —
+    where a broken halo carry would bite first."""
+    h = w = 128
+    board = np.zeros((h, w), np.uint8)
+    r0, c0 = at
+    patch = _soup(at[0] * 131 + at[1], 8, 8, 0.5)
+    for r in range(8):
+        for c in range(8):
+            if patch[r, c]:
+                board[(r0 + r) % h, (c0 + c) % w] = 255
+    t = make_stepper(threads=1, height=h, width=w, tile=64)
+    world = t.put(board)
+    world, count = t.step_n(world, 96)
+    want, want_count = _oracle(board, 96)
+    assert int(count) == want_count
+    assert np.array_equal(t.fetch(world), want)
+
+
+def test_per_turn_diff_stream_matches_dense():
+    """step_n_with_diffs must emit the identical packed XOR stack the
+    dense backend scans — per TURN, not per boundary (a mid-chunk
+    oscillation must flip), including across fused<->diffs mode
+    switches."""
+    h = w = 128
+    board = _soup(2, h, w, 0.25)
+    d = make_stepper(threads=1, height=h, width=w, backend="packed")
+    t = make_stepper(threads=1, height=h, width=w, tile=64)
+    dw, tw = d.put(board), t.put(board)
+    # fused prefix (mode switch must reactivate, not leak stale flags)
+    dw, _ = d.step_n(dw, 32)
+    tw, _ = t.step_n(tw, 32)
+    for k in (7, 1, 16):
+        dw, dd, dc = d.step_n_with_diffs(dw, k)
+        tw, td, tc = t.step_n_with_diffs(tw, k)
+        assert int(dc) == int(tc)
+        assert np.array_equal(np.asarray(dd), np.asarray(td))
+    # fused suffix lands on the same world
+    dw, dc = d.step_n(dw, 48)
+    tw, tc = t.step_n(tw, 48)
+    assert int(dc) == int(tc)
+    assert np.array_equal(d.fetch(dw), t.fetch(tw))
+
+
+def test_paging_sub_batches_stay_exact():
+    """An active set larger than the residency bound pages through in
+    multiple slabs — all gathered from chunk-start state, so the
+    result is the dense stepper's bit for bit."""
+    h = w = 128
+    board = _soup(3, h, w, 0.35)
+    t = tiled_stepper("B3/S23", h, w, 32, max_resident=3)
+    world = t.put(board)
+    world, count = t.step_n(world, 70)
+    want, want_count = _oracle(board, 70)
+    assert int(count) == want_count
+    assert np.array_equal(t.fetch(world), want)
+    assert t.tiled.max_resident == 3
+    assert t.tiled._pool_cap <= 3
+
+
+def test_settled_board_leaves_the_dispatch_set():
+    """A still-life board drops to an EMPTY dispatch set after two
+    chunks: settled tiles cost nothing at all."""
+    h = w = 128
+    board = np.zeros((h, w), np.uint8)
+    # a block (still life) per quadrant
+    for r0, c0 in ((10, 10), (10, 90), (90, 10), (90, 90)):
+        board[r0:r0 + 2, c0:c0 + 2] = 255
+    t = make_stepper(threads=1, height=h, width=w, tile=64)
+    world = t.put(board)
+    world, _ = t.step_n(world, 64)  # settle the flags
+    steps0 = tiled_mod._METRICS.tile_steps.value
+    rides0 = tiled_mod._METRICS.tile_rides.value
+    world, count = t.step_n(world, 256)
+    assert tiled_mod._METRICS.tile_steps.value == steps0
+    assert tiled_mod._METRICS.tile_rides.value == rides0
+    assert int(count) == 16
+    want, _ = _oracle(board, 320)
+    assert np.array_equal(t.fetch(world), want)
+
+
+def test_oscillating_island_rides_without_dispatch():
+    """A period-3 pulsar (period NOT dividing the 32-turn chunk) keeps
+    its boundary flags changing — but after one warm period the ride
+    cache replays it with zero device dispatches, bit-exactly (the
+    PR 10 cycle-riding, per tile)."""
+    h = w = 128
+    board = np.zeros((h, w), np.uint8)
+    _stamp(board, PULSAR, (20, 20))
+    t = make_stepper(threads=1, height=h, width=w, tile=64)
+    world = t.put(board)
+    world, _ = t.step_n(world, 32 * 4)  # warm one cache period
+    rides0 = tiled_mod._METRICS.tile_rides.value
+    steps0 = tiled_mod._METRICS.tile_steps.value
+    world, count = t.step_n(world, 32 * 8)
+    assert tiled_mod._METRICS.tile_rides.value > rides0
+    assert tiled_mod._METRICS.tile_steps.value == steps0, (
+        "a warmed oscillating island must replay from the ride cache, "
+        "not re-dispatch"
+    )
+    want, want_count = _oracle(board, 32 * 12)
+    assert int(count) == want_count
+    assert np.array_equal(t.fetch(world), want)
+
+
+def test_broken_halo_carry_fails_the_gate():
+    """The oracle must be able to lose (the PR 4 verification pattern):
+    corrupt ONE ghost word-row in the gather and the committed world
+    must diverge from the dense stepper — proving the bit-equality
+    gate actually exercises the halo path."""
+    h = w = 128
+    board = np.zeros((h, w), np.uint8)
+    # activity right on a tile seam so the ghost row carries real state
+    board[62:66, 60:70] = _soup(9, 4, 10, 0.6)
+    t = make_stepper(threads=1, height=h, width=w, tile=64)
+    impl = t.tiled
+    real_gather = impl._gather
+
+    def broken(words, r, c):
+        ext = real_gather(words, r, c).copy()
+        ext[0, :] = 0  # drop the upper ghost word-row: a lost carry
+        return ext
+
+    impl._gather = broken
+    world = t.put(board)
+    world, _ = t.step_n(world, 64)
+    want, _ = _oracle(board, 64)
+    assert not np.array_equal(t.fetch(world), want), (
+        "a dropped halo carry went undetected — the gate is blind"
+    )
+
+
+def test_warm_pool_zero_recompiles():
+    """Warm tile pool: once the slab capacity and chunk size are
+    compiled, dispatches with ANY active-set shape move neither the
+    jit cache nor the device-plane compile counters (the acceptance
+    census)."""
+    from gol_tpu.obs import device as obs_device
+
+    obs_device.install_compile_watcher()
+    h = w = 128
+    t = make_stepper(threads=1, height=h, width=w, tile=32)
+    impl = t.tiled
+    world = t.put(_soup(4, h, w, 0.3))
+    world, _ = t.step_n(world, 64)  # warm: pool grown, k=32 compiled
+    census = impl.cache_sizes()
+    plane = obs_device.plane_snapshot()
+    # churn the active set: localized soup, then empty, then full
+    world = t.put(np.zeros((h, w), np.uint8))
+    world, _ = t.step_n(world, 32)
+    b2 = np.zeros((h, w), np.uint8)
+    b2[5:8, 5:8] = 255
+    world = t.put(b2)
+    world, _ = t.step_n(world, 64)
+    world = t.put(_soup(5, h, w, 0.3))
+    world, _ = t.step_n(world, 96)
+    assert impl.cache_sizes() == census
+    after = obs_device.plane_snapshot()
+    assert after["compiles_total"] == plane["compiles_total"], (
+        "a warm tile pool recompiled: "
+        f"{plane['compiles']} -> {after['compiles']}"
+    )
+
+
+def test_per_tile_labels_bounded_under_churn():
+    """Per-tile children ride ONE TopKGauge registry entry: tile churn
+    moves the registry not at all, and the exposition stays O(cap)
+    (the PR 12 bounded-cardinality discipline)."""
+    h = w = 512
+    t = make_stepper(threads=1, height=h, width=w, tile=32)  # 256 tiles
+    n_before = len(obs.registry().metrics())
+    world = t.put(_soup(6, h, w, 0.3))
+    world, _ = t.step_n(world, 32)  # every tile active: 256 children
+    assert len(obs.registry().metrics()) == n_before
+    lines = [ln for ln in obs.registry().prometheus_text().splitlines()
+             if ln.startswith("gol_tpu_engine_tile_active_chunks")]
+    cap = tiled_mod._METRICS.per_tile.cap
+    assert len(lines) <= cap + 2
+    # empty board: the active set collapses and the children leave
+    world = t.put(np.zeros((h, w), np.uint8))
+    world, _ = t.step_n(world, 32)
+    assert tiled_mod._METRICS.per_tile.child_count() == 0
+    assert len(obs.registry().metrics()) == n_before
+
+
+def test_engine_runs_tiled_backend(tmp_path):
+    """Engine-level integration: Params(tile=...) steps bit-exactly,
+    the whole-board cycle machinery stands down (the tiled handle is
+    mutated in place — an anchor would alias it), and snapshots
+    write."""
+    from gol_tpu.engine.distributor import Engine
+    from gol_tpu.params import Params
+
+    h = w = 128
+    board = _soup(7, h, w, 0.25)
+    p = Params(turns=100, threads=1, image_width=w, image_height=h,
+               chunk=0, out_dir=str(tmp_path), cycle_detect=True,
+               tile=64)
+    eng = Engine(p, emit_flips=False, initial_world=board)
+    assert eng._cycles is None and eng._ride_cycles is None
+    eng.run()
+    assert eng.error is None
+    want, _ = _oracle(board, 100)
+    assert np.array_equal(eng.stepper.fetch(eng._committed[1]), want)
+
+
+def test_factory_validation():
+    from gol_tpu.params import Params
+
+    assert tileable(128, 128, 64)
+    assert not tileable(128, 128, 48)   # not a multiple of 32
+    assert not tileable(130, 128, 64)   # does not divide height
+    assert not tileable(128, 128, 32, halo_words=2)  # cone > tile
+    with pytest.raises(ValueError, match="tile"):
+        tiled_stepper("B3/S23", 128, 128, 48)
+    with pytest.raises(ValueError, match="two-state"):
+        tiled_stepper("B2/S/C4", 128, 128, 64)
+    with pytest.raises(ValueError, match="B0|births"):
+        TiledStepper("B0123478/S01234678", 128, 128, 64)
+    with pytest.raises(ValueError):
+        Params(turns=1, image_width=64, image_height=64, tile=33)
+
+
+def test_fits_resident_tiles_matches_paging_policy(monkeypatch):
+    from gol_tpu.obs import device as obs_device
+
+    budget = 512 * 1024 * 1024
+    monkeypatch.setenv("GOL_TPU_DEVICE_BUDGET_BYTES", str(budget))
+    ext = obs_device.tile_ext_bytes(1024, 1)
+    assert ext == (1024 // 32 + 2) * (1024 + 64) * 4
+    cap = obs_device.max_resident_tiles(1024, 1)
+    assert cap == budget // (ext * 3)
+    # The capacity answer charges the SAME per-slot constant.
+    base = obs_device.fits(8192, 8192, sessions=1)
+    with_tiles = obs_device.fits(8192, 8192, sessions=1,
+                                 resident_tiles=cap, tile=1024)
+    assert (with_tiles["resident_tile_bytes"]
+            == cap * ext * 3)
+    assert (base["working_set_bytes"] + cap * ext * 3
+            == with_tiles["working_set_bytes"])
+    assert with_tiles["max_sessions"] <= base["max_sessions"]
+    with pytest.raises(ValueError, match="tile"):
+        obs_device.fits(512, 512, resident_tiles=4)
+    # The tiled factory follows the same bound.
+    t = TiledStepper("B3/S23", 2048, 2048, 1024)
+    assert t.max_resident == min(cap, 4)
