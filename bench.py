@@ -1184,6 +1184,233 @@ def measure_fanout(observers=(1, 50, 500), settle_turns: int = 10_000,
     return out
 
 
+def _dispatch_totals() -> float:
+    """Sum of every engine/session/stepper dispatch counter on the
+    process registry — the replay lane's zero-dispatch gate reads its
+    delta (the same series scripts/replay_smoke.sh asserts on
+    /metrics)."""
+    from gol_tpu import obs
+
+    families = ("gol_tpu_engine_dispatches_total",
+                "gol_tpu_session_dispatches_total",
+                "gol_tpu_stepper_dispatches_total")
+    return sum(v["value"] for k, v in obs.registry().snapshot().items()
+               if k.startswith(families))
+
+
+def measure_replay(observers=(1, 10, 100), record_turns: int = 16384,
+                   settle_turns: int = 10_000,
+                   measure_secs: float = 4.0) -> dict:
+    """Replay-plane lane (ISSUE 14; gol_tpu.replay): a recorded 512²
+    run served to 1/10/100 observers vs a LIVE engine serving the same
+    settled board to the same counts.
+
+    Per replay point: delivered turns/s (whole recording to every
+    observer, flat out), bytes per observer-turn (the replay server's
+    forwarded-bytes counter), and `engine_dispatch_delta` — the sum of
+    every engine/session/stepper dispatch counter across the serving
+    window, which MUST be 0 (bench_compare gates `dispatch_delta`
+    off-zero as an infinite regression: a replay tier that starts
+    dispatching device work has lost its whole point). The live points
+    capture the A/B: an engine recomputing the same turns for N
+    watchers."""
+    import selectors as _selectors
+    import socket as _socket
+    import tempfile
+
+    import jax
+
+    from gol_tpu.distributed import EngineServer
+    from gol_tpu.distributed import wire as _wire
+    from gol_tpu.params import Params
+    from gol_tpu.parallel.stepper import make_stepper
+    from gol_tpu.replay.log import (
+        SegmentLog,
+        last_turn,
+        replay_dir,
+        scan_segments,
+    )
+    from gol_tpu.replay.recorder import RecorderSink
+    from gol_tpu.replay.server import ReplayServer
+    from gol_tpu.replay.server import _METRICS as _RPL
+    from gol_tpu.sessions.manager import SessionManager
+    from gol_tpu.checkpoint import session_checkpoint_dir
+
+    st = make_stepper(threads=1, height=H, width=W,
+                      devices=[jax.devices()[0]])
+    q0, c = st.step_n(st.put(_world(W)), settle_turns)
+    int(c)
+    settled = st.fetch(q0)
+
+    # --- record once: the settled 512² run, taped from an inline
+    # manager (chunked like a watched server would dispatch it) ---
+    tmp = tempfile.mkdtemp(prefix="gol-replay-bench-")
+    m = SessionManager(out_dir=tmp, bucket_capacity=1)
+    m.create("r", width=W, height=H, board=settled,
+             start_turn=settle_turns)
+    d = replay_dir(os.path.join(session_checkpoint_dir(tmp), "r"))
+    rec = RecorderSink(m, "r", W, H, SegmentLog(d, keyframe_turns=256))
+    m.attach("r", rec)
+    t0 = time.perf_counter()
+    m.pump(record_turns, chunk=256)
+    record_wall = time.perf_counter() - t0
+    m.detach("r", rec)
+    rec.on_close("r", "done")
+    rec_last = last_turn(d)
+    rec_bytes = sum(os.path.getsize(p) for _, p in scan_segments(d))
+
+    def _drain(sel):
+        for key, _ in sel.select(0.05):
+            try:
+                while key.fileobj.recv(1 << 16):
+                    pass
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError:
+                with contextlib.suppress(Exception):
+                    sel.unregister(key.fileobj)
+
+    def replay_point(n_obs: int) -> dict:
+        disp0 = _dispatch_totals()
+        # pump_paused: the WHOLE fleet attaches before the flat-out
+        # run starts, so every observer receives the full broadcast
+        # (the number measured is serve-to-N, not serve-to-whoever-
+        # attached-before-the-blast-finished).
+        srv = ReplayServer(d, port=0, replay_rate=0,
+                           heartbeat_secs=0, pump_paused=True).start()
+        sel = _selectors.DefaultSelector()
+        socks = []
+        b0, f0 = _RPL.bytes.value, _RPL.frames.value
+        # Bound BEFORE the try: the finally below reads both, and an
+        # attach failure must surface as itself, not UnboundLocalError.
+        wall = None
+        t0 = time.perf_counter()
+        try:
+            for _ in range(n_obs):
+                s = _socket.create_connection(srv.address, timeout=30)
+                s.settimeout(30)
+                _wire.send_msg(s, {"t": "hello", "want_flips": True,
+                                   "binary": True, "role": "observe",
+                                   "batch": 1024})
+                s.setblocking(False)
+                sel.register(s, _selectors.EVENT_READ)
+                socks.append(s)
+            rec_state = next(iter(srv._recordings.values()))
+            grace = time.time() + 60
+            while time.time() < grace:
+                with rec_state.lock:
+                    if len(rec_state.conns) >= n_obs:
+                        break
+                _drain(sel)
+            t0 = time.perf_counter()
+            srv.release_pumps()
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                _drain(sel)
+                with rec_state.lock:
+                    done = (rec_state.finished
+                            and all(c.queued() == 0
+                                    for c in rec_state.conns))
+                if done:
+                    # Stamp the wall HERE: the tail drains below are
+                    # client-side cleanup, not serving time.
+                    wall = time.perf_counter() - t0
+                    break
+            # Let the last enqueued frames actually reach the sockets.
+            for _ in range(5):
+                _drain(sel)
+        finally:
+            if wall is None:
+                wall = time.perf_counter() - t0
+            for s in socks:
+                with contextlib.suppress(OSError):
+                    s.close()
+            srv.shutdown()
+        turns = rec_last - settle_turns
+        sent = _RPL.bytes.value - b0
+        disp = _dispatch_totals() - disp0
+        return {
+            "observers": n_obs,
+            # Per-observer delivered rate. At 100 observers the bench
+            # CLIENT (one selector thread draining every socket) is
+            # the bound, not the server — the aggregate line is the
+            # serving-plane number.
+            "turns_per_sec": round(turns / wall, 1),
+            "aggregate_observer_turns_per_sec": round(
+                turns * n_obs / wall, 1
+            ),
+            "bytes_per_observer_turn": round(
+                sent / max(turns, 1) / n_obs, 3
+            ),
+            "frames_forwarded": int(_RPL.frames.value - f0),
+            "engine_dispatch_delta": disp,
+        }
+
+    def live_point(n_obs: int) -> dict:
+        p = Params(turns=10**9, threads=1, image_width=W,
+                   image_height=H, chunk=0, tick_seconds=60.0,
+                   image_dir="images", out_dir=tmp, cycle_detect=True)
+        server = EngineServer(p, port=0, initial_world=settled,
+                              heartbeat_secs=0).start()
+        sel = _selectors.DefaultSelector()
+        socks = []
+        disp0 = _dispatch_totals()
+        try:
+            for _ in range(n_obs):
+                s = _socket.create_connection(server.address,
+                                              timeout=30)
+                s.settimeout(30)
+                _wire.send_msg(s, {"t": "hello", "want_flips": True,
+                                   "binary": True, "role": "observe",
+                                   "batch": 1024})
+                s.setblocking(False)
+                sel.register(s, _selectors.EVENT_READ)
+                socks.append(s)
+            mark = server.engine.completed_turns
+            grace = time.time() + 60
+            while (server.engine.completed_turns < mark + 500
+                   and time.time() < grace):
+                _drain(sel)
+            t0 = server.engine.completed_turns
+            stop = time.time() + measure_secs
+            while time.time() < stop:
+                _drain(sel)
+            turns = server.engine.completed_turns - t0
+        finally:
+            for s in socks:
+                with contextlib.suppress(OSError):
+                    s.close()
+            server.shutdown()
+        return {
+            "observers": n_obs,
+            "turns_per_sec": round(turns / measure_secs, 1),
+            # Informational (NOT the gated `dispatch_delta` spelling):
+            # the live engine dispatching is the whole point of the A/B.
+            "engine_dispatches": _dispatch_totals() - disp0,
+        }
+
+    out = {
+        "board": f"{W}x{H} settled (turn {settle_turns}+)",
+        "recording": {
+            "turns": record_turns,
+            "keyframe_turns": 256,
+            "log_bytes": rec_bytes,
+            "bytes_per_turn": round(rec_bytes / record_turns, 2),
+            "record_wall_s": round(record_wall, 3),
+        },
+    }
+    for n in observers:
+        out[f"replay_{n}"] = replay_point(n)
+        out[f"live_{n}"] = live_point(n)
+    big = max(observers)
+    r, lv = out.get(f"replay_{big}", {}), out.get(f"live_{big}", {})
+    if r.get("turns_per_sec") and lv.get("turns_per_sec"):
+        out["replay_vs_live_turns_ratio"] = round(
+            r["turns_per_sec"] / lv["turns_per_sec"], 2
+        )
+    return out
+
+
 def _lane(fn, *a, **kw):
     """Run one bench lane with the device plane bracketed: a dict lane
     result gains {"device_plane": {compiles, compile_seconds, split,
@@ -1458,6 +1685,13 @@ def main() -> None:
         detail["activity_32768_soup"] = _lane(measure_activity)
     except Exception as e:
         detail["activity_32768_soup"] = {"error": repr(e)}
+    # Replay plane (ISSUE 14): a recorded 512² run served to 1/10/100
+    # observers vs a live engine — replay-side dispatch_delta gated at
+    # zero by bench_compare.
+    try:
+        detail["replay_512x512"] = _lane(measure_replay)
+    except Exception as e:
+        detail["replay_512x512"] = {"error": repr(e)}
     detail["first_alive_report_s"] = first_report
     # The pallas-packed vs XLA-packed-fori_loop ratio the README quotes.
     try:

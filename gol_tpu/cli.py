@@ -153,6 +153,41 @@ def build_parser() -> argparse.ArgumentParser:
                          "the next attach; 0 parks at the first idle "
                          "sweep (default: never park; see "
                          "docs/SESSIONS.md 'Hibernation')")
+    ap.add_argument("--record", action="store_true",
+                    help="with --serve --sessions: tape every "
+                         "session's encoded wire stream (FBATCH "
+                         "frames + periodic BoardSync keyframes, "
+                         "verbatim bytes) into an append-only segment "
+                         "log under out/sessions/<id>/replay/ — the "
+                         "seekable recording the seek verb and "
+                         "--replay serve from (docs/REPLAY.md)")
+    ap.add_argument("--keyframe-turns", type=int, default=None,
+                    dest="keyframe_turns", metavar="N",
+                    help="with --record: turns between BoardSync "
+                         "keyframes = seek granularity and per-attach "
+                         "catch-up cost (default 256)")
+    ap.add_argument("--record-max-bytes", type=int, default=None,
+                    dest="record_max_bytes", metavar="BYTES",
+                    help="with --record: per-session recording size "
+                         "bound — oldest segments are evicted past it "
+                         "(default: unbounded)")
+    ap.add_argument("--replay", default=None, metavar="LOG-DIR",
+                    dest="replay",
+                    help="run as a STATIC REPLAY SERVER "
+                         "(gol_tpu.replay): serve the recordings "
+                         "under LOG-DIR (a --record run's "
+                         "out/sessions tree, one session's dir, or a "
+                         "bare replay/ dir) on --serve [HOST:]PORT to "
+                         "any number of observers with ZERO engine "
+                         "dispatches — recorded bytes forwarded "
+                         "verbatim, paced by the recorded timestamps "
+                         "or --replay-rate; composes under --relay "
+                         "trees (docs/REPLAY.md)")
+    ap.add_argument("--replay-rate", type=float, default=None,
+                    dest="replay_rate", metavar="TURNS/S",
+                    help="with --replay: playback pacing in turns/s "
+                         "(0 = as fast as the observers drain; "
+                         "default: the recorded wall-clock timing)")
     ap.add_argument("--relay", default=None, metavar="HOST:PORT",
                     help="run as a RELAY NODE (gol_tpu.relay): attach "
                          "to the upstream server/relay at HOST:PORT as "
@@ -342,7 +377,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     from gol_tpu.obs import device, flight, tracing
 
     tracing.set_process_label(
-        "serve" if args.serve is not None
+        "replay" if args.replay is not None
+        else "serve" if args.serve is not None
         else "connect" if args.connect is not None else "local"
     )
     flight.configure(args.out)
@@ -430,6 +466,46 @@ def main(argv: Optional[list[str]] = None) -> int:
             "error: --park-idle-secs applies to --serve --sessions "
             "(hibernation is a session-plane policy)"
         )
+    if args.record and not args.sessions:
+        raise SystemExit(
+            "error: --record applies to --serve --sessions (the "
+            "replay log is a session-plane recording; docs/REPLAY.md)"
+        )
+    if not args.record and (args.keyframe_turns is not None
+                            or args.record_max_bytes is not None):
+        # A silently ignored recording knob would leave an operator
+        # believing a cadence/bound is in force.
+        raise SystemExit(
+            "error: --keyframe-turns/--record-max-bytes require "
+            "--record"
+        )
+    if args.replay_rate is not None and args.replay is None:
+        raise SystemExit("error: --replay-rate requires --replay")
+    if args.replay is not None:
+        if args.sessions or args.relay is not None \
+                or args.connect is not None:
+            raise SystemExit(
+                "error: --replay is its own serving mode — it cannot "
+                "combine with --sessions/--relay/--connect"
+            )
+        if args.tile:
+            # Same reasoning as the --tile guard below: a replay
+            # server owns no board to tile.
+            raise SystemExit(
+                "error: --tile applies to single-board engines, not "
+                "a replay server"
+            )
+        if args.serve is None:
+            raise SystemExit(
+                "error: --replay needs --serve [HOST:]PORT for its "
+                "listener"
+            )
+        if resume_path is not None:
+            raise SystemExit(
+                "error: --resume applies to an engine, not a replay "
+                "server"
+            )
+        return _replay_serve(args)
     if args.tile and (args.sessions or args.relay is not None):
         # Buckets step dense stacks and relays own no board: a
         # silently ignored --tile would leave an operator believing a
@@ -658,7 +734,12 @@ def _serve_sessions(args, params: Params, resume: bool) -> int:
                                         if args.batch_turns is not None
                                         else 1024),
                            writer_pool_threads=args.writer_pool_threads,
-                           park_idle_secs=args.park_idle_secs)
+                           park_idle_secs=args.park_idle_secs,
+                           record=args.record,
+                           keyframe_turns=(args.keyframe_turns
+                                           if args.keyframe_turns
+                                           is not None else 256),
+                           record_max_bytes=args.record_max_bytes)
     print(f"session engine serving on "
           f"{server.address[0]}:{server.address[1]}")
     if resume:
@@ -686,6 +767,49 @@ def _serve_sessions(args, params: Params, resume: bool) -> int:
         print(f"session engine error: {server.engine.error!r}",
               file=sys.stderr)
         return 1
+    return 0
+
+
+def _replay_serve(args) -> int:
+    """Static replay server (gol_tpu.replay; docs/REPLAY.md): serve
+    the recordings under --replay LOG-DIR with zero engine dispatches.
+    Same exposure rules as --serve: loopback unless an explicit HOST,
+    --secret authenticates every attach."""
+    from gol_tpu.replay import ReplayServer
+
+    host, port = _addr(args.serve, default_host="127.0.0.1")
+    try:
+        server = ReplayServer(
+            args.replay, host, port,
+            secret=args.secret,
+            replay_rate=args.replay_rate,
+            heartbeat_secs=args.hb_secs,
+            evict_secs=args.evict_secs,
+            max_peers=args.max_peers,
+            high_water=args.high_water,
+            drain_secs=args.drain_secs,
+            batch_turns=(args.batch_turns
+                         if args.batch_turns is not None else 1024),
+            writer_pool_threads=args.writer_pool_threads,
+        )
+    except ValueError as e:
+        raise SystemExit(f"error: {e}") from None
+    n = len(server._recordings)
+    print(f"replay serving on {server.address[0]}:{server.address[1]} "
+          f"({n} recording{'s' if n != 1 else ''} from {args.replay})")
+    metrics = _start_metrics(args, health=server.health)
+    from gol_tpu.obs import flight as _flight
+
+    _flight.set_state_provider(server.health)
+    server.start()
+    try:
+        while not server.wait(timeout=1.0):
+            pass
+    except KeyboardInterrupt:
+        server.shutdown()
+    finally:
+        if metrics is not None:
+            metrics.close()
     return 0
 
 

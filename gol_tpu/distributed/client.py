@@ -286,6 +286,14 @@ class Controller:
         if secret is not None:
             hello["secret"] = secret
         self._hello = hello
+        #: Seek verb state (gol_tpu.replay, docs/REPLAY.md): the last
+        #: `seek-r` reply and its arrival event — one outstanding seek
+        #: at a time (the verb is a user-interaction, not a stream).
+        self._seek_reply: Optional[dict] = None
+        self._seek_done = threading.Event()
+        self._seek_lock = threading.Lock()
+        self._rid_n = 0
+        self._rid_prefix = uuid.uuid4().hex[:12]
         self._sock, first = self._dial()
         self._arm_read_deadline()
         self._reader = threading.Thread(
@@ -377,6 +385,37 @@ class Controller:
             )
         with self._send_lock:
             wire.send_msg(self._sock, {"t": "key", "key": key})
+
+    def seek(self, turn, timeout: float = 30.0,
+             rid: "str | None" = None) -> dict:
+        """Time-travel (gol_tpu.replay, docs/REPLAY.md): ask a
+        recording-backed server to rewind this stream to `turn` (an
+        int, or the literal "live" to rejoin the present). The server
+        answers with the nearest <= turn keyframe's BoardSync plus the
+        recorded FBATCH suffix — both ride the ORDINARY apply path, so
+        `self.board` simply becomes the historical raster — followed
+        by the `seek-r` completion reply this method returns (ok +
+        landed turn, or ok=False with a reason). The verb is
+        idempotent under rid replay; pass `rid` to retry a specific
+        attempt. Raises TimeoutError when no reply arrives in time."""
+        if rid is None:
+            self._rid_n += 1
+            rid = f"{self._rid_prefix}-seek-{self._rid_n}"
+        with self._seek_lock:
+            self._seek_reply = None
+            self._seek_done.clear()
+            with self._send_lock:
+                wire.send_msg(self._sock,
+                              {"t": "seek", "turn": turn, "rid": rid})
+            deadline = time.monotonic() + timeout
+            while not self._seek_done.wait(0.05):
+                if self.lost.is_set() or self.events.closed \
+                        or time.monotonic() > deadline:
+                    break
+            reply = self._seek_reply
+        if reply is None:
+            raise TimeoutError("no seek-r reply from the server")
+        return reply
 
     def wait_sync(self, timeout: float = 60.0) -> bool:
         """Block until the attach-time board sync has been applied.
@@ -655,6 +694,12 @@ class Controller:
         if t == "ev":
             for ev in wire.msg_to_events(msg):
                 self.events.put(ev)
+            return True
+        if t == "seek-r":
+            # Completion marker of a seek (the frames preceded it in
+            # stream order, already applied above).
+            self._seek_reply = msg
+            self._seek_done.set()
             return True
         if t == "detached":
             self.detached.set()

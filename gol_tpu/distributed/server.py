@@ -382,6 +382,16 @@ class _Conn:
         #: A coalescing BoardSync has been requested/enqueued for this
         #: peer and has not arrived yet — don't request another.
         self.resync_pending = False
+        #: Replay-plane scrub state (gol_tpu.replay, docs/REPLAY.md):
+        #: a peer parked at a seek position. While set, the live /
+        #: broadcast stream is withheld (frames past the seeked board
+        #: would XOR garbage onto it); {"t":"seek","turn":"live"}
+        #: resyncs and clears it. `seek_gate` orders the toggle + the
+        #: served historical frames against concurrent stream sends
+        #: (RLock: the drain-recovery path resyncs from inside a gated
+        #: callback).
+        self.scrub = False
+        self.seek_gate = threading.RLock()
         #: Per-peer lag gauge (label evicted at detach) — installed by
         #: the server once the peer is attached.
         self.lag_metric = None
@@ -1634,99 +1644,120 @@ class _SessionSink:
         """One dispatched chunk for this session as _TAG_FBATCH
         frame(s) — the per-session twin of the singleton broadcaster's
         chunk fan-out: per-chunk housekeeping, shedding at batch
-        granularity, encode gated after offer_stream."""
+        granularity, encode gated after offer_stream. Stream sends run
+        under the peer's seek_gate: a peer parked at a seek position
+        (conn.scrub — gol_tpu.replay) is withheld the live stream, and
+        the gate orders that decision against a concurrent seek's
+        historical frames."""
         conn = self._conn
         if conn.lag_metric is not None:
             conn.lag_metric.set(conn.queued())
-        if conn.drained():
-            conn.resync_pending = True
-            mgr = self._server.manager
-            self.on_sync(sid, mgr.peek_turn(sid), mgr._fetch_board(sid))
-            return
-        k = len(counts)
-        last = first_turn + k - 1
-        if not conn.synced or last <= conn.synced_turn:
-            return
-        try:
-            if not conn.offer_stream():
+        with conn.seek_gate:
+            if conn.scrub:
                 return
-            tracing.event("turn.emit", "wire", turn=last, session=sid,
-                          batch=k)
-            with tracing.span("wire.encode_batch", "wire", turn=last,
-                              session=sid, turns=k):
-                frames = encode_batch_frames(
-                    counts, bitmaps, words, first_turn,
-                    self._width, self._height, conn.batch, time.time(),
-                )
-            for f in frames:
-                conn.send_raw(f)
-        except (wire.WireError, OSError):
-            self._server._drop_conn(conn, detach_sink=False)
-            raise
+            if conn.drained():
+                conn.resync_pending = True
+                mgr = self._server.manager
+                self.on_sync(sid, mgr.peek_turn(sid),
+                             mgr._fetch_board(sid))
+                return
+            k = len(counts)
+            last = first_turn + k - 1
+            if not conn.synced or last <= conn.synced_turn:
+                return
+            try:
+                if not conn.offer_stream():
+                    return
+                tracing.event("turn.emit", "wire", turn=last,
+                              session=sid, batch=k)
+                with tracing.span("wire.encode_batch", "wire", turn=last,
+                                  session=sid, turns=k):
+                    frames = encode_batch_frames(
+                        counts, bitmaps, words, first_turn,
+                        self._width, self._height, conn.batch,
+                        time.time(),
+                    )
+                for f in frames:
+                    conn.send_raw(f)
+            except (wire.WireError, OSError):
+                self._server._drop_conn(conn, detach_sink=False)
+                raise
 
     def on_sync(self, sid: str, turn: int, board) -> None:
         conn = self._conn
-        try:
-            if conn.binary:
-                conn.send_raw(wire.board_to_frame(turn, board, conn.token))
-            else:
-                conn.send(wire.board_to_msg(turn, board, conn.token))
-        except (wire.WireError, OSError):
-            self._server._drop_conn(conn, detach_sink=False)
-            raise
-        conn.synced = True
-        conn.synced_turn = turn
-        conn.delta_prev = None
-        # A degradation-coalesced resync makes the peer whole: every
-        # frame it shed is inside this raster, and synced_turn now
-        # gates anything still buffered.
-        conn.mark_recovered()
+        with conn.seek_gate:
+            if conn.scrub:
+                return  # parked at a seek: no live resyncs either
+            try:
+                if conn.binary:
+                    conn.send_raw(
+                        wire.board_to_frame(turn, board, conn.token)
+                    )
+                else:
+                    conn.send(wire.board_to_msg(turn, board, conn.token))
+            except (wire.WireError, OSError):
+                self._server._drop_conn(conn, detach_sink=False)
+                raise
+            conn.synced = True
+            conn.synced_turn = turn
+            conn.delta_prev = None
+            # A degradation-coalesced resync makes the peer whole:
+            # every frame it shed is inside this raster, and
+            # synced_turn now gates anything still buffered.
+            conn.mark_recovered()
 
     def on_flips(self, sid: str, turn: int, coords) -> None:
         conn = self._conn
-        if not conn.synced or turn <= conn.synced_turn:
-            return
-        try:
-            # Sheddable stream plane: gate BEFORE encoding so a shed
-            # frame never advances this peer's delta chain.
-            if not conn.offer_stream():
+        with conn.seek_gate:
+            if conn.scrub:
                 return
-            with tracing.span("wire.encode_flips", "wire", turn=turn,
-                              session=sid):
-                _encode_and_send_flips(conn, turn, coords, None,
-                                       self._width, self._height)
-        except (wire.WireError, OSError):
-            self._server._drop_conn(conn, detach_sink=False)
-            raise
+            if not conn.synced or turn <= conn.synced_turn:
+                return
+            try:
+                # Sheddable stream plane: gate BEFORE encoding so a
+                # shed frame never advances this peer's delta chain.
+                if not conn.offer_stream():
+                    return
+                with tracing.span("wire.encode_flips", "wire", turn=turn,
+                                  session=sid):
+                    _encode_and_send_flips(conn, turn, coords, None,
+                                           self._width, self._height)
+            except (wire.WireError, OSError):
+                self._server._drop_conn(conn, detach_sink=False)
+                raise
 
     def on_turn(self, sid: str, turn: int) -> None:
         conn = self._conn
         if conn.lag_metric is not None:
             conn.lag_metric.set(conn.queued())
-        if conn.drained():
-            # Degraded peer drained inside the deadline: coalesce the
-            # missed backlog into ONE fresh BoardSync. We are on the
-            # engine thread (the device owner), after this chunk's
-            # commit — the stack and `peek_turn` agree, and stamping
-            # the sync with the POST-chunk turn gates off the rest of
-            # this chunk's already-decoded callbacks (they are inside
-            # the raster being sent; re-applying would XOR-corrupt).
-            conn.resync_pending = True
-            mgr = self._server.manager
-            self.on_sync(sid, mgr.peek_turn(sid),
-                         mgr._fetch_board(sid))
-            return
-        if not conn.synced or turn <= conn.synced_turn:
-            return
-        try:
-            if not conn.offer_stream():
+        with conn.seek_gate:
+            if conn.scrub:
                 return
-            tracing.event("turn.emit", "wire", turn=turn, session=sid)
-            conn.send({"t": "ev", "k": "turn", "turn": turn,
-                       "ts": time.time()})
-        except (wire.WireError, OSError):
-            self._server._drop_conn(conn, detach_sink=False)
-            raise
+            if conn.drained():
+                # Degraded peer drained inside the deadline: coalesce
+                # the missed backlog into ONE fresh BoardSync. We are
+                # on the engine thread (the device owner), after this
+                # chunk's commit — the stack and `peek_turn` agree,
+                # and stamping the sync with the POST-chunk turn gates
+                # off the rest of this chunk's already-decoded
+                # callbacks (they are inside the raster being sent;
+                # re-applying would XOR-corrupt).
+                conn.resync_pending = True
+                mgr = self._server.manager
+                self.on_sync(sid, mgr.peek_turn(sid),
+                             mgr._fetch_board(sid))
+                return
+            if not conn.synced or turn <= conn.synced_turn:
+                return
+            try:
+                if not conn.offer_stream():
+                    return
+                tracing.event("turn.emit", "wire", turn=turn, session=sid)
+                conn.send({"t": "ev", "k": "turn", "turn": turn,
+                           "ts": time.time()})
+            except (wire.WireError, OSError):
+                self._server._drop_conn(conn, detach_sink=False)
+                raise
 
     def on_close(self, sid: str, reason: str) -> None:
         conn = self._conn
@@ -1738,6 +1769,33 @@ class _SessionSink:
         # client's reconnect storm against a session that is gone.
         conn.finish(timeout=2.0)
         self._server._drop_conn(conn, detach_sink=False)
+
+
+class _SeekTarget:
+    """Session-plane adapter for gol_tpu.replay.serve_seek: the
+    recording's log dir, the peer's own seek_gate as the ordering
+    lock (historical frames vs the live sink's sends), and the
+    engine-thread live rejoin."""
+
+    def __init__(self, server: "SessionServer", sid: str,
+                 sink: _SessionSink, conn: _Conn, root: str):
+        self._server = server
+        self.sid = sid
+        self._sink = sink
+        self._conn = conn
+        self.root = root
+        self.lock = conn.seek_gate
+
+    def resync_live(self, conn: _Conn) -> None:
+        def _prepare():
+            with conn.seek_gate:
+                conn.scrub = False
+
+        # Engine-thread verb: scrub clears and the fresh BoardSync
+        # lands between dispatches, so the next chunk is contiguous
+        # with the synced raster.
+        self._server.manager.resync(self.sid, self._sink,
+                                    prepare=_prepare)
 
 
 class SessionServer:
@@ -1783,6 +1841,9 @@ class SessionServer:
         batch_turns: int = 1024,
         writer_pool_threads: int = 2,
         park_idle_secs: Optional[float] = None,
+        record: bool = False,
+        keyframe_turns: int = 256,
+        record_max_bytes: Optional[int] = None,
     ):
         from gol_tpu.sessions import SessionEngine, SessionManager
 
@@ -1822,6 +1883,32 @@ class SessionServer:
         #: never double-creates, a retried destroy never errors.
         self._replay: "dict[str, dict]" = {}  # insertion-ordered FIFO
         self._replay_lock = threading.Lock()
+        #: Replay-plane recording (gol_tpu.replay, docs/REPLAY.md):
+        #: with `record`, every live session gets an ephemeral
+        #: RecorderSink taping its encoded wire stream into
+        #: out/sessions/<sid>/replay/, and the `seek` verb serves
+        #: time-travel from those logs.
+        self.record = bool(record)
+        self.keyframe_turns = max(1, int(keyframe_turns))
+        self.record_max_bytes = record_max_bytes
+        self._recorders: "dict[str, object]" = {}
+        self._recorder_lock = threading.Lock()
+        if self.record:
+            # Recording state rides the session.json sidecar (the
+            # PR 7 crash-consistency story covers it), and the
+            # recorder factory makes EVERY create — wire verb, resume,
+            # rehydration — tape from its first turn (a resumed
+            # session's fresh keyframe also CUTS any stale future
+            # segments a dead incarnation recorded past its last
+            # checkpoint: SegmentLog.start_segment). Import the plane
+            # now so the first create doesn't pay module-import
+            # latency inside an engine verb.
+            import gol_tpu.replay.recorder  # noqa: F401
+
+            self.manager.record_meta = {
+                "keyframe_turns": self.keyframe_turns,
+            }
+            self.manager.recorder_factory = self._make_recorder
         #: Sessions restored from out/sessions/ at boot (PR 3's
         #: `--resume latest`, composed per session).
         self.resumed = self.manager.resume_all() if resume else 0
@@ -2031,6 +2118,23 @@ class SessionServer:
             geom = self.manager.peek_geometry(sid) or (0, 0)
             sink = _SessionSink(self, conn, sid, geom[0] or 0,
                                 geom[1] or 0)
+            # Register the sink BEFORE the (possibly slow) attach: a
+            # peer that sends a seek verb the instant its board sync
+            # lands must find its session mapping, not race the
+            # registration into a spurious "not-recorded". Every
+            # failure path below goes through _drop_conn, which pops
+            # the entry (and detaches the sink OUTSIDE _conn_lock —
+            # manager.detach blocks on the engine verb queue, and the
+            # engine thread may simultaneously be tearing a sink down
+            # through on_close -> _drop_conn, which needs _conn_lock:
+            # holding it across the verb deadlocks the serving plane,
+            # seen live as a ~60s stall).
+            with self._conn_lock:
+                gone = conn not in self._conns
+                if not gone:
+                    self._sinks[conn] = (sid, sink)
+            if gone:  # reader dropped the peer before we got here
+                return
             try:
                 # A parked session rehydrates inside attach — the
                 # board sync below then carries the revived state
@@ -2056,21 +2160,82 @@ class SessionServer:
                     conn.send(err)
                 self._drop_conn(conn)
                 return
+            undo = False
             with self._conn_lock:
-                undo = conn not in self._conns
-                if not undo:
-                    self._sinks[conn] = (sid, sink)
+                if conn not in self._conns:
+                    # The reader dropped the peer ('q', death) while we
+                    # were attaching; _drop_conn already popped _sinks
+                    # — undo the manager-side attach it could not have
+                    # seen yet.
+                    undo = True
             if undo:
-                # The reader dropped the peer ('q', death) while we
-                # were attaching: undo the sink registration — OUTSIDE
-                # _conn_lock. manager.detach blocks on the engine verb
-                # queue, and the engine thread may simultaneously be
-                # tearing a sink down through on_close -> _drop_conn,
-                # which needs _conn_lock: holding it across the verb
-                # deadlocks the whole serving plane (seen live as a
-                # ~60s stall until the verb deadline expired).
                 with contextlib.suppress(Exception):
                     self.manager.detach(sid, sink)
+
+    # --- replay-plane recording + seek (gol_tpu.replay) ---
+
+    def _make_recorder(self, sid: str, width: int, height: int):
+        """The manager's recorder factory (called from inside _create,
+        on the owner thread): one RecorderSink per live session,
+        taping into out/sessions/<sid>/replay/. Returns None when the
+        session already has one (re-entrant resume paths)."""
+        import os
+
+        from gol_tpu.checkpoint import session_checkpoint_dir
+        from gol_tpu.replay.log import SegmentLog, replay_dir
+        from gol_tpu.replay.recorder import RecorderSink
+
+        with self._recorder_lock:
+            if sid in self._recorders:
+                return None
+            d = replay_dir(os.path.join(
+                session_checkpoint_dir(self.manager.out_dir), sid
+            ))
+            try:
+                rec = RecorderSink(
+                    self.manager, sid, width, height,
+                    SegmentLog(d, keyframe_turns=self.keyframe_turns,
+                               max_bytes=self.record_max_bytes),
+                    on_closed=self._recorder_closed,
+                )
+            except OSError:
+                log.exception("recorder for session %r failed to open",
+                              sid)
+                return None
+            self._recorders[sid] = rec
+        return rec
+
+    def _recorder_closed(self, sid: str, reason: str) -> None:
+        with self._recorder_lock:
+            self._recorders.pop(sid, None)
+
+    def _handle_seek(self, conn: _Conn, msg: dict) -> None:
+        """One `{"t":"seek"}` verb on the session plane: time-travel
+        served from the session's recording under the idempotent-rid
+        rules (gol_tpu.replay.serve_seek — the shared implementation;
+        the reply is sent AFTER the frames, as the completion
+        marker)."""
+        from gol_tpu.replay.server import serve_seek
+
+        with self._conn_lock:
+            entry = self._sinks.get(conn)
+        target = None
+        if entry is not None:
+            sid, sink = entry
+            with self._recorder_lock:
+                rec = self._recorders.get(sid)
+            if rec is not None:
+                target = _SeekTarget(self, sid, sink, conn,
+                                     rec.log.root)
+        try:
+            reply = serve_seek(conn, msg, target,
+                               replay_lookup=self._replay_lookup,
+                               replay_record=self._replay_record)
+        except (wire.WireError, OSError):
+            self._drop_conn(conn)
+            return
+        with contextlib.suppress(wire.WireError, OSError):
+            conn.send(reply)
 
     def _drop_conn(self, conn: _Conn, detach_sink: bool = True) -> None:
         """Remove one peer everywhere (idempotent; any thread). With
@@ -2123,6 +2288,11 @@ class SessionServer:
                 continue
             if t == "session":
                 self._handle_session_op(conn, msg)
+                continue
+            if t == "seek":
+                # Time-travel verb (gol_tpu.replay): read-only, so
+                # observers may scrub too.
+                self._handle_seek(conn, msg)
                 continue
             if t != "key":
                 continue

@@ -202,12 +202,17 @@ class Endpoint:
 
     def _turns(self, metrics: Series) -> Optional[float]:
         parts = [sum_series(metrics, "gol_tpu_engine_turns_total"),
-                 sum_series(metrics, "gol_tpu_session_turns_total")]
+                 sum_series(metrics, "gol_tpu_session_turns_total"),
+                 # Replay servers have no engine: their turn flow is
+                 # the pump position (gol_tpu.replay), so rate math
+                 # works unchanged on replay rows.
+                 sum_series(metrics, "gol_tpu_replay_turns_total")]
         vals = [p for p in parts if p is not None]
         return sum(vals) if vals else None
 
     def _row(self, metrics: Series, now: float) -> dict:
         turns = self._turns(metrics)
+        recordings = sum_series(metrics, "gol_tpu_replay_recordings")
         rate = None
         if self.prev is not None and turns is not None:
             t0, prev_metrics = self.prev
@@ -238,7 +243,23 @@ class Endpoint:
             ),
             "endpoint": self.spec,
             "up": True,
-            "turn": max_series(metrics, "gol_tpu_engine_committed_turn"),
+            # Replay servers (gol_tpu.replay): no engine series at all
+            # — they export listen_addr + the replay family, and the
+            # row renders from those instead of as a broken '-' row.
+            # Keyed on recordings > 0, not presence: a live session
+            # server that merely ANSWERED a seek verb registers the
+            # family at 0 (import side effect) and must keep its
+            # engine row.
+            "mode": "replay" if recordings else None,
+            "recordings": recordings,
+            "replay_serves": sum_series(
+                metrics, "gol_tpu_replay_serves_total"
+            ),
+            "turn": (
+                max_series(metrics, "gol_tpu_replay_position_turn")
+                if recordings
+                else max_series(metrics, "gol_tpu_engine_committed_turn")
+            ),
             "turns_total": turns,
             "turns_per_sec": rate,
             "sessions": sum_series(metrics, "gol_tpu_sessions_active"),
@@ -302,6 +323,7 @@ def build_tree(rows: List[dict]) -> List[dict]:
             "endpoint": r["endpoint"],
             "listen": r["listen"],
             "upstream": r.get("upstream"),
+            "mode": r.get("mode"),
             "depth": r.get("depth"),
             "peers": (r.get("relay_peers")
                       if r.get("upstream") is not None
@@ -333,7 +355,8 @@ def render_tree(tree: List[dict], out=None) -> None:
             bits.append(f"{_num(ws)} ws")
         if n.get("hop_latency_s") is not None and n.get("upstream"):
             bits.append(f"+{_num(n['hop_latency_s'], 's')}/hop")
-        tag = ("root" if not n.get("upstream")
+        tag = ("replay" if n.get("mode") == "replay"
+               else "root" if not n.get("upstream")
                else f"depth {_num(n.get('depth'))}")
         out.write(f"{'  ' * indent}{'└─ ' if indent else ''}"
                   f"{n['listen']}  [{tag}]  {', '.join(bits)}\n")
@@ -439,7 +462,14 @@ def _cells(row: dict) -> list:
     cells = []
     for key, _, width, unit in _COLUMNS:
         if key == "endpoint":
-            cells.append(str(row.get("endpoint", "TOTAL"))[:width])
+            name = str(row.get("endpoint", "TOTAL"))
+            if row.get("mode") == "replay":
+                # Replay servers render DISTINCTLY: no engine behind
+                # them, their SESS column carries recordings.
+                name = f"{name} ⟲"
+            cells.append(name[:width])
+        elif key == "sessions" and row.get("mode") == "replay":
+            cells.append(_num(row.get("recordings"), unit))
         elif key in ("p50", "p95", "p99"):
             cells.append(_num(lat.get(key), "s"))
         else:

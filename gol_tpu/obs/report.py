@@ -113,9 +113,65 @@ def turn_pairs(merged: dict) -> dict:
     return pairs
 
 
+def replay_summary(log_dir: str, turn: int,
+                   board_out: Optional[str] = None) -> dict:
+    """Join the timeline with EXACT board history (gol_tpu.replay,
+    docs/REPLAY.md): decode the recording at the nearest state <= turn
+    and summarize it — landed turn, alive count, a board digest (the
+    bit-identity anchor two post-mortems can compare), optionally the
+    raster itself as a PGM. The one numpy-touching corner of this
+    otherwise-stdlib module, imported only when --replay-to is asked
+    for."""
+    import hashlib
+
+    import numpy as np
+
+    from gol_tpu.replay.log import board_at, last_turn
+
+    got = board_at(log_dir, int(turn))
+    if got is None:
+        return {"requested_turn": int(turn), "error": "no usable "
+                f"recording under {log_dir}"}
+    landed, board = got
+    mask = np.ascontiguousarray((board != 0).astype(np.uint8))
+    out = {
+        "requested_turn": int(turn),
+        "turn": int(landed),
+        "recorded_last_turn": int(last_turn(log_dir)),
+        "alive": int(np.count_nonzero(mask)),
+        "width": int(board.shape[1]),
+        "height": int(board.shape[0]),
+        "board_sha256": hashlib.sha256(mask.tobytes()).hexdigest(),
+        "log_dir": str(log_dir),
+    }
+    if board_out:
+        from gol_tpu.io.pgm import write_pgm
+
+        write_pgm(board_out, board)
+        out["board_pgm"] = str(board_out)
+    return out
+
+
 def _cmd_merge(args) -> int:
     dumps = [load_trace(p) for p in args.paths]
     merged = merge_traces(dumps, labels=args.label)
+    if args.replay_to is not None:
+        if not args.replay_log:
+            print("error: --replay-to needs --replay-log LOG-DIR",
+                  file=sys.stderr)
+            return 2
+        rp = replay_summary(args.replay_log, args.replay_to,
+                            board_out=args.replay_board)
+        merged["metadata"]["replay"] = rp
+        if "error" in rp:
+            print(f"replay: {rp['error']}", file=sys.stderr)
+        else:
+            print(f"replay: turn {rp['turn']} (asked {rp['requested_turn']}"
+                  f", recording ends {rp['recorded_last_turn']}), "
+                  f"{rp['alive']} alive, board sha256 "
+                  f"{rp['board_sha256'][:16]}…"
+                  + (f", raster -> {rp['board_pgm']}"
+                     if rp.get("board_pgm") else ""))
     out = json.dumps(merged, indent=1)
     if args.output:
         with open(args.output, "w") as f:
@@ -289,6 +345,21 @@ def main(argv: Optional[list] = None) -> int:
                     help="override process labels, in input order "
                          "(repeatable — useful when merging N relays "
                          "that all call themselves 'connect')")
+    mp.add_argument("--replay-to", type=int, default=None,
+                    dest="replay_to", metavar="TURN",
+                    help="time-travel debugging (gol_tpu.replay): "
+                         "decode the --replay-log recording at TURN "
+                         "and join the exact board state (landed "
+                         "turn, alive count, sha256 digest) into the "
+                         "merged metadata")
+    mp.add_argument("--replay-log", default=None, dest="replay_log",
+                    metavar="LOG-DIR",
+                    help="the recording to decode for --replay-to (a "
+                         "session's replay/ directory)")
+    mp.add_argument("--replay-board", default=None, dest="replay_board",
+                    metavar="OUT.pgm",
+                    help="with --replay-to: also write the decoded "
+                         "raster as a PGM snapshot")
     mp.set_defaults(fn=_cmd_merge)
     rp = sub.add_parser("render", help="human post-mortem of a "
                                        "flight-recorder dump")

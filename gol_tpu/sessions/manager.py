@@ -147,6 +147,14 @@ class Sink:
     #: `on_sync`/`on_turn`/`on_close`.
     want_flips = True
 
+    #: EPHEMERAL sinks (the replay plane's RecorderSink) never count
+    #: as watchers for the hibernation policy: a session whose only
+    #: sink is ephemeral still idles, still parks (the park closes the
+    #: ephemeral sink with reason "parked"), and its `info()` watcher
+    #: count stays honest. They DO count for the dispatch path —
+    #: recording needs the diff stream.
+    ephemeral = False
+
     #: A POSITIVE value makes this sink chunk-granular: the manager
     #: hands whole dispatched chunks to `on_flip_chunk` instead of the
     #: per-turn on_flips/on_turn loop, and the SessionEngine scales
@@ -228,9 +236,17 @@ class Session:
             "height": b.height,
             "rule": str(b.rule),
             "turn": self.turn,
-            "watchers": len(b.sinks.get(self.id, ())),
+            # Ephemeral sinks (recorders) are plumbing, not watchers.
+            "watchers": len(_watching(b.sinks.get(self.id, ()))),
             "bucket": b.key,
         }
+
+
+def _watching(sinks) -> list:
+    """The NON-ephemeral sinks of one session — what the idle/park
+    policy and the watcher counts mean by "watched"."""
+    return [sk for sk in (sinks or ())
+            if not getattr(sk, "ephemeral", False)]
 
 
 class _Bucket:
@@ -351,6 +367,19 @@ class SessionManager:
         #: (the SessionEngine sweeps it every loop round). 0 parks at
         #: the first idle sweep; None (default) never auto-parks.
         self.park_idle_secs = park_idle_secs
+        #: Replay-plane recording state (gol_tpu.replay): when the
+        #: serving layer records sessions it sets this (e.g.
+        #: {"keyframe_turns": K}) and every session.json sidecar
+        #: carries it under "record" — the durable mark that a
+        #: session's out/sessions/<id>/replay/ log is live.
+        self.record_meta: "Optional[dict]" = None
+        #: Recorder factory `(sid, width, height) -> Optional[Sink]`:
+        #: when set (SessionServer --record), EVERY `_create` — wire
+        #: verb, resume, rehydration — attaches the returned ephemeral
+        #: sink INSIDE the create, on the owner thread, before the
+        #: session's first dispatch: the recording's first keyframe is
+        #: the birth (or revival) board, never a few chunks late.
+        self.recorder_factory = None
         #: Hibernated sessions: sid -> manifest-shaped meta (width/
         #: height/rule/seed/density + parked/turn). No device rows,
         #: no bucket slot — just the durable record; `_rehydrate`
@@ -442,7 +471,7 @@ class SessionManager:
         now = time.monotonic()
         due = [
             s.id for s in list(self._by_id.values())
-            if not s.bucket.sinks.get(s.id)
+            if not _watching(s.bucket.sinks.get(s.id))
             and s.idle_since is not None
             and now - s.idle_since >= self.park_idle_secs
         ]
@@ -796,6 +825,16 @@ class SessionManager:
         tracing.event("session.create", "lifecycle", session=sid,
                       bucket=b.key, slot=slot, turn=start_turn)
         flight.note("session.create", session=sid, bucket=b.key)
+        if self.recorder_factory is not None:
+            # Tape from birth: the recorder's attach-time keyframe is
+            # THIS board at THIS turn (after remnant clearing, so a
+            # re-created id's log starts clean). A recorder that fails
+            # to arm never fails the create — the session is the
+            # product, the tape is best-effort.
+            with contextlib.suppress(Exception):
+                sink = self.recorder_factory(sid, b.width, b.height)
+                if sink is not None:
+                    self._attach(sid, sink)
         return s.info()
 
     def _clear_session_remnants(self, sid: str) -> None:
@@ -816,6 +855,14 @@ class SessionManager:
             if name.endswith(".pgm") or name == "session.json":
                 with contextlib.suppress(OSError):
                     os.unlink(os.path.join(d, name))
+        # The dead incarnation's RECORDING must not survive either: a
+        # replay server pointed at this tree would serve the destroyed
+        # board's history under the new session's id.
+        from gol_tpu.replay.log import replay_dir, scan_segments
+
+        for _, seg in scan_segments(replay_dir(d)):
+            with contextlib.suppress(OSError):
+                os.unlink(seg)
         # Tombstone last: a kill mid-clear must leave the predecessor
         # destroyed (tombstone intact), never half-resurrected.
         with contextlib.suppress(OSError):
@@ -930,10 +977,12 @@ class SessionManager:
         turn = s.turn
         path = os.path.join(d, f"{b.width}x{b.height}x{turn}.pgm")
         write_pgm(path, self._fetch_board(sid))
+        side = {"id": sid, "width": b.width, "height": b.height,
+                "rule": str(b.rule), "turn": turn}
+        if self.record_meta is not None:
+            side["record"] = dict(self.record_meta)
         obs.atomic_write_text(
-            os.path.join(d, "session.json"),
-            json.dumps({"id": sid, "width": b.width, "height": b.height,
-                        "rule": str(b.rule), "turn": turn}),
+            os.path.join(d, "session.json"), json.dumps(side),
         )
         _METRICS.checkpoints.inc()
         tracing.event("session.checkpoint", "lifecycle", session=sid,
@@ -947,8 +996,15 @@ class SessionManager:
                 "parked" if sid in self._parked else "unknown-session"
             )
         b = s.bucket
-        if b.sinks.get(sid):
+        if _watching(b.sinks.get(sid)):
             raise SessionError("watched")
+        # Ephemeral sinks (recorders) don't block hibernation — they
+        # close with the park (their last segment is already durable;
+        # the next attach re-arms a recorder off the rehydrated board).
+        for sink in list(b.sinks.get(sid, ())):
+            with contextlib.suppress(Exception):
+                sink.on_close(sid, "parked")
+        b.sinks.pop(sid, None)
         # The checkpoint IS the hibernated state: crash-atomic PGM +
         # sidecar at the current turn, so a kill anywhere past this
         # line rehydrates exactly what was parked.
@@ -1056,8 +1112,11 @@ class SessionManager:
         board = self._fetch_board(sid)
         sink.on_sync(sid, s.turn, board)
         b.sinks.setdefault(sid, []).append(sink)
-        s.idle_since = None
-        s.watchers_metric.set(len(b.sinks[sid]))
+        if not getattr(sink, "ephemeral", False):
+            # Only real watchers stop the idle clock: a recorder-only
+            # session still auto-parks (docs/SESSIONS.md).
+            s.idle_since = None
+        s.watchers_metric.set(len(_watching(b.sinks[sid])))
         tracing.event("session.attach", "lifecycle", session=sid)
         return s.info()
 
@@ -1070,11 +1129,28 @@ class SessionManager:
             sinks.remove(sink)
         if not sinks:
             s.bucket.sinks.pop(sid, None)
-            # The idle clock starts when the LAST sink leaves — the
-            # auto-park policy's trigger.
+        if not _watching(sinks) and s.idle_since is None:
+            # The idle clock starts when the LAST watcher leaves — the
+            # auto-park policy's trigger (ephemeral sinks don't hold
+            # the session awake).
             s.idle_since = time.monotonic()
-        s.watchers_metric.set(len(sinks))
+        s.watchers_metric.set(len(_watching(sinks)))
         tracing.event("session.detach", "lifecycle", session=sid)
+
+    def resync(self, sid: str, sink: Sink, prepare=None) -> None:
+        """Serve `sink` a FRESH BoardSync on the engine thread,
+        between dispatches (the replay plane's live-rejoin: a scrubbed
+        peer returns to the present contiguously — `prepare` runs
+        first, atomically with the sync, e.g. clearing the scrub
+        flag). Raises SessionError for unknown/parked ids."""
+
+        def _do():
+            s = self._require(sid)
+            if prepare is not None:
+                prepare()
+            sink.on_sync(sid, s.turn, self._fetch_board(sid))
+
+        self._exec(_do)
 
     # --- the bucketed dispatch loop (owner thread) ---
 

@@ -74,7 +74,15 @@ LOWER_BETTER = re.compile(
     # named here for the activity lane's tile counters. `speedup`
     # gates HIGHER via the existing rule, and the lane's
     # device_plane.compiles rides the off-zero compile gate.
-    r"|active_tiles|tile_steps)", re.I
+    r"|active_tiles|tile_steps"
+    # Replay plane (ISSUE 14): the replay lane's engine_dispatch_delta
+    # sits at 0 by construction — serving a recording costs ZERO
+    # engine dispatches, so any move off zero is an infinite
+    # regression (the replay tier started dispatching device work).
+    # Deliberately the `_delta` spelling only: the live A/B points
+    # report their (legitimately nonzero) dispatch counts under
+    # `engine_dispatches`, which stays informational.
+    r"|dispatch_delta)", re.I
 )
 
 

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Merge the replay-plane lane into BENCH_DETAIL.json — the
+`relay_fanout_capture.py` pattern applied to ISSUE 14's acceptance
+lane.
+
+Runs `bench.measure_replay` — a recorded settled-512² run (inline
+SessionManager + RecorderSink, keyframes every 256 turns) served by a
+real ReplayServer to 1/10/100 raw observers, A/B'd against a live
+EngineServer doing the same — with the device plane bracketed, and
+writes the result under
+
+    BENCH_DETAIL.json["replay_512x512"]
+
+stamping the substrate platform. Gates (bench_compare picks these up
+by name): every `replay_N.engine_dispatch_delta` rides the off-zero
+infinite-regression rule (`dispatch_delta` is LOWER_BETTER with a
+zero baseline — a replay tier that dispatches device work has lost
+its point), `bytes_per_observer_turn` and the log's `bytes_per_turn`
+gate LOWER, the delivered `turns_per_sec` gates HIGHER.
+
+Usage: python scripts/replay_capture.py   (CPU-safe; ~2 min)
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    import jax
+
+    from gol_tpu.obs import device
+
+    device.install_compile_watcher()
+
+    import bench
+
+    entry = bench._lane(bench.measure_replay)
+    entry["platform"] = jax.devices()[0].platform
+
+    detail_path = REPO / "BENCH_DETAIL.json"
+    detail = json.loads(detail_path.read_text())
+    detail["replay_512x512"] = entry
+    detail_path.write_text(json.dumps(detail, indent=1))
+    print(json.dumps(entry, indent=1))
+    deltas = [entry.get(f"replay_{n}", {}).get("engine_dispatch_delta")
+              for n in (1, 10, 100)]
+    ok = all(d == 0 for d in deltas)
+    print(f"replay_512x512: engine_dispatch_delta @1/10/100 = {deltas} "
+          f"({'OK — zero engine dispatches' if ok else 'NOT MET'})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,161 @@
+#!/usr/bin/env bash
+# Replay smoke (ISSUE 14 / ROADMAP item 2 acceptance): record a REAL
+# `--serve --sessions --record` run, SIGKILL the server mid-append (the
+# torn-tail crash window), serve the surviving log with `--replay` to
+# 100 concurrent observers, and assert
+#   - every observer's final board is BIT-IDENTICAL to the recording's
+#     last decodable state (invariants forced ON in every process);
+#   - the replay server's /metrics has NO engine dispatch series at all
+#     (gol_tpu_engine_dispatches_total absent — zero engine dispatches
+#     is structural, not a counter that happens to read 0) while
+#     gol_tpu_replay_serves_total counts the fleet;
+#   - a seek through a real client lands <= the asked turn and decodes
+#     bit-identically to the log's own board_at.
+#
+# Usage: scripts/replay_smoke.sh   (CPU-safe; ~2 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export GOL_TPU_CHECK_INVARIANTS=1
+LOG_REC=$(mktemp) LOG_RPL=$(mktemp)
+OUT=$(mktemp -d)
+cleanup() {
+    for p in "${PID_RPL:-}" "${PID_REC:-}"; do
+        [ -n "$p" ] && kill "$p" 2>/dev/null || true
+    done
+    for p in "${PID_RPL:-}" "${PID_REC:-}"; do
+        [ -n "$p" ] && wait "$p" 2>/dev/null || true
+    done
+    rm -rf "$LOG_REC" "$LOG_RPL" "$OUT"
+}
+trap cleanup EXIT
+
+wait_addr() {  # $1 log, $2 sed pattern -> prints host:port
+    local addr=""
+    for _ in $(seq 1 240); do
+        addr=$(sed -n "$2" "$1" | head -1)
+        [ -n "$addr" ] && break
+        sleep 0.5
+    done
+    if [ -z "$addr" ]; then
+        echo "replay smoke: FAILED — no address in $1:" >&2
+        cat "$1" >&2
+        exit 1
+    fi
+    echo "$addr"
+}
+
+# --- phase 1: record a live run, then SIGKILL it -----------------------
+python -m gol_tpu --serve 127.0.0.1:0 --sessions --record \
+    --keyframe-turns 128 -noVis -t 1 -w 512 -h 512 \
+    --images fixtures/images --out "$OUT" --platform cpu \
+    >"$LOG_REC" 2>&1 &
+PID_REC=$!
+REC=$(wait_addr "$LOG_REC" 's#^session engine serving on \(.*\)$#\1#p')
+echo "recording server at $REC"
+
+JAX_PLATFORMS=cpu python - "$REC" <<'PYEOF'
+import sys, time
+from gol_tpu.distributed import SessionControl
+
+h, _, p = sys.argv[1].rpartition(":")
+ctl = SessionControl(h, int(p))
+ctl.create("viral", width=256, height=256, seed=42)
+# Let the tape grow (the unwatched-but-recorded session steps and
+# records continuously).
+time.sleep(6)
+ctl.close()
+print("session created + recorded for 6s")
+PYEOF
+
+kill -9 "$PID_REC"
+wait "$PID_REC" 2>/dev/null || true
+PID_REC=
+echo "recording server SIGKILLed mid-run"
+
+# --- phase 2: serve the surviving log to 100 observers ------------------
+python -m gol_tpu --replay "$OUT/sessions" --serve 127.0.0.1:0 \
+    --replay-rate 0 --platform cpu --metrics-port 0 \
+    >"$LOG_RPL" 2>&1 &
+PID_RPL=$!
+RPL=$(wait_addr "$LOG_RPL" 's#^replay serving on \([^ ]*\) .*$#\1#p')
+RPL_MX=$(wait_addr "$LOG_RPL" \
+    's#^metrics serving on \(http://[^/]*\)/metrics$#\1#p')
+echo "replay server at $RPL (metrics $RPL_MX)"
+
+JAX_PLATFORMS=cpu python - "$RPL" "$RPL_MX" "$OUT" <<'PYEOF'
+import sys, time, urllib.request
+
+import numpy as np
+
+from gol_tpu.distributed import Controller
+from gol_tpu.replay.log import board_at, last_turn, replay_dir
+
+h, _, p = sys.argv[1].rpartition(":")
+ADDR = (h, int(p))
+MX, OUT = sys.argv[2], sys.argv[3]
+
+log_dir = replay_dir(OUT + "/sessions/viral")
+end = last_turn(log_dir)
+assert end > 0, f"empty recording under {log_dir}"
+_, oracle = board_at(log_dir, end)
+oracle = oracle != 0
+print(f"recording ends at turn {end} "
+      f"({int(oracle.sum())} alive; torn tail, if any, discarded)")
+
+N = 100
+ctls = [Controller(*ADDR, want_flips=True, batch=True, batch_turns=1024,
+                   batch_flip_events=False, observe=True,
+                   reconnect=False) for _ in range(N)]
+deadline = time.time() + 120
+pending = list(range(N))
+while pending and time.time() < deadline:
+    pending = [i for i in pending
+               if ctls[i].board is None
+               or not np.array_equal(ctls[i].board != 0, oracle)]
+    time.sleep(0.25)
+assert not pending, (
+    f"{len(pending)} of {N} observers never converged to the "
+    f"recording's final board (e.g. observer {pending[0]})"
+)
+print(f"all {N} observers bit-identical to the recording at turn {end}")
+
+# Seek through a real client: lands at/past the ask within a keyframe
+# interval and decodes bit-identically to the log's own decoder.
+r = ctls[0].seek(end // 2, timeout=30)
+assert r.get("ok") and r["keyframe"] <= end // 2, r
+time.sleep(1.0)
+want = board_at(log_dir, r["turn"])[1]
+np.testing.assert_array_equal(ctls[0].board != 0, want != 0,
+                              err_msg="seeked board diverges")
+print(f"seek to {end // 2} landed at {r['turn']} "
+      f"(keyframe {r['keyframe']}), bit-identical")
+
+text = urllib.request.urlopen(MX + "/metrics", timeout=15).read().decode()
+def metric(name):
+    tot = 0.0
+    for line in text.splitlines():
+        head = line.split(" ")[0]
+        if head == name or head.startswith(name + "{"):
+            tot += float(line.rsplit(" ", 1)[1])
+    return tot
+# Zero engine dispatches: the dispatch families are ABSENT or FLAT AT
+# ZERO after serving a 100-observer fleet (registration-at-import may
+# create the series; serving must never move them).
+for fam in ("gol_tpu_engine_dispatches_total",
+            "gol_tpu_session_dispatches_total",
+            "gol_tpu_stepper_dispatches_total"):
+    v = metric(fam)
+    assert v == 0.0, f"{fam} moved to {v} on a REPLAY server"
+serves = metric("gol_tpu_replay_serves_total")
+assert serves >= N, f"serves_total {serves} < {N}"
+assert metric("gol_tpu_replay_recordings") >= 1
+assert metric("gol_tpu_replay_forwarded_bytes_total") > 0
+print(f"metrics OK: {serves:.0f} serves, zero engine dispatch series")
+
+for c in ctls:
+    c.close()
+print("REPLAY SMOKE PASS")
+PYEOF
+
+echo "replay smoke: PASS"

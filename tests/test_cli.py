@@ -175,3 +175,37 @@ def test_pause_resume_prints_reference_lines(golden_root, tmp_path, capsys):
     paused = next(e for e in changes if e.new_state is State.PAUSED)
     out = capsys.readouterr().out
     assert out == f"{paused.completed_turns}\nContinuing\n"
+
+
+# --- replay-plane flag validation (gol_tpu.replay, ISSUE 14) ------------
+
+
+def test_record_requires_sessions():
+    with pytest.raises(SystemExit, match="--record applies to --serve "
+                                         "--sessions"):
+        main(["--serve", "127.0.0.1:0", "--record", "-noVis"])
+
+
+def test_replay_requires_serve_listener():
+    with pytest.raises(SystemExit, match="--replay needs --serve"):
+        main(["--replay", "/nonexistent", "-noVis"])
+
+
+def test_replay_rejects_other_serving_modes():
+    with pytest.raises(SystemExit, match="own serving mode"):
+        main(["--replay", "/x", "--serve", "127.0.0.1:0", "--sessions",
+              "-noVis"])
+    with pytest.raises(SystemExit, match="own serving mode"):
+        main(["--replay", "/x", "--serve", "127.0.0.1:0",
+              "--connect", "localhost:1", "-noVis"])
+
+
+def test_replay_rate_requires_replay():
+    with pytest.raises(SystemExit, match="--replay-rate requires"):
+        main(["--serve", "127.0.0.1:0", "--replay-rate", "0", "-noVis"])
+
+
+def test_replay_without_recordings_errors(tmp_path):
+    with pytest.raises(SystemExit, match="no recordings under"):
+        main(["--replay", str(tmp_path), "--serve", "127.0.0.1:0",
+              "-noVis"])

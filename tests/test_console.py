@@ -231,3 +231,64 @@ def test_tree_survives_relay_cycles():
         return sum(1 + count(n["children"]) for n in nodes)
 
     assert count(tree) == 2
+
+
+# --- replay rows (gol_tpu.replay, ISSUE 14) -----------------------------
+
+
+def test_replay_server_renders_as_distinct_row_not_broken():
+    """A replay server's exposition has listen_addr + the replay
+    family but NO engine series: the row must carry its position turn,
+    turns/s from the pump counter, recordings in the SESS column and
+    a 'replay' tag in the tree — not a broken '-' row."""
+    text = "\n".join([
+        'gol_tpu_server_listen_addr{addr="127.0.0.1:9300"} 1',
+        "gol_tpu_replay_recordings 2",
+        "gol_tpu_replay_position_turn 512",
+        "gol_tpu_replay_turns_total 4096",
+        "gol_tpu_replay_serves_total 100",
+        "gol_tpu_server_peers 100",
+    ])
+    metrics = console.parse_prometheus(text)
+    ep = console.Endpoint("9300")
+    row = ep._row(metrics, 10.0)
+    assert row["mode"] == "replay"
+    assert row["turn"] == 512
+    assert row["recordings"] == 2
+    assert row["replay_serves"] == 100
+    assert row["peers"] == 100
+    # Rate between scrapes comes from the pump's turn counter.
+    ep.prev = (9.0, console.parse_prometheus(
+        text.replace("4096", "3072")
+    ))
+    row2 = ep._row(metrics, 10.0)
+    assert row2["turns_per_sec"] == pytest.approx(1024.0)
+    # The table cell plane: SESS shows recordings, endpoint is marked.
+    cells = console._cells(row)
+    assert "⟲" in cells[0]
+    assert cells[3] == "2"  # SESS column
+    # Tree tag: a replay node is labeled, not mistaken for an engine
+    # root.
+    tree = console.build_tree([row])
+    assert tree and tree[0]["mode"] == "replay"
+    out = io.StringIO()
+    console.render_tree(tree, out)
+    assert "[replay]" in out.getvalue()
+
+
+def test_zero_recordings_gauge_keeps_engine_row():
+    """A LIVE session server that merely answered a seek verb has the
+    replay family registered at 0 (import side effect): its row must
+    stay an engine row, never flip to replay rendering."""
+    text = "\n".join([
+        'gol_tpu_server_listen_addr{addr="127.0.0.1:8030"} 1',
+        "gol_tpu_replay_recordings 0",
+        "gol_tpu_engine_committed_turn 777",
+        "gol_tpu_session_turns_total 1000",
+    ])
+    row = console.Endpoint("8030")._row(
+        console.parse_prometheus(text), 1.0
+    )
+    assert row["mode"] is None
+    assert row["turn"] == 777
+    assert "⟲" not in console._cells(row)[0]

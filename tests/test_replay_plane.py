@@ -1,0 +1,454 @@
+"""Replay plane (gol_tpu.replay, ISSUE 14): segment log round-trips,
+seek semantics, and the zero-dispatch replay server — the tier-1 half
+of the acceptance split (the 100-observer scenario lives in
+scripts/replay_smoke.sh).
+
+Pinned here:
+- the recording decodes BIT-IDENTICALLY to the recorded session at
+  every sampled turn, including turns inside a frame (board_at's
+  partial apply vs an independent stepper oracle);
+- a COLD replay client's stream converges to the recorded run
+  bit-exactly (invariants forced ON via the autouse fixture);
+- seek lands within one keyframe interval and is idempotent under rid
+  replay;
+- serving a recording moves ZERO engine/session/stepper dispatch
+  counters;
+- hibernation interplay: an ephemeral recorder never blocks park, and
+  rehydration re-arms it;
+- a destroyed session's recording never survives into a re-created id.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu.checkpoint import session_checkpoint_dir
+from gol_tpu.params import Params
+from gol_tpu.replay.log import (
+    SegmentLog,
+    board_at,
+    last_turn,
+    replay_dir,
+    scan_segments,
+    seek_frames,
+)
+from gol_tpu.replay.recorder import RecorderSink
+from gol_tpu.sessions.manager import SessionManager, seeded_board
+
+
+@pytest.fixture(autouse=True)
+def _invariants_on(monkeypatch):
+    """Runtime invariants forced ON for every replay test (the
+    acceptance criterion says the bit-identity holds with the
+    monitors armed); any violation fails through the counter."""
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.analysis.invariants import violations_total
+
+    before = violations_total()
+    yield
+    assert violations_total() == before, "invariant violation recorded"
+
+
+def _record_session(out_dir, *, side=64, seed=7, turns=300, chunk=30,
+                    keyframe_turns=64):
+    """Inline-manager recording (no engine thread): returns
+    (replay_dir, {turn: board} oracle snapshots, final board)."""
+    m = SessionManager(out_dir=str(out_dir), bucket_capacity=4)
+    m.create("s1", width=side, height=side, seed=seed)
+    d = replay_dir(os.path.join(session_checkpoint_dir(str(out_dir)),
+                                "s1"))
+    log = SegmentLog(d, keyframe_turns=keyframe_turns)
+    rec = RecorderSink(m, "s1", side, side, log)
+    m.attach("s1", rec)
+    oracle = {0: m.fetch_board("s1").copy()}
+    done = 0
+    while done < turns:
+        m.pump(chunk, chunk=chunk)
+        done += chunk
+        oracle[m.peek_turn("s1")] = m.fetch_board("s1").copy()
+    m.detach("s1", rec)
+    rec.on_close("s1", "done")
+    return d, oracle, oracle[max(oracle)]
+
+
+def test_log_roundtrip_bit_identity(tmp_path):
+    d, oracle, _ = _record_session(tmp_path)
+    assert scan_segments(d)[0][0] == 0  # taped from birth
+    assert last_turn(d) == max(oracle)
+    for turn, want in oracle.items():
+        got = board_at(d, turn)
+        assert got is not None and got[0] == turn
+        np.testing.assert_array_equal(got[1] != 0, want != 0,
+                                      err_msg=f"turn {turn}")
+
+
+def test_board_at_mid_frame_matches_stepper_oracle(tmp_path):
+    """Turns INSIDE a recorded frame (the partial apply): bit-equal to
+    an independent dense stepper advanced to exactly that turn."""
+    from gol_tpu.parallel.stepper import make_stepper
+
+    d, _, _ = _record_session(tmp_path, turns=120, chunk=40)
+    st = make_stepper(threads=1, height=64, width=64)
+    q = st.put(seeded_board(64, 64, 7))
+    prev = 0
+    for turn in (1, 17, 39, 41, 63, 64, 65, 97, 120):
+        q, c = st.step_n(q, turn - prev)
+        int(c)
+        prev = turn
+        landed, got = board_at(d, turn)
+        assert landed == turn
+        np.testing.assert_array_equal(got != 0, st.fetch(q) != 0,
+                                      err_msg=f"turn {turn}")
+
+
+def test_seek_frames_lands_within_keyframe_interval(tmp_path):
+    d, oracle, _ = _record_session(tmp_path, turns=300, chunk=25,
+                                   keyframe_turns=64)
+    for want in (0, 1, 40, 130, 299, 300):
+        k, landed, payloads = seek_frames(d, want)
+        assert k <= want
+        # Landing may overshoot by less than one frame; frames are
+        # bounded by the keyframe cadence (RecorderSink.batch_turns).
+        assert want <= landed < want + 64 + 25
+        assert payloads[0][0] == 2  # _TAG_BOARD keyframe first
+    # Past-the-end seeks land at the recording's end.
+    k, landed, _ = seek_frames(d, 10 ** 9)
+    assert landed == 300
+
+
+def test_log_eviction_keeps_serving_recent_history(tmp_path):
+    """max_bytes evicts oldest segments; seeks before the surviving
+    history answer from the first remaining keyframe."""
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    m.create("s1", width=64, height=64, seed=3)
+    d = replay_dir(os.path.join(session_checkpoint_dir(str(tmp_path)),
+                                "s1"))
+    log = SegmentLog(d, keyframe_turns=16, max_bytes=4096)
+    rec = RecorderSink(m, "s1", 64, 64, log)
+    m.attach("s1", rec)
+    m.pump(200, chunk=16)
+    final = m.fetch_board("s1").copy()
+    m.detach("s1", rec)
+    rec.on_close("s1", "done")
+    segs = scan_segments(d)
+    assert segs[0][0] > 0, "nothing evicted — bound not enforced"
+    total = sum(os.path.getsize(p) for _, p in segs)
+    assert total <= 4096 + 4096  # bound + one in-flight segment slack
+    k, landed, _ = seek_frames(d, 0)  # before surviving history
+    assert k == segs[0][0]
+    got = board_at(d, 200)
+    np.testing.assert_array_equal(got[1] != 0, final != 0)
+
+
+def test_cold_replay_client_bit_identical(tmp_path):
+    """ACCEPTANCE: a cold replay client's event stream reconstructs
+    the live recording bit-identically (invariants ON), with zero
+    engine dispatches on the serving side."""
+    from gol_tpu.distributed.client import Controller
+    from gol_tpu.replay.server import ReplayServer
+
+    d, oracle, final = _record_session(tmp_path, turns=240, chunk=30)
+    before = _dispatch_totals()
+    srv = ReplayServer(str(tmp_path / "sessions"), port=0,
+                       replay_rate=0).start()
+    try:
+        ctl = Controller(*srv.address, want_flips=True, batch=True,
+                         batch_turns=1024, batch_flip_events=False,
+                         observe=True)
+        assert ctl.wait_sync(60)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if ctl.board is not None and np.array_equal(
+                    ctl.board != 0, final != 0):
+                break
+            time.sleep(0.05)
+        np.testing.assert_array_equal(
+            ctl.board != 0, final != 0,
+            err_msg="cold replay client diverges from the recording",
+        )
+        ctl.close()
+    finally:
+        srv.shutdown()
+    after = _dispatch_totals()
+    assert after == before, f"engine dispatches moved: {before}->{after}"
+
+
+def _dispatch_totals() -> dict:
+    """Every dispatch-counter series the replay side must NOT move:
+    the singleton engine's, the session buckets', and the stepper
+    entries' — read straight off the process registry (the same
+    series the smoke script asserts on /metrics)."""
+    from gol_tpu import obs
+
+    families = ("gol_tpu_engine_dispatches_total",
+                "gol_tpu_session_dispatches_total",
+                "gol_tpu_stepper_dispatches_total")
+    return {k: v["value"] for k, v in obs.registry().snapshot().items()
+            if k.startswith(families)}
+
+
+def test_replay_server_seek_idempotent_and_bounded(tmp_path):
+    from gol_tpu.distributed.client import Controller
+    from gol_tpu.replay.server import ReplayServer
+
+    d, oracle, final = _record_session(tmp_path, turns=240, chunk=30,
+                                       keyframe_turns=64)
+    srv = ReplayServer(str(tmp_path / "sessions"), port=0,
+                       replay_rate=0).start()
+    try:
+        ctl = Controller(*srv.address, want_flips=True, batch=True,
+                         batch_turns=1024, batch_flip_events=False,
+                         observe=True)
+        assert ctl.wait_sync(60)
+        r = ctl.seek(100, timeout=30)
+        assert r["ok"] and r["keyframe"] <= 100, r
+        assert 100 <= r["turn"] < 100 + 64 + 30  # one keyframe interval
+        time.sleep(0.3)
+        want = board_at(d, r["turn"])[1]
+        np.testing.assert_array_equal(ctl.board != 0, want != 0)
+        # rid replay: the recorded reply verbatim.
+        r2 = ctl.seek(100, timeout=30, rid=r["rid"])
+        assert r2 == r, (r, r2)
+        # Live rejoin converges back to the recording's end.
+        r3 = ctl.seek("live", timeout=30)
+        assert r3["ok"], r3
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not np.array_equal(
+                ctl.board != 0, final != 0):
+            time.sleep(0.05)
+        np.testing.assert_array_equal(ctl.board != 0, final != 0)
+        ctl.close()
+    finally:
+        srv.shutdown()
+
+
+def test_replay_server_requires_binary_flip_peers(tmp_path):
+    """The tier's capability floor (the relay rule): legacy peers get
+    a reasoned reject, unknown recordings a clean unknown-session."""
+    import socket
+
+    from gol_tpu.distributed import wire
+    from gol_tpu.replay.server import ReplayServer
+
+    _record_session(tmp_path, turns=60, chunk=30)
+    srv = ReplayServer(str(tmp_path / "sessions"), port=0,
+                       replay_rate=0).start()
+    try:
+        for hello, reason in (
+            ({"t": "hello", "want_flips": True}, "replay-binary-only"),
+            ({"t": "hello", "want_flips": True, "binary": True,
+              "session": "nope"}, "unknown-session"),
+        ):
+            s = socket.create_connection(srv.address, timeout=10)
+            s.settimeout(10)
+            wire.send_msg(s, hello)
+            r = wire.recv_msg(s)
+            assert r == {"t": "error", "reason": reason}, r
+            s.close()
+    finally:
+        srv.shutdown()
+
+
+def test_recorder_is_ephemeral_for_park_and_rearms(tmp_path):
+    """Hibernation interplay: the recorder never blocks park (it is
+    closed with reason 'parked'), and rehydration re-creates the
+    session through _create, which re-arms the factory recorder with
+    a fresh keyframe at the revived turn."""
+    closed = []
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    d = replay_dir(os.path.join(session_checkpoint_dir(str(tmp_path)),
+                                "p1"))
+
+    def factory(sid, w, h):
+        return RecorderSink(m, sid, w, h,
+                            SegmentLog(d, keyframe_turns=32),
+                            on_closed=lambda s, r: closed.append(r))
+
+    m.recorder_factory = factory
+    m.create("p1", width=64, height=64, seed=9)
+    m.pump(64, chunk=32)
+    turn = m.peek_turn("p1")
+    board = m.fetch_board("p1").copy()
+    r = m.park("p1")  # must not raise "watched" over the recorder
+    assert r["turn"] == turn
+    assert closed == ["parked"]
+    assert m.is_parked("p1")
+
+    class _Probe:
+        want_flips = False
+        batch_turns = 0
+
+        def on_sync(self, sid, t, b):
+            self.turn, self.board = t, np.array(b)
+
+        def on_flips(self, *a):
+            pass
+
+        def on_turn(self, *a):
+            pass
+
+        def on_close(self, *a):
+            pass
+
+    probe = _Probe()
+    m.attach("p1", probe)  # rehydrates + re-arms the recorder
+    assert probe.turn == turn
+    np.testing.assert_array_equal(probe.board != 0, board != 0)
+    # The revived recorder cut a fresh keyframe at the parked turn.
+    assert any(t == turn for t, _ in scan_segments(d))
+    got = board_at(d, turn)
+    np.testing.assert_array_equal(got[1] != 0, board != 0)
+
+
+def test_recreated_id_drops_dead_incarnations_recording(tmp_path):
+    """A destroyed session's tape must not leak into a re-created id:
+    the tombstone-gated remnant clearing covers replay segments."""
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    d = replay_dir(os.path.join(session_checkpoint_dir(str(tmp_path)),
+                                "z1"))
+    m.recorder_factory = lambda sid, w, h: RecorderSink(
+        m, sid, w, h, SegmentLog(d, keyframe_turns=32)
+    )
+    m.create("z1", width=64, height=64, seed=1)
+    m.pump(64, chunk=32)
+    assert scan_segments(d)
+    m.destroy("z1")
+    m.create("z1", width=64, height=64, seed=2)
+    segs = scan_segments(d)
+    assert [t for t, _ in segs] == [0], segs  # only the new birth tape
+    got = board_at(d, 0)
+    np.testing.assert_array_equal(
+        got[1] != 0, seeded_board(64, 64, 2) != 0,
+        err_msg="re-created id served the dead incarnation's board",
+    )
+
+
+def test_session_json_carries_recording_state(tmp_path):
+    """--record state rides the session.json sidecar (the PR 7
+    crash-consistency story covers it)."""
+    import json
+
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    m.record_meta = {"keyframe_turns": 64}
+    m.create("s1", width=64, height=64, seed=7)
+    m.checkpoint("s1")
+    side = json.load(open(os.path.join(
+        session_checkpoint_dir(str(tmp_path)), "s1", "session.json"
+    )))
+    assert side["record"] == {"keyframe_turns": 64}
+
+
+def test_report_merge_replay_to(tmp_path, capsys):
+    """obs.report merge --replay-to joins the flight-recorder timeline
+    with the exact board history: the merged metadata names the landed
+    turn, alive count and board digest."""
+    import json
+
+    from gol_tpu.obs import report
+
+    d, oracle, _ = _record_session(tmp_path, turns=120, chunk=30)
+    trace = tmp_path / "t.json"
+    trace.write_text(json.dumps({"traceEvents": [], "metadata": {}}))
+    out = tmp_path / "merged.json"
+    rc = report.main(["merge", str(trace), "-o", str(out),
+                      "--replay-log", str(d), "--replay-to", "90"])
+    assert rc == 0
+    merged = json.loads(out.read_text())
+    rp = merged["metadata"]["replay"]
+    assert rp["requested_turn"] == 90 and rp["turn"] == 90
+    want = oracle[90]
+    assert rp["alive"] == int(np.count_nonzero(want))
+    import hashlib
+
+    digest = hashlib.sha256(
+        np.ascontiguousarray((want != 0).astype(np.uint8)).tobytes()
+    ).hexdigest()
+    assert rp["board_sha256"] == digest
+
+
+def test_replay_composes_under_relay_tree(tmp_path):
+    """PR 12 composition: a relay node attaches to a REPLAY server
+    exactly as to a live root, and a leaf observer behind the relay
+    converges to the recording bit-identically — one recording fans
+    out through the same broadcast tiers."""
+    from gol_tpu.distributed.client import Controller
+    from gol_tpu.relay import RelayNode
+    from gol_tpu.replay.server import ReplayServer
+
+    _, _, final = _record_session(tmp_path, turns=240, chunk=30)
+    srv = ReplayServer(str(tmp_path / "sessions"), port=0,
+                       replay_rate=0, pump_paused=True).start()
+    relay = None
+    try:
+        relay = RelayNode(srv.address, port=0).start()
+        ctl = Controller(*relay.address, want_flips=True, batch=True,
+                         batch_turns=1024, batch_flip_events=False,
+                         observe=True)
+        srv.release_pumps()
+        assert ctl.wait_sync(60)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if ctl.board is not None and np.array_equal(
+                    ctl.board != 0, final != 0):
+                break
+            time.sleep(0.05)
+        np.testing.assert_array_equal(
+            ctl.board != 0, final != 0,
+            err_msg="leaf behind a relay diverges from the recording",
+        )
+        assert relay.depth == 1  # replay server acks depth 0
+        ctl.close()
+    finally:
+        if relay is not None:
+            relay.shutdown()
+        srv.shutdown()
+
+
+def test_per_turn_fallback_never_cuts_mid_chunk_keyframe(tmp_path):
+    """The per-turn (non-chunk-granular) delivery path runs AFTER the
+    whole chunk committed, so _fetch_board is the POST-chunk board: a
+    keyframe cut mid-chunk would stamp it with an earlier turn and
+    every later frame would double-apply on replay. Pinned: on_turn
+    only cuts at the chunk's final (committed) turn, and the log
+    stays bit-exact through the fallback path."""
+    m = SessionManager(out_dir=str(tmp_path), bucket_capacity=4)
+    m.create("s1", width=64, height=64, seed=7)
+    d = replay_dir(os.path.join(session_checkpoint_dir(str(tmp_path)),
+                                "s1"))
+    rec = RecorderSink(m, "s1", 64, 64, SegmentLog(d, keyframe_turns=8))
+    # Derive the per-turn flip stream from an independent stepper.
+    from gol_tpu.parallel.stepper import make_stepper
+
+    st = make_stepper(threads=1, height=64, width=64)
+    q = st.put(m.fetch_board("s1"))
+    boards = {0: st.fetch(q)}
+    flips = {}
+    for t in range(1, 17):
+        q, c = st.step_n(q, 1)
+        int(c)
+        boards[t] = st.fetch(q)
+        diff = (boards[t] != 0) ^ (boards[t - 1] != 0)
+        flips[t] = np.argwhere(diff)[:, ::-1].astype(np.int32)
+    # Commit the same 16 turns on the bucket in ONE chunk (recorder
+    # deliberately NOT attached — this test drives the per-turn
+    # delivery by hand, exactly as _emit would after the commit:
+    # flips then turn, per turn, with the session clock already at
+    # the post-chunk turn).
+    m.pump(16, chunk=16)
+    rec.on_sync("s1", 0, boards[0])
+    for t in range(1, 17):
+        if len(flips[t]):
+            rec.on_flips("s1", t, flips[t])
+        rec.on_turn("s1", t)  # due at t=8 — must NOT cut there
+    segs = [t for t, _ in scan_segments(d)]
+    assert 8 not in segs, "keyframe cut mid-chunk (stamped wrong turn)"
+    assert segs == [0, 16], segs
+    m.detach("s1", rec)
+    rec.on_close("s1", "done")
+    for t in (4, 8, 12, 16):
+        landed, got = board_at(d, t)
+        assert landed == t
+        np.testing.assert_array_equal(got != 0, boards[t] != 0,
+                                      err_msg=f"turn {t}")

@@ -1222,3 +1222,204 @@ def test_ws_fragmented_hello_accepted(fuzz_relay):
             acked = _json.loads(body).get("t") == "attach-ack"
     assert acked, "fragmented hello was not assembled"
     s.close()
+
+
+# --- replay plane fuzz (gol_tpu.replay, ISSUE 14) ---
+
+
+def _mini_recording(root, keyframe_turns=8, segments=3,
+                    frames_per_seg=4, side=64):
+    """A tiny synthetic recording: `segments` keyframes, each followed
+    by single-turn FBATCH frames (one flipped cell per turn) — enough
+    structure for the torn-tail and seek sweeps without an engine."""
+    from gol_tpu.replay.log import SegmentLog
+
+    log = SegmentLog(root, keyframe_turns=keyframe_turns)
+    rng = np.random.default_rng(5)
+    board = (rng.random((side, side)) < 0.2).astype(np.uint8) * 255
+    _, nb = wire.grid_words(side, side)
+    turn = 0
+    for _ in range(segments):
+        log.start_segment(turn, wire.board_to_frame(turn, board, 0),
+                          time.time())
+        for _ in range(frames_per_seg):
+            turn += 1
+            x, y = int(rng.integers(side)), int(rng.integers(side))
+            board[y, x] ^= np.uint8(255)
+            bitmap, words = wire.coords_to_words([[x, y]], side, side)
+            log.append(wire.flip_batch_to_frame(
+                turn, nb, np.asarray([len(words)], np.uint32),
+                bitmap.reshape(1, -1), words, time.time(),
+            ), time.time(), turn)
+        turn += keyframe_turns - frames_per_seg
+    log.close()
+    return board, turn
+
+
+def test_torn_segment_tail_discarded(tmp_path):
+    """A SIGKILL mid-append leaves a torn tail record: the log still
+    opens, the tail is discarded, and seeks keep serving from the last
+    good frame — never an exception, never a short/garbage payload."""
+    from gol_tpu.replay.log import read_records, scan_segments, seek_frames
+
+    root = tmp_path / "replay"
+    _mini_recording(str(root))
+    segs = scan_segments(root)
+    last = segs[-1][1]
+    whole = read_records(last)
+    assert len(whole) == 5  # keyframe + 4 frames
+    blob = open(last, "rb").read()
+    for cut in (1, 7, 13, len(blob) - 3, len(blob) - 1):
+        with open(last, "wb") as f:
+            f.write(blob[:cut])
+        got = read_records(last)
+        assert all(payload in [w[1] for w in whole]
+                   for _, payload in got)
+        assert len(got) < len(whole) or cut >= len(blob)
+        # Seeking into the torn region still answers (from whatever
+        # survived — at worst the previous segment's keyframe).
+        answer = seek_frames(root, segs[-1][0] + 2)
+        assert answer is not None
+        k, landed, payloads = answer
+        assert payloads and payloads[0][0] == wire._TAG_BOARD
+    # A hostile tail: header claiming an absurd record length.
+    with open(last, "wb") as f:
+        f.write(blob + struct.pack("<Id", wire.MAX_FRAME + 1, 0.0)
+                + b"x" * 16)
+    assert len(read_records(last)) == len(whole)
+
+
+def test_torn_keyframe_falls_back_to_previous_segment(tmp_path):
+    """A segment whose KEYFRAME record is torn is unusable — a seek
+    into it must fall back to the last good keyframe, not error."""
+    from gol_tpu.replay.log import scan_segments, seek_frames
+
+    root = tmp_path / "replay"
+    _mini_recording(str(root))
+    segs = scan_segments(root)
+    # Tear the last segment inside its first (keyframe) record.
+    with open(segs[-1][1], "r+b") as f:
+        f.truncate(10)
+    k, landed, payloads = seek_frames(root, segs[-1][0] + 1)
+    assert k == segs[-2][0]
+    assert payloads[0][0] == wire._TAG_BOARD
+    # Doubly-corrupted tree: the fallback walks PAST a second torn
+    # keyframe to the oldest intact segment, never answers empty.
+    with open(segs[-2][1], "r+b") as f:
+        f.truncate(6)
+    k, landed, payloads = seek_frames(root, segs[-1][0] + 1)
+    assert k == segs[-3][0]
+    assert payloads[0][0] == wire._TAG_BOARD
+
+
+@pytest.fixture(scope="module")
+def record_server(tmp_path_factory):
+    """One real `--record` SessionServer with a recorded session, for
+    the seek-verb attack sweeps."""
+    from gol_tpu.distributed import SessionControl, SessionServer
+    from gol_tpu.params import Params
+
+    out = tmp_path_factory.mktemp("replay-fuzz")
+    p = Params(turns=10**9, threads=1, image_width=64, image_height=64,
+               out_dir=str(out))
+    srv = SessionServer(p, port=0, watched_chunk=4, idle_chunk=32,
+                        record=True, keyframe_turns=16).start()
+    ctl = SessionControl(*srv.address)
+    ctl.create("taped", width=64, height=64, seed=11)
+    deadline = time.monotonic() + 30
+    while srv.manager.peek_turn("taped") < 64 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    ctl.close()
+    yield srv
+    srv.shutdown()
+
+
+def _attach_session_observer(addr, sid):
+    s = _hello(addr, session=sid, want_flips=True, binary=True,
+               role="observe", batch=64)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        m = wire.recv_msg(s)
+        if m is None:
+            raise AssertionError("stream closed before board sync")
+        if m.get("t") == "board":
+            return s
+        if m.get("t") == "hb":
+            wire.send_msg(s, {"t": "hb"})
+    raise AssertionError("no board sync")
+
+
+def _seek_reply(s, msg):
+    wire.send_msg(s, msg)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        m = wire.recv_msg(s)
+        if m is None:
+            raise AssertionError("stream closed awaiting seek-r")
+        if m.get("t") == "seek-r":
+            return m
+        if m.get("t") == "hb":
+            wire.send_msg(s, {"t": "hb"})
+    raise AssertionError("no seek-r reply")
+
+
+def test_hostile_seek_verbs_never_kill_the_reader(record_server):
+    """Negative / huge / non-int / missing turns: every one answers a
+    reasoned ok:false seek-r on the SAME connection, which then still
+    serves a legitimate seek — a bad verb must never kill the reader
+    thread or wedge the peer."""
+    s = _attach_session_observer(record_server.address, "taped")
+    for bad in (-1, -(10 ** 30), 2 ** 70, 3.5, "soon", None, True,
+                False, [], {"turn": 4}):
+        r = _seek_reply(s, {"t": "seek", "turn": bad})
+        assert r.get("ok") is False and r.get("reason") == "bad-turn", \
+            (bad, r)
+    r = _seek_reply(s, {"t": "seek"})  # missing operand entirely
+    assert r.get("ok") is False and r.get("reason") == "bad-turn"
+    good = _seek_reply(s, {"t": "seek", "turn": 8})
+    assert good.get("ok") and good["keyframe"] <= 8, good
+    s.close()
+
+
+def test_seek_on_unrecorded_session_clean_error(session_server):
+    """Seeking a session on a server WITHOUT --record: a clean
+    reasoned rejection, never a dead reader or a half-stream."""
+    from gol_tpu.distributed import SessionControl
+
+    ctl = SessionControl(*session_server.address)
+    ctl.create("untaped", width=64, height=64, seed=2)
+    s = _attach_session_observer(session_server.address, "untaped")
+    r = _seek_reply(s, {"t": "seek", "turn": 5})
+    assert r.get("ok") is False and r.get("reason") == "not-recorded", r
+    # Connection still alive: a session verb still answers.
+    wire.send_msg(s, {"t": "session", "op": "list"})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        m = wire.recv_msg(s)
+        if m.get("t") == "session-r":
+            assert m["ok"]
+            break
+        if m.get("t") == "hb":
+            wire.send_msg(s, {"t": "hb"})
+    ctl.destroy("untaped")
+    ctl.close()
+    s.close()
+
+
+def test_rid_replayed_seek_returns_recorded_reply_verbatim(
+        record_server):
+    """The idempotent-rid rule applied to seek: a retried rid answers
+    the RECORDED reply dict verbatim (landed turn included), even when
+    the recording has since grown past it."""
+    s = _attach_session_observer(record_server.address, "taped")
+    r1 = _seek_reply(s, {"t": "seek", "turn": 8, "rid": "seek-rid-x"})
+    assert r1.get("ok"), r1
+    time.sleep(0.3)  # the recording keeps growing meanwhile
+    r2 = _seek_reply(s, {"t": "seek", "turn": 8, "rid": "seek-rid-x"})
+    assert r2 == r1, (r1, r2)
+    # Hostile rids fall back to one-shot semantics, never crash.
+    for rid in ("", "x" * 300, 42, None, ["rid"]):
+        r = _seek_reply(s, {"t": "seek", "turn": 8, "rid": rid})
+        assert r.get("ok"), (rid, r)
+    s.close()
