@@ -105,6 +105,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="bind address for --metrics-port (default "
                          "loopback; non-loopback exposure should sit "
                          "behind the same controls as --serve)")
+    ap.add_argument("--alert-rules", default=None, dest="alert_rules",
+                    metavar="FILE",
+                    help="with --metrics-port: SLO alert rules evaluated "
+                         "inside the sidecar (gol_tpu.obs.freshness), "
+                         "one per line, e.g. 'age: p99(gol_tpu_server_"
+                         "turn_age_seconds) > 2 for 30s'; state served "
+                         "at /alerts, transitions counted and noted in "
+                         "the flight recorder; a parse error is a "
+                         "STARTUP error, never a runtime crash")
     ap.add_argument("--profile-dir", default=None, dest="profile_dir",
                     metavar="DIR",
                     help="capture a jax.profiler device trace into DIR "
@@ -303,13 +312,37 @@ def build_parser() -> argparse.ArgumentParser:
 def _start_metrics(args, health=None):
     """Opt-in observability sidecar (gol_tpu.obs.http): serve the
     process registry + a health probe whenever --metrics-port is given.
-    Returns the MetricsServer (caller closes it) or None."""
+    With --alert-rules, the freshness plane's SLO evaluator runs
+    inside the sidecar (served at /alerts) — rule-file parse errors
+    abort AT STARTUP with the offending line, so a typo can never take
+    a serving process down at runtime. Returns the MetricsServer
+    (caller closes it — the evaluator rides its lifecycle) or None."""
+    if getattr(args, "alert_rules", None) is not None \
+            and args.metrics_port is None:
+        raise SystemExit(
+            "error: --alert-rules requires --metrics-port (the "
+            "evaluator runs inside the metrics sidecar)"
+        )
     if args.metrics_port is None:
         return None
     from gol_tpu.obs.http import MetricsServer
 
+    alerts = None
+    if getattr(args, "alert_rules", None) is not None:
+        from gol_tpu.obs.freshness import AlertEvaluator, load_rules
+
+        try:
+            rules = load_rules(args.alert_rules)
+        except OSError as e:
+            raise SystemExit(f"error: cannot read --alert-rules: {e}") \
+                from None
+        except ValueError as e:
+            raise SystemExit(f"error: {e}") from None
+        alerts = AlertEvaluator(rules)
+        print(f"alert evaluator armed: {len(rules)} rule(s) from "
+              f"{args.alert_rules}")
     srv = MetricsServer(args.metrics_host, args.metrics_port,
-                        health=health).start()
+                        health=health, alerts=alerts).start()
     print(f"metrics serving on http://{srv.address[0]}:{srv.address[1]}"
           "/metrics")
     return srv

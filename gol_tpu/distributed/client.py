@@ -51,6 +51,7 @@ import numpy as np
 from gol_tpu import obs
 from gol_tpu.distributed import wire
 from gol_tpu.obs import flight, tracing
+from gol_tpu.obs.freshness import ClientFreshness, sane_lag
 from gol_tpu.engine.distributor import EventQueue
 from gol_tpu.events import CellFlipped, FlipBatch, TurnComplete
 from gol_tpu.utils.cell import Cell, cells_from_mask, xy_from_mask
@@ -105,6 +106,13 @@ class _ClientMetrics:
             "gol_tpu_client_clock_offset_seconds",
             "Handshake-estimated wall-clock offset to the server "
             "(server_time - client_time; min-RTT probe sample)",
+        )
+        self.turn_age = obs.gauge(
+            "gol_tpu_client_turn_age_seconds",
+            "Seconds this client's APPLIED turn lags the server's "
+            "committed head (freshness plane: head learned from "
+            "stamped events and heartbeat beacons on the corrected "
+            "clock — what an observer actually experiences)",
         )
 
 
@@ -257,6 +265,13 @@ class Controller:
         #: bitmap of the last applied delta frame, reset at every
         #: board sync (the server resets its twin when it sends one).
         self._delta_prev: Optional[np.ndarray] = None
+        #: Freshness plane (gol_tpu.obs.freshness): applied-turn age
+        #: against the server's committed head — the head clock learns
+        #: from stamped turn events/batch frames (emit stamps mapped
+        #: onto the local clock via the PR 5 offset) and heartbeat
+        #: beacons; `turn_age()` is the live reading the canary
+        #: publishes.
+        self.freshness = ClientFreshness()
         hello = {"t": "hello", "want_flips": want_flips,
                  "compact": True, "binary": bool(binary),
                  "levels": bool(levels), "hb": True, "clock": True,
@@ -417,6 +432,14 @@ class Controller:
             raise TimeoutError("no seek-r reply from the server")
         return reply
 
+    def turn_age(self) -> float:
+        """Live applied-turn age in seconds (freshness plane): how far
+        this client's applied board lags the server's committed head —
+        0.0 while current (or before anything is known), growing in
+        real time while behind a live stream. The canary publishes
+        exactly this reading."""
+        return self.freshness.age()
+
     def wait_sync(self, timeout: float = 60.0) -> bool:
         """Block until the attach-time board sync has been applied.
         Returns False IMMEDIATELY once the stream closed or the link
@@ -484,8 +507,11 @@ class Controller:
         the emit→apply lag for stamped TurnCompletes)."""
         t0 = time.perf_counter()
         wall0 = time.time()
+        applied = False
         try:
-            return self._handle_inner(msg)
+            ret = self._handle_inner(msg)
+            applied = True
+            return ret
         finally:
             t = msg.get("t")
             dt = time.perf_counter() - t0
@@ -502,37 +528,94 @@ class Controller:
                 # clock_offset forever unmeasured. Stream-idle links
                 # retry off the heartbeat cadence at worst.
                 self._send_clk()
-            if t == "fbatch":
+            # Everything below requires `applied`: a message that
+            # FAILED to apply (WireError out of the handler, which is
+            # propagating right now — no `return` here, it would
+            # swallow it) must not feed the latency histograms or the
+            # MONOTONE freshness clocks — a rejected frame carrying a
+            # plausible-but-absurd turn (< 2^62) would wedge turn_age
+            # at 0 for the process lifetime, blinding the very canary
+            # this plane exists for.
+            if applied and t == "fbatch":
                 # Per-BATCH latency: emit-of-batch (the frame's one ts
                 # stamp) -> whole batch applied. A separate histogram
                 # on purpose: feeding per-batch readings into the
                 # per-turn series would silently change its semantics
                 # under bench_compare.
+                # The emit stamp crossed the wire: sane_lag is the ONE
+                # validation before it reaches a histogram — a
+                # hostile/absurd ts (negative epoch, 1e18, NaN) is
+                # dropped, never observed (the relay hop's rule,
+                # applied at the leaf too; wire-fuzz-pinned).
                 off = self.clock_offset or 0.0
-                lag = max(0.0, time.time() + off - float(msg["ts"]))
-                _METRICS.batch_latency.observe(lag)
-                tracing.event(
-                    "turn.apply", "wire",
-                    turn=msg["first_turn"] + msg["k"] - 1,
-                    batch=msg["k"], lag_s=round(lag, 6),
+                lag = sane_lag(msg.get("ts"), time.time() + off)
+                if lag is not None:
+                    _METRICS.batch_latency.observe(lag)
+                # Binary frames guarantee these fields (parse-time
+                # validation); a hostile JSON "fbatch" does not, and a
+                # KeyError out of this finally block kills the reader.
+                try:
+                    last = int(msg["first_turn"]) + int(msg["k"]) - 1
+                except (KeyError, TypeError, ValueError):
+                    last = -1  # dropped by the sane_turn guards below
+                # Freshness: the frame's last turn was committed at
+                # ~(now - lag) on the LOCAL clock, and this apply just
+                # caught the client up to it.
+                self.freshness.note_head(
+                    last, None if lag is None else time.time() - lag
                 )
-            if t == "ev" and msg.get("k") == "turn" and "ts" in msg:
+                self.freshness.note_applied(last)
+                _METRICS.turn_age.set(round(self.freshness.age(), 6))
+                tracing.event(
+                    "turn.apply", "wire", turn=last,
+                    batch=msg.get("k"),
+                    lag_s=None if lag is None else round(lag, 6),
+                )
+            if applied and t == "hb":
+                # Beacons carry the committed head turn precisely so
+                # an idle or lagging client can still measure its own
+                # staleness — the head clock advances, the applied
+                # turn does not, and the age gauge tells the truth.
+                self.freshness.note_head(msg.get("turn"))
+                _METRICS.turn_age.set(round(self.freshness.age(), 6))
+            if applied and t == "board":
+                self.freshness.note_head(msg.get("turn"))
+                self.freshness.note_applied(msg.get("turn"))
+                _METRICS.turn_age.set(round(self.freshness.age(), 6))
+            if applied and t == "ev" and msg.get("k") == "turn" \
+                    and "ts" not in msg:
+                # Legacy unstamped servers: the turn event itself is
+                # the freshest head evidence there is.
+                self.freshness.note_head(msg.get("turn"))
+                self.freshness.note_applied(msg.get("turn"))
+                _METRICS.turn_age.set(round(self.freshness.age(), 6))
+            if applied and t == "ev" and msg.get("k") == "turn" \
+                    and "ts" in msg:
                 # The handshake-estimated offset moves this reading
-                # onto the SERVER's timebase (server_now ≈ client_now +
-                # offset), turning the documented cross-host skew into
-                # a measured correction; legacy servers leave the
-                # offset None and the raw subtraction stands. Clamped
-                # at 0: a sub-millisecond negative reading is clock
-                # granularity (or residual probe error), not time
-                # travel.
+                # onto the SERVER's timebase (server_now ≈ client_now
+                # + offset); legacy servers leave the offset None and
+                # the raw subtraction stands. sane_lag clamps sub-zero
+                # readings (clock granularity, not time travel) and
+                # DROPS hostile stamps — a JSON peer can put anything
+                # in "ts", and "abc" used to raise out of this finally
+                # block and kill the reader thread.
                 off = self.clock_offset or 0.0
-                lag = max(0.0, time.time() + off - float(msg["ts"]))
-                _METRICS.turn_latency.observe(lag)
+                lag = sane_lag(msg.get("ts"), time.time() + off)
+                if lag is not None:
+                    _METRICS.turn_latency.observe(lag)
+                self.freshness.note_head(
+                    msg.get("turn"),
+                    None if lag is None else time.time() - lag,
+                )
+                self.freshness.note_applied(msg.get("turn"))
+                _METRICS.turn_age.set(round(self.freshness.age(), 6))
                 # The CLIENT half of the per-turn wire correlation
                 # (pairs with the server's `turn.emit` in merged
                 # timelines).
-                tracing.event("turn.apply", "wire", turn=msg.get("turn"),
-                              lag_s=round(lag, 6))
+                tracing.event(
+                    "turn.apply", "wire", turn=msg.get("turn"),
+                    lag_s=None if lag is None else round(lag, 6),
+                )
 
     def _handle_inner(self, msg: dict) -> bool:
         t = msg.get("t")
@@ -725,8 +808,11 @@ class Controller:
         pinned by the fuzz suite's scripted-server test)."""
         if self.board is None:
             raise wire.WireError("batch frame before any board sync")
-        k, first = int(msg["k"]), int(msg["first_turn"])
+        # apply_fbatch_raster validates/coerces every field first (a
+        # hostile JSON "fbatch" surfaces as WireError there); past it,
+        # these plain conversions cannot fail.
         t0 = apply_fbatch_raster(self.board, msg, self.synced_turn)
+        k, first = int(msg["k"]), int(msg["first_turn"])
         if t0 >= k:
             return  # whole batch already inside the synced raster
         if not self._batch_flip_events:
@@ -739,11 +825,13 @@ class Controller:
             return
         # Exact per-turn surfacing: reconstruct each turn's flip set
         # from the delta chain (the slow-but-faithful mode; identical
-        # to the unbatched event stream, pinned by test).
-        counts = msg["counts"].astype(np.int64)
+        # to the unbatched event stream, pinned by test). asarray, not
+        # .astype: a JSON-carried batch holds plain lists here.
+        counts = np.asarray(msg["counts"], np.int64)
         total, nb = wire.grid_words(self.board.shape[1],
                                     self.board.shape[0])
-        dbm, dwords = msg["dbitmaps"], msg["dwords"]
+        dbm = np.asarray(msg["dbitmaps"], np.uint32).reshape(-1, nb)
+        dwords = np.asarray(msg["dwords"], np.uint32)
         w, h = self.board.shape[1], self.board.shape[0]
         evs: list = []
         cur = np.zeros(total, np.uint32)
@@ -920,14 +1008,23 @@ def apply_fbatch_raster(board: np.ndarray, msg: dict,
     raises WireError on any frame/board inconsistency."""
     h, w = board.shape
     total, nb = wire.grid_words(w, h)
-    if msg["nb"] != nb:
+    try:
+        # Binary frames are parse-validated upstream; a hostile JSON
+        # "fbatch" reaches here with arbitrary fields, and anything
+        # escaping as KeyError/AttributeError would kill reader
+        # threads whose handlers expect WireError/OSError only.
+        msg_nb = int(msg["nb"])
+        counts = np.asarray(msg["counts"], np.int64)
+        k, first = int(msg["k"]), int(msg["first_turn"])
+        dbm = np.asarray(msg["dbitmaps"], np.uint32).reshape(-1, nb)
+        dwords = np.asarray(msg["dwords"], np.uint32)
+    except (KeyError, TypeError, ValueError, AttributeError) as e:
+        raise wire.WireError(f"malformed batch message: {e}") from None
+    if msg_nb != nb:
         raise wire.WireError(
-            f"batch bitmap rows of {msg['nb']} words, this board "
+            f"batch bitmap rows of {msg_nb} words, this board "
             f"needs {nb}"
         )
-    counts = msg["counts"].astype(np.int64)
-    k, first = int(msg["k"]), int(msg["first_turn"])
-    dbm, dwords = msg["dbitmaps"], msg["dwords"]
     if total % 32 and dbm.size and np.any(
             dbm[:, -1] >> np.uint32(total % 32)):
         raise wire.WireError("batch bitmap bit outside the board grid")

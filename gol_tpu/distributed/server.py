@@ -50,6 +50,7 @@ import numpy as np
 from gol_tpu import obs
 from gol_tpu.checkpoint import snapshot_turn
 from gol_tpu.obs import flight, tracing
+from gol_tpu.obs.freshness import ServerFreshness
 from gol_tpu.distributed import wire
 from gol_tpu.relay.writerpool import PoolFull, WriterPool
 from gol_tpu.engine.distributor import Engine
@@ -395,6 +396,18 @@ class _Conn:
         #: Per-peer lag gauge (label evicted at detach) — installed by
         #: the server once the peer is attached.
         self.lag_metric = None
+        #: Freshness plane (gol_tpu.obs.freshness): the last turn
+        #: WRITTEN to this peer — stamped at every successful stream
+        #: send/sync, read by the owning server's ServerFreshness
+        #: sweep to turn "peer is at turn T" into seconds of turn age.
+        #: Shed frames deliberately do not advance it: a degraded
+        #: peer's growing age IS the signal the alert plane watches.
+        self.fresh_turn = -1
+
+    def note_written(self, turn: int) -> None:
+        """Advance the freshness stamp (monotone)."""
+        if turn > self.fresh_turn:
+            self.fresh_turn = turn
 
     def mark_degraded(self) -> None:
         if self.degraded:
@@ -795,6 +808,11 @@ class EngineServer:
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
         publish_listen_addr(self.address)
+        #: Freshness plane (docs/OBSERVABILITY.md "Freshness plane"):
+        #: per-peer turn age vs the engine's committed turn, sampled
+        #: by the broadcaster's per-turn housekeeping and the
+        #: heartbeat sweep (rate-limited inside).
+        self.freshness = ServerFreshness("engine")
         self._conn: Optional[_Conn] = None
         #: Read-only observers fanned out from the same event stream —
         #: the controller ⇄ broker ⇄ workers topology's natural "one
@@ -838,6 +856,10 @@ class EngineServer:
         self.engine.join(timeout=60)
         if self.pool is not None:
             self.pool.close()
+        # A dead server's last worst-age reading must not stay glued
+        # to the registry (fleet AGE columns and max() alert rules
+        # read the family).
+        self.freshness.close()
         self.done.set()
 
     #: Per-peer writer-drain budget at teardown. Writers drain
@@ -1081,6 +1103,7 @@ class EngineServer:
         if removed:  # idempotent under the detach/close double-call
             _METRICS.detaches.inc()
             remove_lag_gauge(conn)
+            self.freshness.forget(conn.token)
             tracing.event("server.detach", "lifecycle", role=conn.role,
                           token=conn.token)
             flight.note("server.detach", role=conn.role, token=conn.token)
@@ -1196,7 +1219,12 @@ class EngineServer:
         while not self._shutdown.wait(interval):
             now = time.monotonic()
             turn = self.engine.completed_turns
-            for conn in self._all_conns():
+            conns = self._all_conns()
+            # Freshness sweep off the liveness cadence: a degraded or
+            # idle peer's turn age keeps moving even when the
+            # broadcaster has nothing to fan out.
+            self.freshness.sample((c, None) for c in conns)
+            for conn in conns:
                 if not conn.writer_started:
                     # Mid-handshake: the attach-ack (which carries the
                     # hb cadence and must be the peer's FIRST message)
@@ -1331,6 +1359,7 @@ class EngineServer:
         k = len(ev.counts)
         last = ev.completed_turns
         _METRICS.chunks.inc()
+        self.freshness.note_commit(last)
         depth = 0
         for c in conns:
             q = c.queued()
@@ -1343,6 +1372,7 @@ class EngineServer:
                     enable_flips=c.want_flips, token=c.token
                 )
         _METRICS.queue_depth.set(depth)
+        self.freshness.sample((c, None) for c in conns)
         tracing.event("turn.emit", "wire", turn=last, batch=k)
         ts = time.time()
         enc: dict = {}
@@ -1371,6 +1401,7 @@ class EngineServer:
                     if expanded is None:
                         expanded = self._expand_chunk(ev)
                     self._send_chunk_expanded(conn, ev, expanded, ts)
+                conn.note_written(last)
             except (wire.WireError, OSError):
                 self._detach(conn)
 
@@ -1515,6 +1546,9 @@ class EngineServer:
                     # its TurnComplete — the checker above asserts that
                     # — but the broadcaster no longer depends on it.
                     target.synced_turn = ev.completed_turns
+                    # A synced raster is the freshest possible write:
+                    # everything up to its turn is inside it.
+                    target.note_written(ev.completed_turns)
                     # The synced raster restarts the delta-of-sparse
                     # chain: the client resets its own prev bitmap on
                     # the board message, so the next flips frame must
@@ -1547,6 +1581,8 @@ class EngineServer:
                             enable_flips=c.want_flips, token=c.token
                         )
                 _METRICS.queue_depth.set(depth)
+                self.freshness.note_commit(ev.completed_turns)
+                self.freshness.sample((c, None) for c in conns)
                 # The SERVER half of the per-turn wire correlation: one
                 # instant mark per broadcast turn, carrying the turn
                 # number — `report merge` pairs it with the client's
@@ -1580,6 +1616,8 @@ class EngineServer:
                         self._send_flips(conn, flips_turn, flips,
                                          flips_levels, delta_words)
                     self._send_stream_event(conn, ev)
+                    if isinstance(ev, (TurnComplete, FinalTurnComplete)):
+                        conn.note_written(ev.completed_turns)
                 except (wire.WireError, OSError):
                     self._detach(conn)
             if flush:
@@ -1652,6 +1690,9 @@ class _SessionSink:
         conn = self._conn
         if conn.lag_metric is not None:
             conn.lag_metric.set(conn.queued())
+        k = len(counts)
+        last = first_turn + k - 1
+        self._server.freshness.note_commit(last, key=sid)
         with conn.seek_gate:
             if conn.scrub:
                 return
@@ -1661,8 +1702,6 @@ class _SessionSink:
                 self.on_sync(sid, mgr.peek_turn(sid),
                              mgr._fetch_board(sid))
                 return
-            k = len(counts)
-            last = first_turn + k - 1
             if not conn.synced or last <= conn.synced_turn:
                 return
             try:
@@ -1679,6 +1718,7 @@ class _SessionSink:
                     )
                 for f in frames:
                     conn.send_raw(f)
+                conn.note_written(last)
             except (wire.WireError, OSError):
                 self._server._drop_conn(conn, detach_sink=False)
                 raise
@@ -1700,6 +1740,7 @@ class _SessionSink:
                 raise
             conn.synced = True
             conn.synced_turn = turn
+            conn.note_written(turn)
             conn.delta_prev = None
             # A degradation-coalesced resync makes the peer whole:
             # every frame it shed is inside this raster, and
@@ -1730,6 +1771,7 @@ class _SessionSink:
         conn = self._conn
         if conn.lag_metric is not None:
             conn.lag_metric.set(conn.queued())
+        self._server.freshness.note_commit(turn, key=sid)
         with conn.seek_gate:
             if conn.scrub:
                 return
@@ -1755,6 +1797,7 @@ class _SessionSink:
                 tracing.event("turn.emit", "wire", turn=turn, session=sid)
                 conn.send({"t": "ev", "k": "turn", "turn": turn,
                            "ts": time.time()})
+                conn.note_written(turn)
             except (wire.WireError, OSError):
                 self._server._drop_conn(conn, detach_sink=False)
                 raise
@@ -1918,6 +1961,10 @@ class SessionServer:
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
         publish_listen_addr(self.address)
+        #: Freshness plane: per-peer turn age against each SESSION's
+        #: own committed turn (clocks keyed by sid — one stalled
+        #: session can never age another session's watchers).
+        self.freshness = ServerFreshness("session")
         self._conn_lock = threading.Lock()
         self._conns: "list[_Conn]" = []
         #: sid -> driving connection (one driver per session).
@@ -1974,6 +2021,7 @@ class SessionServer:
             conn.close()
         if self.pool is not None:
             self.pool.close()
+        self.freshness.close()
         self.done.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -2254,6 +2302,7 @@ class SessionServer:
         if removed:
             _METRICS.detaches.inc()
             remove_lag_gauge(conn)
+            self.freshness.forget(conn.token)
             tracing.event("server.detach", "lifecycle", role=conn.role,
                           token=conn.token)
         if entry is not None and detach_sink and not self._shutdown.is_set():
@@ -2465,6 +2514,10 @@ class SessionServer:
                 reply.update(ok=True, session=info)
             elif op == "destroy":
                 self.manager.destroy(msg.get("id"))
+                # Evict the destroyed session's freshness clock (the
+                # bounded-cardinality discipline: clocks key on sid
+                # and must not accumulate under create/destroy churn).
+                self.freshness.drop_key(msg.get("id"))
                 reply.update(ok=True, id=msg.get("id"))
             elif op == "list":
                 reply.update(ok=True,
@@ -2514,6 +2567,12 @@ class SessionServer:
             with self._conn_lock:
                 conns = list(self._conns)
                 sids = dict((c, s[0]) for c, s in self._sinks.items())
+            # Freshness sweep: session-attached peers age against
+            # THEIR session's clock; control peers (no sink) are not
+            # stream consumers and are skipped.
+            self.freshness.sample(
+                (c, sids[c]) for c in conns if c in sids
+            )
             for conn in conns:
                 if not conn.writer_started:
                     continue

@@ -223,6 +223,25 @@ class Endpoint:
             metrics, "gol_tpu_client_turn_latency_seconds"
         )
         rtt = sum_series(metrics, "gol_tpu_relay_upstream_rtt_seconds")
+        # Freshness plane: the worst turn age this endpoint reports —
+        # a server's worst-peer sweep gauge, a client/canary's own
+        # applied-turn age, whichever is present and worst.
+        ages = [v for v in (
+            max_series(metrics, "gol_tpu_server_worst_turn_age_seconds"),
+            max_series(metrics, "gol_tpu_client_turn_age_seconds"),
+        ) if v is not None]
+        firing = [
+            _labels_of(key)["rule"]
+            for key, v in metrics.items()
+            if _name_of(key) == "gol_tpu_alert_firing" and v >= 1
+            and "rule" in _labels_of(key)
+        ]
+        # The firing COUNT: the evaluator's gauge when present (0
+        # renders as 0 — "no alerts" differs from "no evaluator"),
+        # else derived from the per-rule gauges.
+        alerts_firing = sum_series(metrics, "gol_tpu_alerts_firing")
+        if alerts_firing is None and firing:
+            alerts_firing = float(len(firing))
         return {
             # Topology identity (the relay tier's sidecar labels): how
             # the fan-out tree is joined from scrapes alone.
@@ -266,6 +285,9 @@ class Endpoint:
             "peers": sum_series(metrics, "gol_tpu_server_peers"),
             "peer_lag": max_series(metrics,
                                    "gol_tpu_server_peer_lag_frames"),
+            "turn_age_s": max(ages) if ages else None,
+            "alerts_firing": alerts_firing,
+            "alerts": sorted(firing),
             "degradations": sum_series(
                 metrics, "gol_tpu_server_degradations_total"
             ),
@@ -398,12 +420,19 @@ def fleet_snapshot(endpoints: List[Endpoint]) -> dict:
     merged_lat = merge_cumulative_buckets(
         [r["latency_buckets"] for r in live if r.get("latency_buckets")]
     )
+    ages = [r["turn_age_s"] for r in live
+            if r.get("turn_age_s") is not None]
+    alerts = [{"endpoint": r["endpoint"], "rule": rule}
+              for r in live for rule in (r.get("alerts") or [])]
     total = {
         "endpoints": len(endpoints),
         "up": len(live),
         "turns_per_sec": total_of("turns_per_sec"),
         "sessions": total_of("sessions"),
         "peers": total_of("peers"),
+        "turn_age_s": max(ages) if ages else None,
+        "alerts_firing": total_of("alerts_firing"),
+        "alerts": alerts,
         "degradations": total_of("degradations"),
         "compiles": total_of("compiles"),
         "violations": total_of("violations"),
@@ -446,6 +475,8 @@ _COLUMNS = (
     ("sessions", "SESS", 5, ""),
     ("peers", "PEERS", 5, ""),
     ("peer_lag", "LAG", 5, ""),
+    ("turn_age_s", "AGE", 8, "s"),
+    ("alerts_firing", "ALRT", 4, ""),
     ("degradations", "DEGR", 5, ""),
     ("reconnects", "RECON", 5, ""),
     ("clock_offset_s", "CLOCK", 8, "s"),
@@ -511,6 +542,8 @@ def render(snap: dict, out=None, clear: bool = False) -> None:
     tree = snap.get("tree") or []
     if any(n["children"] or n.get("upstream") for n in tree):
         render_tree(tree, out)
+    for a in snap["total"].get("alerts") or []:
+        w(f"!! ALERT firing on {a['endpoint']}: {a['rule']}\n")
     viol = snap["total"].get("violations")
     if viol:
         w(f"!! INVARIANT VIOLATIONS across the fleet: {int(viol)}\n")
@@ -529,7 +562,8 @@ def main(argv: Optional[list] = None) -> int:
                          "loopback; full http:// URLs accepted)")
     ap.add_argument("--once", action="store_true",
                     help="print one snapshot and exit (CI mode; exits 1 "
-                         "if any endpoint is down)")
+                         "if any endpoint is down, 2 if any alert rule "
+                         "is firing)")
     ap.add_argument("--interval", type=float, default=2.0, metavar="SEC",
                     help="live-mode refresh cadence (default 2)")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -547,7 +581,12 @@ def main(argv: Optional[list] = None) -> int:
             print(json.dumps(snap, indent=1))
         else:
             render(snap)
-        return 1 if snap["down"] else 0
+        if snap["down"]:
+            return 1
+        # Firing alerts are a CI failure too (freshness plane): the
+        # distinct code lets a harness tell "endpoint down" from
+        # "SLO broken".
+        return 2 if snap["total"].get("alerts") else 0
     try:
         while True:
             snap = fleet_snapshot(eps)

@@ -1,5 +1,5 @@
 """Metrics HTTP sidecar — `/metrics`, `/healthz`, `/vars`, `/trace`,
-`/flightrecorder` on a live engine.
+`/flightrecorder`, `/alerts` on a live engine.
 
 Opt-in (`--metrics-port` in the CLI, or `MetricsServer(...)` from
 library code): a ThreadingHTTPServer on its own daemon thread serving
@@ -16,7 +16,12 @@ library code): a ThreadingHTTPServer on its own daemon thread serving
 - `/flightrecorder`  the live black box (gol_tpu.obs.flight): recent
               lifecycle notes, metric deltas, spans and the current
               state snapshot — what a crash dump WOULD contain, for a
-              process that is still alive.
+              process that is still alive;
+- `/alerts`   the freshness plane's SLO evaluator state
+              (gol_tpu.obs.freshness, CLI --alert-rules): every rule
+              with its ok/pending/firing state and last value, plus
+              the firing count — sane (empty rules, firing 0) when no
+              rules are loaded.
 
 With the plane disabled (`GOL_TPU_METRICS=0`) the last two return an
 explicit `{"enabled": false}` payload so a scraper can tell "disabled"
@@ -47,12 +52,21 @@ class MetricsServer:
     `health` is an optional zero-arg callable returning a JSON-able
     dict; it is invoked per `/healthz` request from the HTTP thread, so
     it must be cheap and must not touch the device (Engine.health and
-    EngineServer.health read only host-side committed state)."""
+    EngineServer.health read only host-side committed state).
+
+    `alerts` is an optional `freshness.AlertEvaluator`: the sidecar
+    OWNS it — `start()` starts its evaluation thread, `close()` stops
+    it — and `/alerts` serves its JSON state. Without one, `/alerts`
+    answers the explicit empty shape (a scraper must be able to tell
+    "no rules configured" from 404-means-old-build)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  registry: Optional[Registry] = None,
-                 health: Optional[Callable[[], dict]] = None):
+                 health: Optional[Callable[[], dict]] = None,
+                 alerts=None):
         reg = registry if registry is not None else REGISTRY
+        self.alerts = alerts
+        srv = self  # the handler closes over the sidecar instance
 
         class _Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # no access-log spam on stderr
@@ -92,6 +106,12 @@ class MetricsServer:
                         json.dumps(flight.payload(), indent=1).encode(),
                         "application/json",
                     )
+                elif path == "/alerts":
+                    ev = srv.alerts
+                    body = (ev.payload() if ev is not None
+                            else {"rules": [], "firing": 0})
+                    self._reply(200, json.dumps(body, indent=1).encode(),
+                                "application/json")
                 elif path == "/healthz":
                     try:
                         info = dict(health()) if health is not None \
@@ -115,9 +135,13 @@ class MetricsServer:
 
     def start(self) -> "MetricsServer":
         self._thread.start()
+        if self.alerts is not None:
+            self.alerts.start()
         return self
 
     def close(self) -> None:
+        if self.alerts is not None:
+            self.alerts.close()
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)

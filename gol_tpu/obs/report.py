@@ -92,6 +92,72 @@ def merge_traces(dumps: list, labels: Optional[list] = None) -> dict:
     }
 
 
+def hop_legs(merged: dict) -> dict:
+    """Per-hop lag attribution over a merged trace (freshness plane,
+    docs/OBSERVABILITY.md): every tier marks each turn on the SAME
+    root-corrected timebase — `turn.emit` at the root, `turn.forward`
+    (with `args.depth`) at each relay hop, `turn.apply` at the leaf
+    client — so the end-to-end emit→apply time of a turn decomposes
+    EXACTLY into per-hop legs by differencing successive marks. The
+    legs sum to the end-to-end number by construction (it is the same
+    telescoping difference); clock skew cancels because each dump's
+    own measured offset already shifted it onto the root timebase
+    (the per-hop PR 5 snap-to-zero rules apply before that offset is
+    ever published).
+
+    Returns {"turns": N, "end_to_end_mean_s": ..., "legs": [{"leg":
+    label, "mean_s": ..., "max_s": ...}, ...]} over every turn that
+    has both an emit and an apply mark (reconnect replays keep the
+    earliest mark per stage, like turn_pairs)."""
+    stages: dict = {}
+    for ev in merged.get("traceEvents", []):
+        name = ev.get("name")
+        if name not in ("turn.emit", "turn.forward", "turn.apply"):
+            continue
+        args = ev.get("args") or {}
+        turn = args.get("turn")
+        if turn is None:
+            continue
+        ts = ev.get("ts", 0.0)
+        slot = stages.setdefault(int(turn), {})
+        if name == "turn.forward":
+            depth = args.get("depth")
+            if depth is None:
+                continue
+            key = ("fwd", int(depth))
+        else:
+            key = (name.split(".")[1],)
+        if key not in slot or ts < slot[key]:
+            slot[key] = ts
+    legs: dict = {}
+    e2e = []
+    for slot in stages.values():
+        emit = slot.get(("emit",))
+        apply_ts = slot.get(("apply",))
+        if emit is None or apply_ts is None or apply_ts < emit:
+            continue
+        hops = sorted(
+            (key[1], ts) for key, ts in slot.items()
+            if key[0] == "fwd" and emit <= ts <= apply_ts
+        )
+        chain = [("emit", emit)] + [
+            (f"hop{d}", ts) for d, ts in hops
+        ] + [("apply", apply_ts)]
+        e2e.append(apply_ts - emit)
+        for (a, ta), (b, tb) in zip(chain, chain[1:]):
+            legs.setdefault(f"{a}→{b}", []).append(tb - ta)
+    return {
+        "turns": len(e2e),
+        "end_to_end_mean_s": (sum(e2e) / len(e2e) / 1e6) if e2e else None,
+        "legs": [
+            {"leg": name,
+             "mean_s": sum(vals) / len(vals) / 1e6,
+             "max_s": max(vals) / 1e6}
+            for name, vals in sorted(legs.items())
+        ],
+    }
+
+
 def turn_pairs(merged: dict) -> dict:
     """{turn: {"emit": ts_us, "apply": ts_us}} from a merged trace —
     the per-turn wire correlation the acceptance ordering is judged on
@@ -155,6 +221,21 @@ def replay_summary(log_dir: str, turn: int,
 def _cmd_merge(args) -> int:
     dumps = [load_trace(p) for p in args.paths]
     merged = merge_traces(dumps, labels=args.label)
+    if args.hops:
+        hops = hop_legs(merged)
+        merged["metadata"]["hops"] = hops
+        if not hops["turns"]:
+            print("hops: no turn with both an emit and an apply mark "
+                  "(merge a root, its relays and a leaf client)",
+                  file=sys.stderr)
+        else:
+            print(f"hops: {hops['turns']} turns decomposed, "
+                  f"end-to-end mean "
+                  f"{hops['end_to_end_mean_s'] * 1e3:.2f}ms")
+            for leg in hops["legs"]:
+                print(f"  {leg['leg']:<16} mean "
+                      f"{leg['mean_s'] * 1e3:8.2f}ms   max "
+                      f"{leg['max_s'] * 1e3:8.2f}ms")
     if args.replay_to is not None:
         if not args.replay_log:
             print("error: --replay-to needs --replay-log LOG-DIR",
@@ -345,6 +426,14 @@ def main(argv: Optional[list] = None) -> int:
                     help="override process labels, in input order "
                          "(repeatable — useful when merging N relays "
                          "that all call themselves 'connect')")
+    mp.add_argument("--hops", action="store_true",
+                    help="per-hop lag attribution (freshness plane): "
+                         "decompose each turn's emit→apply time into "
+                         "per-hop legs from the merged turn.emit / "
+                         "turn.forward / turn.apply marks — the legs "
+                         "sum to the end-to-end number exactly; the "
+                         "table prints and the breakdown lands in "
+                         "metadata.hops")
     mp.add_argument("--replay-to", type=int, default=None,
                     dest="replay_to", metavar="TURN",
                     help="time-travel debugging (gol_tpu.replay): "

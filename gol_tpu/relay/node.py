@@ -58,6 +58,7 @@ from gol_tpu.distributed.server import (
     remove_lag_gauge,
 )
 from gol_tpu.obs import flight, tracing
+from gol_tpu.obs.freshness import ServerFreshness, sane_lag
 from gol_tpu.relay import ws as wsproto
 from gol_tpu.relay.writerpool import WriterPool
 
@@ -108,6 +109,13 @@ class _RelayMetrics:
             "gol_tpu_relay_rejects_total",
             "Downstream attaches rejected (bad hello, capability "
             "mismatch, capacity, auth)",
+        )
+        self.forward_latency = obs.histogram(
+            "gol_tpu_relay_forward_latency_seconds",
+            "Root emit stamp -> frame arrival at THIS hop, on the "
+            "summed per-hop corrected clock — successive tiers' "
+            "readings decompose emit->leaf-apply into per-hop legs "
+            "(docs/OBSERVABILITY.md \"Freshness plane\")",
         )
 
 
@@ -241,6 +249,9 @@ class RelayNode:
         self._shutdown = threading.Event()
         self.done = threading.Event()
         self._threads: "list[threading.Thread]" = []
+        #: Freshness plane: downstream peers age against the relay's
+        #: shadow turn (advanced by every upstream frame).
+        self.freshness = ServerFreshness("relay")
         _METRICS.depth.set(self.depth)
         self._info_gauge()
 
@@ -307,6 +318,7 @@ class RelayNode:
         # accumulate dead tree roots in the process-global registry.
         obs.registry().remove("gol_tpu_relay_node_info",
                               self._info_labels())
+        self.freshness.close()
         self.done.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -476,6 +488,14 @@ class RelayNode:
                 self._on_upstream_board(msg, payload)
                 continue
             if t == "fbatch":
+                # Per-hop forward latency: the frame's root emit stamp
+                # against THIS hop's arrival, on the summed corrected
+                # clock — hostile/absurd stamps are dropped, never
+                # observed (sane_lag; the wire fuzz pin).
+                lag = sane_lag(msg.get("ts"),
+                               time.time() + (self.clock_offset or 0.0))
+                if lag is not None:
+                    _METRICS.forward_latency.observe(lag)
                 with self._board_lock:
                     if self.board is None:
                         raise wire.WireError(
@@ -486,6 +506,7 @@ class RelayNode:
                         self.turn,
                         int(msg["first_turn"]) + int(msg["k"]) - 1,
                     )
+                    self.freshness.note_commit(self.turn)
                     self._forward(payload,
                                   last_turn=int(msg["first_turn"])
                                   + int(msg["k"]) - 1, flips=True)
@@ -505,8 +526,13 @@ class RelayNode:
                                   flips=True)
                 continue
             if t == "ev" and msg.get("k") == "turn":
+                lag = sane_lag(msg.get("ts"),
+                               time.time() + (self.clock_offset or 0.0))
+                if lag is not None:
+                    _METRICS.forward_latency.observe(lag)
                 with self._board_lock:
                     self.turn = max(self.turn, int(msg.get("turn", 0)))
+                    self.freshness.note_commit(self.turn)
                     self._forward(payload,
                                   last_turn=int(msg.get("turn", 0)))
                 continue
@@ -547,6 +573,11 @@ class RelayNode:
         self.upstream_rtt = rtt
         _METRICS.clock_offset.set(off)
         _METRICS.rtt.set(rtt)
+        # The relay's trace dump joins merged timelines on the ROOT's
+        # timebase (upstream echoes are already root-adjusted, so the
+        # summed offset is exactly report merge's correction) — what
+        # makes the per-hop `turn.forward` marks decomposable.
+        tracing.set_clock_offset(off)
         tracing.event("relay.clock_sync", "lifecycle",
                       offset_s=round(off, 6), rtt_s=round(rtt, 6))
 
@@ -587,6 +618,14 @@ class RelayNode:
         that did not subscribe to the flip plane (a -noVis leaf wants
         alive ticks and the final, not the raster stream)."""
         conns = self._all_conns()
+        if last_turn is not None:
+            # The hop's half of the per-turn wire correlation: one
+            # instant mark per forwarded frame, on this dump's (root-
+            # corrected) timebase — `report merge --hops` differences
+            # successive tiers' marks into per-hop legs.
+            tracing.event("turn.forward", "wire", turn=last_turn,
+                          depth=self.depth)
+        self.freshness.sample((c, None) for c in conns)
         for conn in conns:
             if conn.lag_metric is not None:
                 conn.lag_metric.set(conn.queued())
@@ -603,6 +642,8 @@ class RelayNode:
                 if not control and not conn.offer_stream():
                     continue
                 conn.send_raw(payload)
+                if last_turn is not None:
+                    conn.note_written(last_turn)
                 _METRICS.forwarded.inc()
                 _METRICS.forwarded_bytes.inc(len(payload))
             except (wire.WireError, OSError):
@@ -621,6 +662,7 @@ class RelayNode:
             return
         conn.synced = True
         conn.synced_turn = self.turn
+        conn.note_written(self.turn)
         conn.delta_prev = None
         conn.mark_recovered()
 
@@ -772,6 +814,7 @@ class RelayNode:
                 _METRICS.ws_peers.dec()
         if removed:
             remove_lag_gauge(conn)
+            self.freshness.forget(conn.token)
             tracing.event("relay.detach", "lifecycle", token=conn.token)
         conn.close()
 
@@ -926,7 +969,9 @@ class RelayNode:
         interval = max(0.05, self.heartbeat_secs / 2.0)
         while not self._shutdown.wait(interval):
             now = time.monotonic()
-            for conn in self._all_conns():
+            conns = self._all_conns()
+            self.freshness.sample((c, None) for c in conns)
+            for conn in conns:
                 if not conn.writer_started:
                     continue
                 if conn.degraded:

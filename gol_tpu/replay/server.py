@@ -47,6 +47,7 @@ from gol_tpu.distributed.server import (
     remove_lag_gauge,
 )
 from gol_tpu.obs import flight, tracing
+from gol_tpu.obs.freshness import ServerFreshness
 from gol_tpu.relay.writerpool import WriterPool
 from gol_tpu.replay.log import (
     fbatch_span,
@@ -177,6 +178,10 @@ class ReplayServer:
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
         publish_listen_addr(self.address)
+        #: Freshness plane: observers age against their recording's
+        #: PUMP position (clocks keyed by sid) — a replay tier serves
+        #: the same turn-age SLO a live tier does.
+        self.freshness = ServerFreshness("replay")
         self.pool = (WriterPool(writer_pool_threads, "gol-replay-writer")
                      if writer_pool_threads > 0 else None)
         self._conn_lock = threading.Lock()
@@ -234,6 +239,7 @@ class ReplayServer:
             conn.close()
         if self.pool is not None:
             self.pool.close()
+        self.freshness.close()
         self.done.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -357,6 +363,7 @@ class ReplayServer:
                 try:
                     self._send_catchup(conn, rec.keyframe_turn,
                                        rec.catchup)
+                    conn.note_written(rec.turn)
                 except (wire.WireError, OSError):
                     self._drop_conn(conn)
                     return
@@ -386,6 +393,7 @@ class ReplayServer:
         if removed:
             _SRV.detaches.inc()
             remove_lag_gauge(conn)
+            self.freshness.forget(conn.token)
             tracing.event("replay.detach", "lifecycle", token=conn.token)
         conn.close()
 
@@ -432,12 +440,14 @@ class ReplayServer:
                         rec.catchup = [payload]
                         rec.keyframe_turn = seg_turn
                         rec.turn = max(rec.turn, seg_turn)
+                        self.freshness.note_commit(rec.turn, key=rec.sid)
                         for conn in list(rec.conns):
                             if conn.scrub:
                                 continue
                             try:
                                 self._send_catchup(conn, seg_turn,
                                                    [payload])
+                                conn.note_written(rec.turn)
                             except (wire.WireError, OSError):
                                 self._drop_conn(conn)
                 else:
@@ -452,6 +462,7 @@ class ReplayServer:
                             _METRICS.turns.inc(last - max(rec.turn,
                                                           first - 1))
                             rec.turn = last
+                            self.freshness.note_commit(last, key=rec.sid)
                         self._broadcast(rec, payload, last)
                     _METRICS.position.set(max(
                         r.turn for r in self._recordings.values()
@@ -478,6 +489,7 @@ class ReplayServer:
                 with contextlib.suppress(wire.WireError, OSError):
                     self._send_catchup(conn, rec.keyframe_turn,
                                        rec.catchup)
+                    conn.note_written(rec.turn)
                 continue
             if not conn.synced or last_turn <= conn.synced_turn:
                 continue
@@ -485,6 +497,7 @@ class ReplayServer:
                 if not conn.offer_stream():
                     continue
                 conn.send_raw(payload)
+                conn.note_written(last_turn)
                 _METRICS.frames.inc()
                 _METRICS.bytes.inc(len(payload))
             except (wire.WireError, OSError):
@@ -554,6 +567,10 @@ class ReplayServer:
             now = time.monotonic()
             with self._conn_lock:
                 conns = list(self._conns)
+                recs = dict(self._by_conn)
+            self.freshness.sample(
+                (c, recs[c].sid) for c in conns if c in recs
+            )
             for conn in conns:
                 if not conn.writer_started:
                     continue

@@ -82,7 +82,13 @@ LOWER_BETTER = re.compile(
     # Deliberately the `_delta` spelling only: the live A/B points
     # report their (legitimately nonzero) dispatch counts under
     # `engine_dispatches`, which stays informational.
-    r"|dispatch_delta)", re.I
+    r"|dispatch_delta"
+    # Freshness plane (ISSUE 15): turn-age percentiles ride the
+    # `seconds`/pNN rules above; `alerts_firing` sits at 0 on a
+    # healthy bench box, so any capture where it moves off a zero
+    # baseline gates as an infinite regression — the SLO evaluator
+    # itself saw the lane break.
+    r"|turn_age|alerts_firing)", re.I
 )
 
 

@@ -1,0 +1,325 @@
+#!/usr/bin/env bash
+# Freshness smoke (ISSUE 15 acceptance): a real root + 2-level relay
+# tree + canaries, three assertions on live processes:
+#   1. ATTRIBUTION — merge the four tiers' /trace dumps and prove the
+#      per-hop legs (emit -> hop1 -> hop2 -> leaf apply) SUM to the
+#      end-to-end turn age within tolerance (report merge --hops).
+#   2. ALERTING — stall one relay's downstream reader (the PR 7
+#      degradation path: queue fills, frames shed, the peer's turn age
+#      grows); assert the turn-age rule FIRES on the relay's /alerts,
+#      `obs.console --once` exits NONZERO while it fires, and the
+#      alert RESOLVES after the reader drains (coalesced BoardSync).
+#   3. REPLAY CANARY — record a real --sessions --record run, SIGKILL
+#      it, serve it with --replay, and assert a canary attached to the
+#      replay server reports BOUNDED age while the replay process has
+#      no engine dispatch series at all (dispatches flat structurally).
+#
+# Usage: scripts/freshness_smoke.sh   (CPU-safe; ~2-3 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export GOL_TPU_CHECK_INVARIANTS=1
+LOG_ROOT=$(mktemp) LOG_R1=$(mktemp) LOG_R2=$(mktemp)
+LOG_CAN=$(mktemp) LOG_REC=$(mktemp) LOG_RPL=$(mktemp)
+OUT=$(mktemp -d) TRACES=$(mktemp -d)
+RULES="$OUT/alerts.rules"
+cleanup() {
+    for p in "${PID_CAN:-}" "${PID_RPL:-}" "${PID_REC:-}" \
+             "${PID_R2:-}" "${PID_R1:-}" "${PID_ROOT:-}"; do
+        [ -n "$p" ] && kill "$p" 2>/dev/null || true
+    done
+    for p in "${PID_CAN:-}" "${PID_RPL:-}" "${PID_REC:-}" \
+             "${PID_R2:-}" "${PID_R1:-}" "${PID_ROOT:-}"; do
+        [ -n "$p" ] && wait "$p" 2>/dev/null || true
+    done
+    rm -rf "$LOG_ROOT" "$LOG_R1" "$LOG_R2" "$LOG_CAN" "$LOG_REC" \
+        "$LOG_RPL" "$OUT" "$TRACES"
+}
+trap cleanup EXIT
+
+wait_addr() {  # $1 log, $2 sed pattern -> prints host:port
+    local addr=""
+    for _ in $(seq 1 240); do
+        addr=$(sed -n "$2" "$1" | head -1)
+        [ -n "$addr" ] && break
+        sleep 0.5
+    done
+    if [ -z "$addr" ]; then
+        echo "freshness smoke: FAILED — no address in $1:" >&2
+        cat "$1" >&2
+        exit 1
+    fi
+    echo "$addr"
+}
+
+MX_PAT='s#^metrics serving on \(http://[^/]*\)/metrics$#\1#p'
+
+# The SLO under test: any peer of this process more than 2s behind the
+# committed turn, sustained 2s, is an incident.
+cat >"$RULES" <<'EOF'
+turn_age: max(gol_tpu_server_worst_turn_age_seconds) > 2 for 2s
+violations: gol_tpu_invariant_violations_total > 0
+EOF
+
+# --- the tree: root + 2 chained relays + a leaf canary -----------------
+# --batch-turns 16 caps the chunk size, so the tree carries tens of
+# frames per second — the stalled reader's 64-frame queue must be
+# fillable inside the smoke's window (a 1024-turn chunk cadence would
+# take minutes to cross high-water).
+python -m gol_tpu --serve 127.0.0.1:0 -noVis -t 2 -w 512 -h 512 \
+    -turns 1000000000 --images fixtures/images --out "$OUT/root" \
+    --batch-turns 16 --platform cpu --metrics-port 0 >"$LOG_ROOT" 2>&1 &
+PID_ROOT=$!
+ROOT=$(wait_addr "$LOG_ROOT" 's#^engine serving on \(.*\)$#\1#p')
+ROOT_MX=$(wait_addr "$LOG_ROOT" "$MX_PAT")
+echo "root at $ROOT (metrics $ROOT_MX)"
+
+python -m gol_tpu --relay "$ROOT" --serve 127.0.0.1:0 --platform cpu \
+    --metrics-port 0 --alert-rules "$RULES" --high-water 64 \
+    --drain-secs 600 >"$LOG_R1" 2>&1 &
+PID_R1=$!
+R1=$(wait_addr "$LOG_R1" 's#^relay serving on \([^ ]*\) .*$#\1#p')
+R1_MX=$(wait_addr "$LOG_R1" "$MX_PAT")
+grep -q "alert evaluator armed: 2 rule" "$LOG_R1" || {
+    echo "freshness smoke: FAILED — relay1 did not arm the rules" >&2
+    cat "$LOG_R1" >&2; exit 1
+}
+echo "relay1 at $R1 (metrics $R1_MX, alert rules armed)"
+
+python -m gol_tpu --relay "$R1" --serve 127.0.0.1:0 --platform cpu \
+    --metrics-port 0 >"$LOG_R2" 2>&1 &
+PID_R2=$!
+R2=$(wait_addr "$LOG_R2" 's#^relay serving on \([^ ]*\) .*$#\1#p')
+R2_MX=$(wait_addr "$LOG_R2" "$MX_PAT")
+echo "relay2 at $R2 (metrics $R2_MX)"
+
+# A typo'd rule file must be a STARTUP error, never a crashed sidecar.
+echo "broken rule !!" >"$OUT/bad.rules"
+if python -m gol_tpu --relay "$R1" --serve 127.0.0.1:0 --platform cpu \
+    --metrics-port 0 --alert-rules "$OUT/bad.rules" >/dev/null 2>&1
+then
+    echo "freshness smoke: FAILED — bad rule file did not abort" >&2
+    exit 1
+fi
+echo "bad rule file aborts at startup OK"
+
+# Leaf canary: a real batching observer on the depth-2 relay,
+# publishing MEASURED end-to-end freshness on its own sidecar.
+python -m gol_tpu.obs.canary "$R2" --interval 0.5 --duration 25 \
+    --max-age 2.0 --json --metrics-port 0 >"$LOG_CAN" 2>&1 &
+PID_CAN=$!
+CAN_MX=$(wait_addr "$LOG_CAN" "$MX_PAT")
+echo "canary watching $R2 (metrics $CAN_MX)"
+sleep 8
+
+# --- 1: per-hop attribution --------------------------------------------
+for pair in "root:$ROOT_MX" "r1:$R1_MX" "r2:$R2_MX" "canary:$CAN_MX"; do
+    name="${pair%%:*}" base="${pair#*:}"
+    curl -sf "$base/trace" >"$TRACES/$name.json"
+done
+JAX_PLATFORMS=cpu python - "$TRACES" <<'PYEOF'
+import json
+import sys
+
+from gol_tpu.obs.report import hop_legs, load_trace, merge_traces
+
+d = sys.argv[1]
+dumps = [load_trace(f"{d}/{n}.json")
+         for n in ("root", "r1", "r2", "canary")]
+merged = merge_traces(dumps, labels=["root", "r1", "r2", "canary"])
+hops = hop_legs(merged)
+assert hops["turns"] >= 5, f"too few decomposable turns: {hops}"
+legs = {x["leg"]: x["mean_s"] for x in hops["legs"]}
+names = set(legs)
+assert {"emit→hop1", "hop1→hop2", "hop2→apply"} <= names, names
+total = sum(legs.values())
+e2e = hops["end_to_end_mean_s"]
+# The acceptance tolerance: legs must reconstruct the measured
+# end-to-end age (the decomposition is exact per turn; means agree
+# to float noise).
+assert abs(total - e2e) <= max(1e-6, 0.01 * e2e), (total, e2e)
+print(f"attribution OK: {hops['turns']} turns, "
+      f"e2e {e2e * 1e3:.2f}ms = "
+      + " + ".join(f"{legs[k] * 1e3:.2f}ms" for k in sorted(legs)))
+PYEOF
+
+wait "$PID_CAN" && CAN_RC=0 || CAN_RC=$?
+PID_CAN=""
+if [ "$CAN_RC" -ne 0 ]; then
+    echo "freshness smoke: FAILED — leaf canary exit $CAN_RC:" >&2
+    cat "$LOG_CAN" >&2
+    exit 1
+fi
+grep -q '"ok": true' "$LOG_CAN"
+echo "leaf canary OK (bounded end-to-end age through 2 relay hops)"
+
+# --- 2: stall -> alert fires -> console nonzero -> drain -> resolves ---
+JAX_PLATFORMS=cpu python - "$R1" "$R1_MX" <<'PYEOF'
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+from gol_tpu.distributed import wire
+
+
+def alerts(base):
+    return json.loads(urllib.request.urlopen(
+        base + "/alerts", timeout=10).read())
+
+
+def firing(base):
+    return {r["name"] for r in alerts(base)["rules"]
+            if r["state"] == "firing"}
+
+
+host, _, port = sys.argv[1].rpartition(":")
+base = sys.argv[2]
+assert alerts(base)["firing"] == 0, alerts(base)
+
+# The stalled reader: attach as a real binary observer, then stop
+# reading entirely — the writer queue fills, PR 7 degradation sheds
+# frames, and this peer's turn age grows in real time.
+s = socket.create_connection((host, int(port)), timeout=30)
+s.settimeout(30)
+wire.send_msg(s, {"t": "hello", "want_flips": True, "binary": True,
+                  "role": "observe"})
+time.sleep(1.0)  # sync + stream a little first
+
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    if "turn_age" in firing(base):
+        break
+    time.sleep(0.5)
+else:
+    raise SystemExit(f"turn-age alert never fired: {alerts(base)}")
+print("turn-age alert FIRING against the stalled reader")
+
+# CI contract: the console sees it and exits nonzero (2 = alerts).
+rc = subprocess.run(
+    [sys.executable, "-m", "gol_tpu.obs.console", base,
+     "--once", "--json"],
+    stdout=subprocess.PIPE, timeout=60,
+).returncode
+assert rc == 2, f"console --once exit {rc} while an alert fires"
+print("console --once exits 2 while firing")
+
+# Drain: read flat out -> queue empties -> coalescing BoardSync makes
+# the peer whole -> age collapses -> the rule resolves.
+stop = threading.Event()
+
+
+def drain():
+    try:
+        s.settimeout(2)
+        while not stop.is_set() and s.recv(1 << 20):
+            pass
+    except OSError:
+        pass
+
+
+threading.Thread(target=drain, daemon=True).start()
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    if "turn_age" not in firing(base):
+        break
+    time.sleep(0.5)
+else:
+    raise SystemExit(f"alert never resolved: {alerts(base)}")
+print("turn-age alert RESOLVED after the drain")
+stop.set()
+
+rc = subprocess.run(
+    [sys.executable, "-m", "gol_tpu.obs.console", base,
+     "--once", "--json"],
+    stdout=subprocess.PIPE, timeout=60,
+).returncode
+assert rc == 0, f"console --once exit {rc} after resolve"
+print("console --once exits 0 after resolve")
+s.close()
+PYEOF
+
+kill "$PID_R2" "$PID_R1" "$PID_ROOT" 2>/dev/null || true
+wait "$PID_R2" "$PID_R1" "$PID_ROOT" 2>/dev/null || true
+PID_R2="" PID_R1="" PID_ROOT=""
+
+# --- 3: replay-server canary -------------------------------------------
+python -m gol_tpu --serve 127.0.0.1:0 --sessions --record \
+    --keyframe-turns 128 -noVis -t 1 -w 512 -h 512 \
+    --images fixtures/images --out "$OUT/rec" --platform cpu \
+    >"$LOG_REC" 2>&1 &
+PID_REC=$!
+REC=$(wait_addr "$LOG_REC" 's#^session engine serving on \(.*\)$#\1#p')
+echo "recording server at $REC"
+JAX_PLATFORMS=cpu python - "$REC" <<'PYEOF'
+import sys
+import time
+
+from gol_tpu.distributed import Controller, SessionControl
+
+host, _, port = sys.argv[1].rpartition(":")
+ctl = SessionControl(host, int(port))
+ctl.create("canary-tape", width=512, height=512, seed=11)
+# Watch it so the interactive chunk cadence tapes a dense stream.
+w = Controller(host, int(port), session="canary-tape", observe=True,
+               want_flips=True, batch=True, batch_turns=256,
+               batch_flip_events=False)
+assert w.wait_sync(120)
+time.sleep(6)
+print("taped to turn", w.sync_turn, flush=True)
+w.close()
+ctl.close()
+PYEOF
+kill -9 "$PID_REC" 2>/dev/null || true
+wait "$PID_REC" 2>/dev/null || true
+PID_REC=""
+echo "recording server SIGKILLed (torn tail is part of the test)"
+
+python -m gol_tpu --replay "$OUT/rec/sessions" --serve 127.0.0.1:0 \
+    --platform cpu --metrics-port 0 >"$LOG_RPL" 2>&1 &
+PID_RPL=$!
+RPL=$(wait_addr "$LOG_RPL" 's#^replay serving on \([^ ]*\) .*$#\1#p')
+RPL_MX=$(wait_addr "$LOG_RPL" "$MX_PAT")
+echo "replay server at $RPL (metrics $RPL_MX)"
+
+python -m gol_tpu.obs.canary "$RPL" --session canary-tape \
+    --interval 0.5 --duration 6 --max-age 3.0 --json >"$LOG_CAN" 2>&1 \
+    || { echo "freshness smoke: FAILED — replay canary:" >&2;
+         cat "$LOG_CAN" >&2; exit 1; }
+grep -q '"ok": true' "$LOG_CAN"
+echo "replay canary OK (bounded age from recorded bytes)"
+
+# Dispatches flat: the family registers at import, so it may exist at
+# 0 — but serving the canary must never have moved it (the replay_smoke
+# rule). Meanwhile the replay tier's own freshness series must be live.
+curl -sf "$RPL_MX/metrics" >"$OUT/replay_metrics.txt"
+python - "$OUT/replay_metrics.txt" <<'PYEOF'
+import sys
+
+text = open(sys.argv[1]).read()
+
+
+def total(name):
+    tot = 0.0
+    for line in text.splitlines():
+        head = line.split(" ")[0]
+        if head == name or head.startswith(name + "{"):
+            tot += float(line.rsplit(" ", 1)[1])
+    return tot
+
+
+for fam in ("gol_tpu_engine_dispatches_total",
+            "gol_tpu_session_dispatches_total",
+            "gol_tpu_stepper_dispatches_total"):
+    v = total(fam)
+    assert v == 0.0, f"{fam} moved to {v} on a REPLAY server"
+assert "gol_tpu_server_turn_age_seconds" in text, \
+    "no replay-tier turn-age series"
+assert total("gol_tpu_replay_serves_total") >= 1
+print("replay dispatches flat + freshness series live")
+PYEOF
+
+echo "freshness smoke: PASS"

@@ -1,0 +1,723 @@
+"""The freshness plane (ISSUE 15, docs/OBSERVABILITY.md "Freshness
+plane"):
+
+- TurnClock / ServerFreshness / ClientFreshness math: turn-number
+  staleness expressed in SECONDS, paused streams aging nobody, hostile
+  values dropped, bounded history, bounded per-peer cardinality.
+- Alert rules: the grammar catalog, parse errors as ValueError (the
+  CLI's startup-error contract), the for:-duration hold, firing and
+  resolve transitions with counters + per-rule gauges, quantile and
+  rate aggregations over real exposition text, evaluation that can
+  never crash the sidecar.
+- /alerts endpoint on the metrics sidecar, sane with zero rules.
+- Per-hop attribution: a synthetic 3-hop chain with injected per-hop
+  delays decomposes into legs that sum to the end-to-end age EXACTLY,
+  and a 5s clock skew on one hop's dump is corrected by that dump's
+  own measured offset (the PR 5 rules apply per hop).
+- Live end-to-end: a real EngineServer ages a stalled observer into a
+  firing alert and resolves it on drain; a real client/canary reports
+  ~0 age while current; the console renders AGE/ALRT columns and
+  exits nonzero while alerts fire.
+"""
+
+import io
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gol_tpu import obs
+from gol_tpu.obs import freshness as fr
+from gol_tpu.obs.report import hop_legs, merge_traces
+
+
+@pytest.fixture(autouse=True)
+def _invariants_on(monkeypatch):
+    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
+    from gol_tpu.analysis.invariants import violations_total
+
+    before = violations_total()
+    yield
+    assert violations_total() - before == 0
+
+
+def _world(seed=7, w=64, h=64, density=0.3):
+    rng = np.random.default_rng(seed)
+    return ((rng.random((h, w)) < density).astype(np.uint8) * 255)
+
+
+def _params(tmp_path, w=64, h=64):
+    from gol_tpu.params import Params
+
+    return Params(turns=10 ** 9, threads=1, image_width=w,
+                  image_height=h, out_dir=str(tmp_path / "out"),
+                  tick_seconds=60.0)
+
+
+def _wait(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+# --- TurnClock / sanity --------------------------------------------------
+
+
+def test_turn_clock_age_math():
+    c = fr.TurnClock()
+    now = time.time()
+    for t, ts in ((10, now - 5), (20, now - 3), (30, now - 1)):
+        c.note(t, ts)
+    assert c.head() == 30
+    assert c.age_of(30, now) == 0.0
+    assert c.age_of(31, now) == 0.0  # past the head is current too
+    # Behind turn 20: turn 30 (committed 1s ago) is the first missing.
+    assert abs(c.age_of(25, now) - 1.0) < 1e-6
+    assert abs(c.age_of(15, now) - 3.0) < 1e-6
+    # Older than everything retained: the oldest commit bounds it.
+    assert abs(c.age_of(0, now) - 5.0) < 1e-6
+
+
+def test_turn_clock_paused_stream_ages_nobody():
+    c = fr.TurnClock()
+    c.note(100, time.time() - 3600)
+    # The stream stopped an hour ago; a peer AT the head owes nothing.
+    assert c.age_of(100) == 0.0
+    # A peer behind it has been missing turn 100 for that hour.
+    assert c.age_of(50) > 3500
+
+
+def test_turn_clock_hostile_and_nonmonotone_values_dropped():
+    c = fr.TurnClock()
+    c.note(10)
+    for bad in (-1, True, False, 1 << 63, "x", None, 3.5):
+        c.note(bad)
+    c.note(5)  # non-monotone: dropped
+    assert c.head() == 10
+    # A NaN/absurd timestamp falls back to now, never poisons ages.
+    c.note(11, float("nan"))
+    c.note(12, float("inf"))
+    c.note(13, -1e18)
+    assert c.head() == 13
+    age = c.age_of(0)
+    assert 0.0 <= age < 5.0
+
+
+def test_turn_clock_history_is_bounded():
+    c = fr.TurnClock(capacity=64)
+    for t in range(1000):
+        c.note(t, 1000.0 + t)
+    assert len(c._turns) <= 64
+    assert c.head() == 999
+
+
+def test_sane_turn_and_sane_lag_hostile_sweep():
+    assert fr.sane_turn(7) == 7
+    for bad in (True, False, -1, 1 << 62, "9", 2.5, None):
+        assert fr.sane_turn(bad) is None, bad
+    now = time.time()
+    assert fr.sane_lag(now - 2.0, now) == pytest.approx(2.0, abs=1e-6)
+    # Sub-zero within tolerance clamps to 0 (clock granularity).
+    assert fr.sane_lag(now + 0.5, now) == 0.0
+    for bad in (float("nan"), float("inf"), float("-inf"), -1e18,
+                1e18, "x", True, None):
+        assert fr.sane_lag(bad, now) is None, bad
+
+
+# --- ServerFreshness / ClientFreshness -----------------------------------
+
+
+class _FakeConn:
+    def __init__(self, token, fresh_turn=-1, scrub=False):
+        self.token = token
+        self.fresh_turn = fresh_turn
+        self.scrub = scrub
+
+
+def test_server_freshness_sample_publishes_bounded_series():
+    f = fr.ServerFreshness("test-tier")
+    now = time.time()
+    f.note_commit(100, ts=now - 4.0)
+    f.note_commit(200, ts=now - 2.0)
+    conns = [_FakeConn(9001, 200), _FakeConn(9002, 150),
+             _FakeConn(9003, 50, scrub=True),
+             _FakeConn(9004, -1)]
+    worst = f.sample(((c, None) for c in conns), now=now, force=True)
+    # Peer 9002 missed turn 200 committed 2s ago; the scrubbed
+    # (seek-parked) peer is excluded however stale; the never-synced
+    # peer (fresh_turn -1, board sync still pending) has no staleness
+    # to measure and must not poison the histogram with the whole
+    # retained history.
+    assert worst == pytest.approx(2.0, abs=0.1)
+    assert f._age_hist.count == 2  # 9001 + 9002 only
+    snap = f._peer_ages.snapshot_value()["children"]
+    assert snap.get("9002") == pytest.approx(2.0, abs=0.1)
+    assert snap.get("9001") == 0.0
+    assert "9003" not in snap and "9004" not in snap
+    # A peer that published an age and THEN parked at a seek: its
+    # stale child is evicted by the next sweep, not frozen into the
+    # top-K "worst peers" for the park's duration.
+    conns[1].scrub = True
+    f.sample(((c, None) for c in conns), now=now, force=True)
+    assert "9002" not in f._peer_ages.snapshot_value().get("children", {})
+    f.forget(9002)
+    assert "9002" not in f._peer_ages.snapshot_value().get("children", {})
+    # close() evicts everything this instance published — a dead
+    # server leaves no ghost peers and no stale worst-age gauge to
+    # hold fleet-max columns or max() alert rules hostage.
+    f.close()
+    assert "9001" not in f._peer_ages.snapshot_value().get("children", {})
+    assert not any(
+        m.name == "gol_tpu_server_worst_turn_age_seconds"
+        and dict(m.labels).get("tier") == "test-tier"
+        for m in obs.registry().metrics()
+    )
+
+
+def test_server_freshness_keyed_clocks_are_independent():
+    f = fr.ServerFreshness("test-keys")
+    now = time.time()
+    f.note_commit(10, key="a", ts=now - 9.0)
+    f.note_commit(500, key="b", ts=now - 1.0)
+    a, b = _FakeConn(9101, 5), _FakeConn(9102, 500)
+    f.sample(((a, "a"), (b, "b")), now=now, force=True)
+    ages = f._peer_ages.snapshot_value()["children"]
+    assert ages["9101"] == pytest.approx(9.0, abs=0.2)
+    assert ages["9102"] == 0.0
+    f.drop_key("a")
+    f.close()
+
+
+def test_server_freshness_sample_is_rate_limited():
+    f = fr.ServerFreshness("test-rate")
+    f.note_commit(10, ts=time.time() - 5)
+    c = _FakeConn(9201, 0)
+    before = f._age_hist.count
+    f.sample([(c, None)], force=True)
+    f.sample([(c, None)])  # inside the window: a free no-op
+    assert f._age_hist.count == before + 1
+    f.close()
+
+
+def test_client_freshness_head_and_applied():
+    f = fr.ClientFreshness()
+    assert f.age() == 0.0  # nothing known yet
+    now = time.time()
+    f.note_head(10, now - 3.0)
+    f.note_applied(10)
+    assert f.age(now) == 0.0
+    f.note_head(20, now - 2.0)  # head moved, we did not
+    assert f.age(now) == pytest.approx(2.0, abs=1e-6)
+    f.note_applied(20)
+    assert f.age(now) == 0.0
+    # Hostile values change nothing.
+    f.note_head(-1)
+    f.note_head(1 << 63)
+    f.note_applied("x")
+    assert f.applied_turn == 20 and f.head() == 20
+
+
+# --- alert rules ---------------------------------------------------------
+
+
+RULES_TEXT = """
+# the freshness SLO catalog
+age_p99: p99(gol_tpu_server_turn_age_seconds) > 2 for 30s
+viol:    gol_tpu_invariant_violations_total > 0
+busy:    rate(gol_tpu_writer_pool_busy_seconds_total) > 0.8 for 10s
+worst:   max(gol_tpu_server_worst_turn_age_seconds) >= 1.5 for 2m
+floor:   min(gol_tpu_server_peers) < 1
+mean:    avg(gol_tpu_client_turn_age_seconds) <= 0.5
+"""
+
+
+def test_rule_grammar_catalog():
+    rules = fr.parse_rules(RULES_TEXT)
+    assert [r.name for r in rules] == ["age_p99", "viol", "busy",
+                                       "worst", "floor", "mean"]
+    assert rules[0].agg == "p50".replace("50", "99")
+    assert rules[0].for_secs == 30.0
+    assert rules[1].agg == "sum" and rules[1].for_secs == 0.0
+    assert rules[3].for_secs == 120.0
+    assert rules[4].op == "<"
+    assert "for 30s" in rules[0].expr()
+
+
+@pytest.mark.parametrize("bad", [
+    "not a rule at all",
+    "x: frob(gol_tpu_foo) > 1",           # unknown aggregation
+    "x: gol_tpu_foo >",                    # missing threshold
+    "x: gol_tpu_foo > 1 for ever",         # malformed duration
+    "a: gol_tpu_x > 1\na: gol_tpu_y > 2",  # duplicate name
+])
+def test_rule_parse_errors_raise_valueerror(bad):
+    with pytest.raises(ValueError):
+        fr.parse_rules(bad)
+
+
+def test_evaluator_for_duration_hold_and_transitions():
+    ev = fr.AlertEvaluator(
+        fr.parse_rules("hot: gol_tpu_x_total > 5 for 2s"))
+    try:
+        t0 = 1000.0
+        p = ev.eval_once(now=t0, text="gol_tpu_x_total 9\n")
+        assert p["rules"][0]["state"] == "pending" and p["firing"] == 0
+        p = ev.eval_once(now=t0 + 1.0, text="gol_tpu_x_total 9\n")
+        assert p["rules"][0]["state"] == "pending"
+        p = ev.eval_once(now=t0 + 2.1, text="gol_tpu_x_total 9\n")
+        assert p["rules"][0]["state"] == "firing" and p["firing"] == 1
+        assert ev._rule_gauges["hot"].value == 1
+        # A dip resets the hold: pending must be served in FULL again.
+        p = ev.eval_once(now=t0 + 3.0, text="gol_tpu_x_total 1\n")
+        assert p["rules"][0]["state"] == "ok" and p["firing"] == 0
+        assert ev._rule_gauges["hot"].value == 0
+        assert ev._transitions["firing"].value >= 1
+        assert ev._transitions["resolved"].value >= 1
+        p = ev.eval_once(now=t0 + 4.0, text="gol_tpu_x_total 9\n")
+        assert p["rules"][0]["state"] == "pending"
+    finally:
+        ev.close()
+
+
+def test_evaluator_quantile_and_rate_aggregations():
+    hist_text = "\n".join([
+        'gol_tpu_age_seconds_bucket{le="0.1"} 10',
+        'gol_tpu_age_seconds_bucket{le="1"} 10',
+        'gol_tpu_age_seconds_bucket{le="10"} 20',
+        'gol_tpu_age_seconds_bucket{le="+Inf"} 20',
+        "gol_tpu_age_seconds_sum 101",
+        "gol_tpu_age_seconds_count 20",
+        "gol_tpu_busy_total 0",
+    ]) + "\n"
+    ev = fr.AlertEvaluator(fr.parse_rules(
+        "slow: p99(gol_tpu_age_seconds) > 2\n"
+        "busy: rate(gol_tpu_busy_total) > 0.5\n"
+    ))
+    try:
+        p = ev.eval_once(now=100.0, text=hist_text)
+        by = {r["name"]: r for r in p["rules"]}
+        # p99 rank 19.8 lands in the (1, 10] bucket.
+        assert by["slow"]["state"] == "firing"
+        assert 1.0 < by["slow"]["value"] <= 10.0
+        assert by["busy"]["state"] == "ok"  # first sample: no rate yet
+        p = ev.eval_once(now=110.0, text=hist_text.replace(
+            "gol_tpu_busy_total 0", "gol_tpu_busy_total 8"))
+        by = {r["name"]: r for r in p["rules"]}
+        assert by["busy"]["value"] == pytest.approx(0.8)
+        assert by["busy"]["state"] == "firing"
+        # Quantiles are WINDOWED (observations since the last eval):
+        # an unchanged histogram means no new data, the latched-p99
+        # incident resolves instead of staying hot for the process
+        # lifetime.
+        assert by["slow"]["value"] is None
+        assert by["slow"]["state"] == "ok"
+        # New fast observations in the window: the windowed p99 reads
+        # the fresh population, not the old incident's tail.
+        p = ev.eval_once(now=120.0, text=hist_text.replace(
+            'le="0.1"} 10', 'le="0.1"} 200').replace(
+            'le="1"} 10', 'le="1"} 200').replace(
+            'le="10"} 20', 'le="10"} 210').replace(
+            'le="+Inf"} 20', 'le="+Inf"} 210'))
+        by = {r["name"]: r for r in p["rules"]}
+        assert by["slow"]["value"] is not None
+        assert by["slow"]["value"] <= 0.1
+        assert by["slow"]["state"] == "ok"
+    finally:
+        ev.close()
+
+
+def test_cumulative_bucket_delta_windows_exactly():
+    cur = [(0.1, 5), (1.0, 9), (float("inf"), 12)]
+    assert fr.cumulative_bucket_delta(cur, None) == cur
+    prev = [(0.1, 3), (1.0, 3), (float("inf"), 4)]
+    assert fr.cumulative_bucket_delta(cur, prev) == [
+        (0.1, 2), (1.0, 6), (float("inf"), 8)]
+    # No new observations: zero totals -> quantile None.
+    from gol_tpu.obs.registry import quantile_from_buckets
+
+    assert quantile_from_buckets(
+        fr.cumulative_bucket_delta(cur, cur), 0.99) is None
+
+
+def test_evaluator_missing_family_and_garbage_text_never_crash():
+    ev = fr.AlertEvaluator(fr.parse_rules(
+        "ghost: p99(gol_tpu_does_not_exist) > 1\n"
+        "ghost2: gol_tpu_also_absent > 0\n"
+    ))
+    try:
+        for text in ("", "garbage !!! not prometheus\n\x00\xff",
+                     "gol_tpu_other 5\n"):
+            p = ev.eval_once(text=text)
+            assert p["firing"] == 0
+            assert all(r["state"] == "ok" for r in p["rules"])
+            assert all(r["value"] is None for r in p["rules"])
+    finally:
+        ev.close()
+
+
+def test_alerts_endpoint_with_and_without_rules():
+    from gol_tpu.obs.http import MetricsServer
+
+    # No evaluator at all: the explicit empty shape, never a 404.
+    mx = MetricsServer("127.0.0.1", 0).start()
+    try:
+        body = json.loads(urllib.request.urlopen(
+            f"http://{mx.address[0]}:{mx.address[1]}/alerts", timeout=10
+        ).read())
+        assert body == {"rules": [], "firing": 0}
+    finally:
+        mx.close()
+    ev = fr.AlertEvaluator(
+        fr.parse_rules("v: gol_tpu_invariant_violations_total > 1e18"),
+        interval=0.1)
+    mx = MetricsServer("127.0.0.1", 0, alerts=ev).start()
+    try:
+        _wait(lambda: json.loads(urllib.request.urlopen(
+            f"http://{mx.address[0]}:{mx.address[1]}/alerts", timeout=10
+        ).read())["rules"][0]["value"] is not None,
+            10, "evaluator to run inside the sidecar")
+        body = json.loads(urllib.request.urlopen(
+            f"http://{mx.address[0]}:{mx.address[1]}/alerts", timeout=10
+        ).read())
+        assert body["firing"] == 0
+        assert body["rules"][0]["name"] == "v"
+    finally:
+        mx.close()  # closes the evaluator too
+    assert ev._stop.is_set()
+
+
+def test_evaluator_close_evicts_its_gauges_even_while_firing():
+    ev = fr.AlertEvaluator(fr.parse_rules("hot: gol_tpu_x_total > 0"))
+    p = ev.eval_once(text="gol_tpu_x_total 5\n")
+    assert p["firing"] == 1
+    ev.close()
+    names = {(m.name, dict(m.labels).get("rule"))
+             for m in obs.registry().metrics()}
+    # Neither the per-rule gauge nor the aggregate count survives a
+    # closed evaluator — a process that serves again must not render
+    # phantom ALRT columns from a dead one.
+    assert ("gol_tpu_alert_firing", "hot") not in names
+    assert not any(n == "gol_tpu_alerts_firing" for n, _ in names)
+
+
+def test_cli_alert_rules_parse_error_is_startup_error(tmp_path):
+    from gol_tpu.cli import _start_metrics
+
+    class _Args:
+        metrics_port = 0
+        metrics_host = "127.0.0.1"
+        alert_rules = str(tmp_path / "rules.txt")
+
+    (tmp_path / "rules.txt").write_text("this is : not > a rule\n")
+    with pytest.raises(SystemExit):
+        _start_metrics(_Args())
+    _Args.alert_rules = str(tmp_path / "missing.txt")
+    with pytest.raises(SystemExit):
+        _start_metrics(_Args())
+    # --alert-rules without --metrics-port is a startup error too.
+    _Args.metrics_port = None
+    _Args.alert_rules = str(tmp_path / "rules.txt")
+    with pytest.raises(SystemExit):
+        _start_metrics(_Args())
+
+
+# --- per-hop attribution math --------------------------------------------
+
+
+def _mark(name, ts_us, turn, depth=None):
+    args = {"turn": turn}
+    if depth is not None:
+        args["depth"] = depth
+    return {"name": name, "ph": "i", "cat": "wire", "ts": ts_us,
+            "tid": 0, "args": args}
+
+
+def _hop_dumps(turns=20, legs_ms=(3.0, 5.0, 4.0), skew_s=0.0,
+               skew_hop=None):
+    """Four synthetic dumps — root, two relays, leaf client — with
+    injected per-hop delays. `skew_hop` gets its wall clock shifted by
+    `skew_s` AND (like the real per-hop probe) records the measured
+    offset in its metadata, so merge must cancel the skew exactly."""
+    base_us = 1_700_000_000 * 1e6
+    roles = ["root", "hop1", "hop2", "client"]
+    events = {r: [] for r in roles}
+    for t in range(turns):
+        ts = base_us + t * 100_000.0
+        events["root"].append(_mark("turn.emit", ts, t))
+        ts += legs_ms[0] * 1000
+        events["hop1"].append(_mark("turn.forward", ts, t, depth=1))
+        ts += legs_ms[1] * 1000
+        events["hop2"].append(_mark("turn.forward", ts, t, depth=2))
+        ts += legs_ms[2] * 1000
+        events["client"].append(_mark("turn.apply", ts, t))
+    dumps = []
+    for i, r in enumerate(roles):
+        evs = events[r]
+        off = 0.0
+        if r == skew_hop and skew_s:
+            # This process's wall clock runs skew_s FAST: its local
+            # stamps are shifted, and its measured offset to the root
+            # timebase is the negation.
+            for ev in evs:
+                ev["ts"] += skew_s * 1e6
+            off = -skew_s
+        dumps.append({
+            "traceEvents": evs,
+            "metadata": {"pid": 1, "process_label": r,
+                         "clock_offset_seconds": off or None},
+        })
+    return dumps
+
+
+def test_three_hop_chain_legs_sum_to_end_to_end():
+    merged = merge_traces(_hop_dumps())
+    h = hop_legs(merged)
+    assert h["turns"] == 20
+    legs = {x["leg"]: x["mean_s"] for x in h["legs"]}
+    assert legs["emit→hop1"] == pytest.approx(0.003, abs=1e-9)
+    assert legs["hop1→hop2"] == pytest.approx(0.005, abs=1e-9)
+    assert legs["hop2→apply"] == pytest.approx(0.004, abs=1e-9)
+    # The acceptance property: the legs SUM to the measured
+    # end-to-end age (exactly — same telescoping difference).
+    assert sum(legs.values()) == pytest.approx(
+        h["end_to_end_mean_s"], rel=1e-12)
+    assert h["end_to_end_mean_s"] == pytest.approx(0.012, abs=1e-9)
+
+
+@pytest.mark.parametrize("skew_hop", ["hop1", "hop2", "client"])
+def test_five_second_skew_on_one_hop_does_not_corrupt(skew_hop):
+    """A 5s wall-clock skew on one tier — far beyond any leg — must
+    cancel through that dump's own measured offset (the per-hop PR 5
+    correction), leaving the decomposition bit-identical."""
+    clean = hop_legs(merge_traces(_hop_dumps()))
+    skewed = hop_legs(merge_traces(
+        _hop_dumps(skew_s=5.0, skew_hop=skew_hop)))
+    assert skewed["turns"] == clean["turns"] == 20
+    for a, b in zip(clean["legs"], skewed["legs"]):
+        assert a["leg"] == b["leg"]
+        assert a["mean_s"] == pytest.approx(b["mean_s"], abs=1e-9)
+    assert skewed["end_to_end_mean_s"] == pytest.approx(
+        clean["end_to_end_mean_s"], abs=1e-9)
+
+
+def test_uncorrected_skew_would_corrupt_the_control():
+    """The control for the test above: the SAME skew with the offset
+    metadata withheld DOES corrupt the legs — proving the correction
+    is load-bearing, not coincidentally idle."""
+    dumps = _hop_dumps(skew_s=5.0, skew_hop="hop1")
+    dumps[1]["metadata"]["clock_offset_seconds"] = None
+    h = hop_legs(merge_traces(dumps))
+    legs = {x["leg"]: x["mean_s"] for x in h["legs"]}
+    # hop1's marks land 5s late; the chain drops them as out-of-range
+    # (emit <= ts <= apply fails), collapsing the decomposition.
+    assert "emit→hop1" not in legs or legs["emit→hop1"] > 1.0
+
+
+# --- live end-to-end -----------------------------------------------------
+
+
+def test_live_server_ages_and_alert_cycle(tmp_path):
+    """The acceptance stall cycle, in-process: a raw observer stops
+    reading -> its queue fills -> degradation sheds frames -> its
+    turn age grows -> the rule FIRES; draining recovers it via the
+    coalescing BoardSync -> age collapses -> the rule RESOLVES."""
+    from gol_tpu.distributed import wire
+    from gol_tpu.distributed.server import EngineServer
+
+    srv = EngineServer(_params(tmp_path), "127.0.0.1", 0,
+                       heartbeat_secs=0.5, high_water=32,
+                       drain_secs=60.0,
+                       initial_world=_world()).start()
+    ev = fr.AlertEvaluator(fr.parse_rules(
+        "stale: max(gol_tpu_server_worst_turn_age_seconds) > 1 for 0.5s"
+    ), interval=0.1).start()
+    s = socket.create_connection(srv.address, timeout=30)
+    try:
+        s.settimeout(30)
+        wire.send_msg(s, {"t": "hello", "want_flips": True,
+                          "binary": True, "role": "observe"})
+        time.sleep(0.5)  # sync + stream a little, then stop reading
+        _wait(lambda: ev.payload()["firing"] == 1, 30,
+              "the turn-age alert to fire against the stalled reader")
+        stopped = threading.Event()
+
+        def drain():
+            with s:
+                s.settimeout(2)
+                try:
+                    while not stopped.is_set() and s.recv(1 << 20):
+                        pass
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        _wait(lambda: ev.payload()["firing"] == 0, 30,
+              "the alert to resolve after the drain")
+        assert ev._transitions["firing"].value >= 1
+        assert ev._transitions["resolved"].value >= 1
+        stopped.set()
+        t.join(timeout=5)
+    finally:
+        ev.close()
+        srv.shutdown()
+
+
+def test_live_client_age_console_columns_and_canary(tmp_path):
+    """A current client reports ~0 turn age; the console renders the
+    AGE and ALRT columns from scrapes alone and --once exits 2 while
+    an alert fires; the canary measures the same freshness as a real
+    observer and passes its own --max-age gate."""
+    from gol_tpu.distributed.client import Controller
+    from gol_tpu.distributed.server import EngineServer
+    from gol_tpu.obs import canary
+    from gol_tpu.obs import console as con
+    from gol_tpu.obs.http import MetricsServer
+
+    srv = EngineServer(_params(tmp_path), "127.0.0.1", 0,
+                       heartbeat_secs=0.5,
+                       initial_world=_world()).start()
+    ev = fr.AlertEvaluator(fr.parse_rules(
+        "always: gol_tpu_server_accepts_total >= 0\n"
+        "never: gol_tpu_server_worst_turn_age_seconds > 1e17\n"
+    ), interval=0.1)
+    mx = MetricsServer("127.0.0.1", 0, health=srv.health,
+                       alerts=ev).start()
+    ctl = Controller(*srv.address, want_flips=True, batch=True,
+                     batch_turns=64, batch_flip_events=False,
+                     observe=True)
+    try:
+        assert ctl.wait_sync(60)
+        _wait(lambda: ctl.turn_age() < 1.0 and ctl.freshness.head() > 0,
+              30, "the client to be measurably current")
+        base = f"{mx.address[0]}:{mx.address[1]}"
+        _wait(lambda: json.loads(urllib.request.urlopen(
+            f"http://{base}/alerts", timeout=10).read())["firing"] == 1,
+            15, "the always-true rule to fire")
+        snap = con.fleet_snapshot([con.Endpoint(base)])
+        row = snap["rows"][0]
+        assert row["turn_age_s"] is not None and row["turn_age_s"] < 5.0
+        assert row["alerts"] == ["always"]
+        assert row["alerts_firing"] == 1
+        assert snap["total"]["alerts"] == [
+            {"endpoint": base, "rule": "always"}]
+        # CI contract: --once exits 2 while an alert fires (and the
+        # ALERT line renders), 1 only for a down endpoint.
+        buf = io.StringIO()
+        snapshot = con.fleet_snapshot([con.Endpoint(base)])
+        con.render(snapshot, out=buf)
+        assert "ALERT firing" in buf.getvalue()
+        assert con.main([base, "--once", "--json"]) == 2
+        # Canary: a real observer measuring the same freshness.
+        out = io.StringIO()
+        rc = canary.run_canary(f"{srv.address[0]}:{srv.address[1]}",
+                               interval=0.2, duration=1.5, max_age=5.0,
+                               as_json=True, out=out)
+        assert rc == 0, out.getvalue()
+        summary = json.loads(out.getvalue())
+        assert summary["ok"] and summary["age"]["samples"] >= 3
+        assert summary["age"]["p95_s"] < 5.0
+    finally:
+        ctl.close()
+        mx.close()
+        srv.shutdown()
+
+
+def test_canary_bad_target_is_a_diagnostic_not_a_traceback():
+    from gol_tpu.obs import canary
+
+    with pytest.raises(ValueError):
+        canary.run_canary("localhost")  # no port
+    # The CLI turns it into the friendly attach error + exit 1.
+    assert canary.main(["localhost"]) == 1
+    assert canary.main(["host:notaport"]) == 1
+
+
+def test_canary_lost_link_exits_nonzero_even_with_duration():
+    """A link declared LOST mid-probe (reconnect rejected by policy)
+    must fail a --duration CI run — the few ~0 samples taken before
+    the drop cannot green-light a dead tier."""
+    import numpy as np_
+
+    from gol_tpu.distributed import wire
+    from gol_tpu.obs import canary
+
+    listener = socket.create_server(("127.0.0.1", 0))
+    world = np_.zeros((32, 32), np_.uint8)
+
+    def serve():
+        # First attach: ack + board, then a hard close; every re-dial
+        # is rejected unauthorized — the Controller declares the link
+        # lost immediately (policy rejections are not retryable).
+        s, _ = listener.accept()
+        s.settimeout(30)
+        wire.recv_msg(s, allow_binary=False)
+        wire.send_msg(s, {"t": "attach-ack"})
+        s.sendall(wire.frame_bytes(wire.board_to_frame(5, world, 0)))
+        time.sleep(0.5)
+        s.close()
+        while True:
+            try:
+                s2, _ = listener.accept()
+            except OSError:
+                return
+            with s2:
+                s2.settimeout(10)
+                try:
+                    wire.recv_msg(s2, allow_binary=False)
+                    wire.send_msg(s2, {"t": "error",
+                                       "reason": "unauthorized"})
+                except (wire.WireError, OSError, TimeoutError):
+                    pass
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        out = io.StringIO()
+        host, port = listener.getsockname()
+        rc = canary.run_canary(f"{host}:{port}", interval=0.2,
+                               duration=15.0, max_age=60.0,
+                               as_json=True, out=out)
+        assert rc == 2, out.getvalue()
+        summary = json.loads(out.getvalue())
+        assert summary["lost"] and not summary["ok"]
+    finally:
+        listener.close()
+
+
+@pytest.mark.slow
+def test_ws_canary_measures_gateway_freshness(tmp_path):
+    """The WS gateway tier, measured by a browser-shaped canary: a
+    masked RFC-6455 client applying the identical binary frames
+    reports bounded age (the 'what a user would see' leg of the
+    acceptance; heavyweight: root + relay + gateway in-process)."""
+    from gol_tpu.distributed.server import EngineServer
+    from gol_tpu.obs import canary
+    from gol_tpu.relay.node import RelayNode
+
+    srv = EngineServer(_params(tmp_path), "127.0.0.1", 0,
+                       heartbeat_secs=0.5,
+                       initial_world=_world()).start()
+    relay = RelayNode(srv.address, "127.0.0.1", 0,
+                      heartbeat_secs=0.5, ws_port=0).start()
+    try:
+        assert relay.synced.wait(60)
+        out = io.StringIO()
+        rc = canary.run_canary(
+            f"{relay.ws_address[0]}:{relay.ws_address[1]}",
+            interval=0.2, duration=2.0, max_age=5.0, use_ws=True,
+            as_json=True, out=out)
+        assert rc == 0, out.getvalue()
+        summary = json.loads(out.getvalue())
+        assert summary["transport"] == "ws" and summary["ok"]
+        assert summary["applied_turn"] > 0
+    finally:
+        relay.shutdown()
+        srv.shutdown()

@@ -1423,3 +1423,174 @@ def test_rid_replayed_seek_returns_recorded_reply_verbatim(
         r = _seek_reply(s, {"t": "seek", "turn": 8, "rid": rid})
         assert r.get("ok"), (rid, r)
     s.close()
+
+
+# --- freshness-plane hop stamps (ISSUE 15, gol_tpu.obs.freshness) ---
+
+
+def test_hostile_hop_stamps_never_corrupt_forward_latency(fuzz_relay):
+    """A frame whose emit stamp is hostile/absurd (negative epoch,
+    1e18, NaN — all representable in the header's double) forwards
+    fine but is DROPPED by the per-hop latency math (sane_lag): the
+    forward-latency histogram never observes it, so one corrupt stamp
+    cannot park the freshness plane in the +Inf bucket."""
+    import numpy as np_
+
+    from gol_tpu.relay.node import _METRICS as relay_metrics
+
+    relay, conns = fuzz_relay
+    s, ack = _attach_observer(relay.address)
+    m = wire.recv_msg(s)
+    while m.get("t") != "board":
+        m = wire.recv_msg(s)
+    up = conns[-1]
+    _, nb = wire.grid_words(48, 48)  # the quiet upstream's board
+
+    def empty_batch(first_turn, ts):
+        return wire.frame_bytes(wire.flip_batch_to_frame(
+            first_turn, nb, np_.zeros(1, np_.uint32),
+            np_.zeros((0, nb), np_.uint32), np_.zeros(0, np_.uint32),
+            ts,
+        ))
+
+    before = relay_metrics.forward_latency.count
+    for i, ts in enumerate((-1e18, 1e18, float("nan"),
+                            float("inf"), -0.0)):
+        up.sendall(empty_batch(10 + i, ts))
+    # A sane stamp still observes (the plane is filtered, not dead).
+    up.sendall(empty_batch(20, time.time()))
+    deadline = time.monotonic() + 15
+    got = 0
+    while time.monotonic() < deadline and got < 6:
+        m = wire.recv_msg(s)
+        if m.get("t") == "fbatch":
+            got += 1
+    assert got == 6, "hostile-stamp frames did not forward"
+    delta = relay_metrics.forward_latency.count - before
+    # Only -0.0 (clamps to a 0-ish lag, sane) and the real stamp may
+    # observe; the four absurd stamps must not.
+    assert 1 <= delta <= 2, delta
+    # The relay's shadow clock stayed sane: downstream ages bounded.
+    assert relay.freshness.clock().age_of(0) < 60.0
+    s.close()
+
+
+def test_hostile_heartbeat_turns_never_corrupt_client_age():
+    """Beacon turns feed the client's freshness head clock: hostile
+    values (negative, bool, 1e18-scale, strings) are dropped and a
+    later honest beacon still lands — the age gauge cannot be poisoned
+    through the hb plane."""
+    import threading as _threading
+
+    from gol_tpu.distributed.client import Controller
+
+    listener = socket.create_server(("127.0.0.1", 0))
+    world = np.zeros((32, 32), np.uint8)
+
+    def serve():
+        s, _ = listener.accept()
+        s.settimeout(30)
+        wire.recv_msg(s, allow_binary=False)
+        wire.send_msg(s, {"t": "attach-ack"})
+        s.sendall(wire.frame_bytes(wire.board_to_frame(100, world, 0)))
+        for turn in (-5, True, 1 << 63, "many", None, 2.5):
+            wire.send_msg(s, {"t": "hb", "turn": turn})
+        # Hostile EMIT STAMPS on turn events: non-numeric ts used to
+        # raise out of the client's latency bookkeeping and kill the
+        # reader thread; absurd ts must never reach the histograms.
+        for ts in ("abc", -1e18, 1e18, None, [1]):
+            wire.send_msg(s, {"t": "ev", "k": "turn", "turn": 100,
+                              "ts": ts})
+        wire.send_msg(s, {"t": "hb", "turn": 100})  # honest: current
+        time.sleep(1.0)
+        wire.send_msg(s, {"t": "bye"})
+        s.close()
+
+    t = _threading.Thread(target=serve, daemon=True)
+    t.start()
+    from gol_tpu.distributed.client import _METRICS as cm
+
+    lat_before = cm.turn_latency.count
+    ctl = Controller(*listener.getsockname(), want_flips=False,
+                     reconnect=False)
+    try:
+        assert ctl.wait_sync(30)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and not ctl.events.closed:
+            time.sleep(0.05)
+        # The reader survived every hostile message to the clean bye.
+        assert ctl.events.closed and not ctl.lost.is_set()
+        assert ctl.freshness.head() == 100
+        assert ctl.freshness.applied_turn == 100
+        assert ctl.turn_age() == 0.0
+        # No hostile stamp reached the latency histogram.
+        assert cm.turn_latency.count == lat_before
+    finally:
+        ctl.close()
+        listener.close()
+        t.join(timeout=5)
+
+
+@pytest.mark.parametrize("bad", [
+    {"t": "fbatch"},
+    {"t": "fbatch", "first_turn": "a", "k": 2},
+    {"t": "fbatch", "first_turn": 1, "k": 2, "ts": 1.0, "nb": "x"},
+    {"t": "fbatch", "first_turn": 1, "k": 2, "ts": 1.0, "nb": 3,
+     "counts": "zz", "dbitmaps": 7, "dwords": None},
+    # Plausible-but-absurd turn number with a frame that FAILS to
+    # apply (wrong nb): the monotone freshness clocks must not be
+    # advanced by a rejected frame's fields — turn_age would read 0
+    # forever after (every honest later turn << 10^14 gets dropped).
+    {"t": "fbatch", "first_turn": 10 ** 14, "k": 4, "ts": 1.0,
+     "nb": 999, "counts": [0, 0, 0, 0], "dbitmaps": [],
+     "dwords": []},
+])
+def test_hostile_json_fbatch_fails_the_link_cleanly(bad):
+    """A hostile JSON "fbatch" (binary frames are parse-validated;
+    JSON is not) must surface as a WireError link failure — the
+    dflips precedent — with the client reaching an explicit LOST
+    state, never a silently dead reader thread (KeyError/TypeError
+    used to escape both the apply path and the latency bookkeeping,
+    outside the reader loop's caught set, leaving consumers hung on a
+    link that looked alive)."""
+    import threading as _threading
+
+    from gol_tpu.distributed.client import Controller
+
+    listener = socket.create_server(("127.0.0.1", 0))
+    world = np.zeros((32, 32), np.uint8)
+
+    def serve():
+        s, _ = listener.accept()
+        s.settimeout(30)
+        wire.recv_msg(s, allow_binary=False)
+        wire.send_msg(s, {"t": "attach-ack"})
+        s.sendall(wire.frame_bytes(wire.board_to_frame(5, world, 0)))
+        wire.send_msg(s, bad)
+        time.sleep(2.0)
+        with __import__("contextlib").suppress(OSError):
+            s.close()
+
+    t = _threading.Thread(target=serve, daemon=True)
+    t.start()
+    ctl = Controller(*listener.getsockname(), want_flips=True,
+                     batch=True, batch_turns=16,
+                     batch_flip_events=True, reconnect=False)
+    try:
+        assert ctl.wait_sync(30)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not ctl.lost.is_set():
+            time.sleep(0.05)
+        assert ctl.lost.is_set(), (
+            "hostile fbatch neither killed the link cleanly nor "
+            "reached the lost state — dead reader thread?"
+        )
+        # A REJECTED frame's fields never reach the monotone
+        # freshness clocks: applied/head stay at the honest sync.
+        assert ctl.freshness.applied_turn == 5
+        assert ctl.freshness.head() == 5
+    finally:
+        ctl.close()
+        listener.close()
+        t.join(timeout=5)
