@@ -1,4 +1,5 @@
-"""Check registry. Each module: CHECK name + run(ctx) -> findings."""
+"""Check registry. Each module: CHECK name + run(ctx) and/or
+run_project(ctxs) -> findings."""
 
 from gol_tpu.analysis.checks import (
     blocking_io,
@@ -9,10 +10,14 @@ from gol_tpu.analysis.checks import (
     recompile,
     tracer_branch,
 )
+from gol_tpu.analysis.concurrency import CONCURRENCY_CHECKS
 
-#: Every check the CLI and the tier-1 test run, in report order.
+#: Every check the CLI and the tier-1 test run, in report order. The
+#: concurrency plane (lock-order, lock-blocking, thread-ownership,
+#: guarded-field) lives in gol_tpu.analysis.concurrency and registers
+#: here like any other check.
 ALL_CHECKS = [host_sync, tracer_branch, recompile, dtype_drift, donation,
-              obs_in_jit, blocking_io]
+              obs_in_jit, blocking_io] + CONCURRENCY_CHECKS
 
 __all__ = ["ALL_CHECKS", "blocking_io", "donation", "dtype_drift",
            "host_sync", "obs_in_jit", "recompile", "tracer_branch"]

@@ -39,11 +39,20 @@ def lint_paths(paths: Sequence[pathlib.Path], root: pathlib.Path,
     repo-relative paths findings (and allowlist entries) use. A file
     that does not parse yields a single `parse-error` finding rather
     than aborting the run — a syntax error anywhere must not blind the
-    linter to the rest of the tree."""
+    linter to the rest of the tree.
+
+    Two check shapes. Per-module checks expose `run(ctx)` and see one
+    file at a time. Project checks expose `run_project(ctxs)` and see
+    every parsed module at once — what the concurrency passes need: a
+    lock-order cycle is a property of the merged lock graph, never of
+    one file, and a lock held here across a call that blocks THERE is
+    only visible to an interprocedural walk. A check may expose both.
+    """
     from gol_tpu.analysis.checks import ALL_CHECKS
 
     active = list(checks) if checks is not None else list(ALL_CHECKS)
     findings: List[Finding] = []
+    ctxs: List[ModuleContext] = []
     for f in iter_py_files(paths, root):
         rel = _rel(f, root)
         try:
@@ -52,7 +61,12 @@ def lint_paths(paths: Sequence[pathlib.Path], root: pathlib.Path,
             findings.append(Finding("parse-error", rel, e.lineno or 0,
                                     "<module>", f"cannot parse: {e.msg}"))
             continue
+        ctxs.append(ctx)
         for mod in active:
-            findings.extend(mod.run(ctx))
+            if hasattr(mod, "run"):
+                findings.extend(mod.run(ctx))
+    for mod in active:
+        if hasattr(mod, "run_project"):
+            findings.extend(mod.run_project(ctxs))
     findings.sort(key=lambda f: (f.path, f.line, f.check))
     return findings

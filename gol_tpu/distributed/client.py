@@ -55,6 +55,7 @@ from gol_tpu.obs.freshness import ClientFreshness, sane_lag
 from gol_tpu.engine.distributor import EventQueue
 from gol_tpu.events import CellFlipped, FlipBatch, TurnComplete
 from gol_tpu.utils.cell import Cell, cells_from_mask, xy_from_mask
+from gol_tpu.analysis.concurrency import lockcheck
 
 log = logging.getLogger(__name__)
 
@@ -233,7 +234,7 @@ class Controller:
         self.lost = threading.Event()
         #: Successful reconnect cycles this controller has survived.
         self.reconnects = 0
-        self._send_lock = threading.Lock()
+        self._send_lock = lockcheck.make_lock("Controller._send_lock")
         self._closing = threading.Event()
         self._reconnecting = threading.Event()
         self._host, self._port = host, port
@@ -306,7 +307,7 @@ class Controller:
         #: at a time (the verb is a user-interaction, not a stream).
         self._seek_reply: Optional[dict] = None
         self._seek_done = threading.Event()
-        self._seek_lock = threading.Lock()
+        self._seek_lock = lockcheck.make_lock("Controller._seek_lock")
         self._rid_n = 0
         self._rid_prefix = uuid.uuid4().hex[:12]
         self._sock, first = self._dial()

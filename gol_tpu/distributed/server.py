@@ -64,6 +64,7 @@ from gol_tpu.events import (
 )
 from gol_tpu.io.pgm import read_pgm
 from gol_tpu.params import Params
+from gol_tpu.analysis.concurrency import lockcheck
 
 __all__ = ["EngineServer", "SessionServer", "snapshot_turn"]
 
@@ -332,7 +333,7 @@ class _Conn:
         #: reset would be wrong now anyway — OTHER synced peers are
         #: still owed those flips).
         self.synced_turn = -1
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("_Conn._lock")
         # Outbound frames ride a bounded per-connection queue: on the
         # WRITER POOL (gol_tpu.relay.writerpool — the default for both
         # servers and the relay tier: thousands of non-blocking
@@ -379,7 +380,7 @@ class _Conn:
         #: lock: `_lock` is held across blocking socket writes, and the
         #: tally must stay wait-free for the broadcaster.
         self._ovf_counted = False
-        self._ovf_lock = threading.Lock()
+        self._ovf_lock = lockcheck.make_lock("_Conn._ovf_lock")
         #: A coalescing BoardSync has been requested/enqueued for this
         #: peer and has not arrived yet — don't request another.
         self.resync_pending = False
@@ -392,7 +393,7 @@ class _Conn:
         #: (RLock: the drain-recovery path resyncs from inside a gated
         #: callback).
         self.scrub = False
-        self.seek_gate = threading.RLock()
+        self.seek_gate = lockcheck.make_rlock("_Conn.seek_gate")
         #: Per-peer lag gauge (label evicted at detach) — installed by
         #: the server once the peer is attached.
         self.lag_metric = None
@@ -819,7 +820,7 @@ class EngineServer:
         #: driver plus N watchers" shape (ref: README.md:201-207 keeps
         #: the DRIVER singular; nothing about watching is exclusive).
         self._observers: "list[_Conn]" = []
-        self._conn_lock = threading.Lock()
+        self._conn_lock = lockcheck.make_lock("EngineServer._conn_lock")
         self._shutdown = threading.Event()
         self.done = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -1925,7 +1926,7 @@ class SessionServer:
         #: recorded answer instead of re-executing — a retried create
         #: never double-creates, a retried destroy never errors.
         self._replay: "dict[str, dict]" = {}  # insertion-ordered FIFO
-        self._replay_lock = threading.Lock()
+        self._replay_lock = lockcheck.make_lock("SessionServer._replay_lock")
         #: Replay-plane recording (gol_tpu.replay, docs/REPLAY.md):
         #: with `record`, every live session gets an ephemeral
         #: RecorderSink taping its encoded wire stream into
@@ -1935,7 +1936,8 @@ class SessionServer:
         self.keyframe_turns = max(1, int(keyframe_turns))
         self.record_max_bytes = record_max_bytes
         self._recorders: "dict[str, object]" = {}
-        self._recorder_lock = threading.Lock()
+        self._recorder_lock = lockcheck.make_lock(
+            "SessionServer._recorder_lock")
         if self.record:
             # Recording state rides the session.json sidecar (the
             # PR 7 crash-consistency story covers it), and the
@@ -1965,7 +1967,7 @@ class SessionServer:
         #: own committed turn (clocks keyed by sid — one stalled
         #: session can never age another session's watchers).
         self.freshness = ServerFreshness("session")
-        self._conn_lock = threading.Lock()
+        self._conn_lock = lockcheck.make_lock("SessionServer._conn_lock")
         self._conns: "list[_Conn]" = []
         #: sid -> driving connection (one driver per session).
         self._drivers: "dict[str, _Conn]" = {}

@@ -62,6 +62,7 @@ from gol_tpu.ops import life
 from gol_tpu.params import Params
 from gol_tpu.parallel import make_stepper
 from gol_tpu.utils.cell import cells_from_mask, xy_from_mask
+from gol_tpu.analysis.concurrency import lockcheck
 
 
 def _is_gen_rule(rule) -> bool:
@@ -389,7 +390,7 @@ class Engine:
         self._stop_reason: Optional[str] = None
         self._ticker_stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._req_lock = threading.Lock()
+        self._req_lock = lockcheck.make_lock("Engine._req_lock")
         # Pending cross-thread requests, each ("count"|"world", event, box).
         self._requests: list = []
         # Last (turn, count) pair actually realised together — the

@@ -61,6 +61,7 @@ from gol_tpu.obs import flight, tracing
 from gol_tpu.obs.freshness import ServerFreshness, sane_lag
 from gol_tpu.relay import ws as wsproto
 from gol_tpu.relay.writerpool import WriterPool
+from gol_tpu.analysis.concurrency import lockcheck
 
 __all__ = ["RelayNode", "WSConn"]
 
@@ -227,7 +228,7 @@ class RelayNode:
         #: board-syncs from (the one per-stream thing a relay encodes).
         self.board: Optional[np.ndarray] = None
         self.turn = 0
-        self._board_lock = threading.Lock()
+        self._board_lock = lockcheck.make_lock("RelayNode._board_lock")
         #: Hops from the root: upstream's attach-ack depth + 1.
         self.depth = 1
         #: Negotiated upstream max-k (the granularity our downstream
@@ -240,12 +241,13 @@ class RelayNode:
         self._clk_samples: "list[tuple[float, float]]" = []
         self._clk_left = 0
         self._up_sock: Optional[socket.socket] = None
-        self._up_lock = threading.Lock()  # serializes upstream sends
+        self._up_lock = lockcheck.make_lock(
+            "RelayNode._up_lock")  # serializes upstream sends
         self._up_hb_secs = 0.0
         self.reconnects = 0
         self.synced = threading.Event()
         self._conns: "list[_Conn]" = []
-        self._conn_lock = threading.Lock()
+        self._conn_lock = lockcheck.make_lock("RelayNode._conn_lock")
         self._shutdown = threading.Event()
         self.done = threading.Event()
         self._threads: "list[threading.Thread]" = []

@@ -60,6 +60,7 @@ from typing import Callable, Optional
 
 from gol_tpu import obs
 from gol_tpu.obs import tracing
+from gol_tpu.analysis.concurrency import lockcheck
 
 __all__ = ["PoolFull", "PoolHandle", "WriterPool"]
 
@@ -108,7 +109,7 @@ class PoolHandle:
         self._on_error = on_error
         self.max_frames = max_frames
         self.max_bytes = max_bytes
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("PoolHandle._lock")
         self._q: "collections.deque[bytes]" = collections.deque()
         #: The frame currently transmitting lives OUTSIDE the deque
         #: (popped into this slot by the loop thread): a concurrent
@@ -381,7 +382,7 @@ class _Loop(threading.Thread):
         #: Peers assigned to this loop (armed or not) — sized gauges
         #: and close() teardown read it.
         self.peers: "set[PoolHandle]" = set()
-        self._peers_lock = threading.Lock()
+        self._peers_lock = lockcheck.make_lock("_Loop._peers_lock")
 
     def adopt(self, handle: PoolHandle) -> None:
         with self._peers_lock:
@@ -451,7 +452,7 @@ class _Loop(threading.Thread):
 #: Registered-socket census across every live pool in the process
 #: (the gauge is process-global; pools are per server/relay).
 _POOLS: "list[WriterPool]" = []
-_POOLS_LOCK = threading.Lock()
+_POOLS_LOCK = lockcheck.make_lock("writerpool:_POOLS_LOCK")
 
 
 def _total_sockets() -> int:

@@ -56,6 +56,7 @@ from gol_tpu.replay.log import (
     scan_segments,
     seek_frames,
 )
+from gol_tpu.analysis.concurrency import lockcheck
 
 __all__ = ["ReplayServer"]
 
@@ -116,7 +117,7 @@ class _Recording:
     def __init__(self, sid: str, root: str):
         self.sid = sid
         self.root = root
-        self.lock = threading.Lock()
+        self.lock = lockcheck.make_lock("_Recording.lock")
         self.conns: "list[_Conn]" = []
         #: Current segment's payloads, keyframe first.
         self.catchup: "list[bytes]" = []
@@ -184,11 +185,11 @@ class ReplayServer:
         self.freshness = ServerFreshness("replay")
         self.pool = (WriterPool(writer_pool_threads, "gol-replay-writer")
                      if writer_pool_threads > 0 else None)
-        self._conn_lock = threading.Lock()
+        self._conn_lock = lockcheck.make_lock("ReplayServer._conn_lock")
         self._conns: "list[_Conn]" = []
         self._by_conn: "dict[_Conn, _Recording]" = {}
         self._replay: "dict[str, dict]" = {}
-        self._replay_lock = threading.Lock()
+        self._replay_lock = lockcheck.make_lock("ReplayServer._replay_lock")
         #: Pumps gate on this before their first record — normally
         #: open; `pump_paused=True` holds playback until
         #: `release_pumps()` so an embedder (the bench lane) can

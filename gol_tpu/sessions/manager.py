@@ -25,6 +25,7 @@ import numpy as np
 from gol_tpu import obs
 from gol_tpu.models.rules import GenRule, LIFE, Rule, get_rule
 from gol_tpu.obs import device, flight, tracing
+from gol_tpu.analysis.concurrency import lockcheck
 
 #: Session ids are path components (checkpoints live under
 #: out/sessions/<id>/) and metric label values — one conservative
@@ -394,7 +395,7 @@ class SessionManager:
         self._deferring_manifest = False
         self._buckets: "dict[tuple, _Bucket]" = {}
         self._by_id: "dict[str, Session]" = {}
-        self._lock = threading.RLock()
+        self._lock = lockcheck.make_rlock("SessionManager._lock")
         #: Cross-thread verb requests: (fn, event, box) serviced by the
         #: engine thread between dispatches (see `_exec`).
         self._requests: list = []

@@ -6,7 +6,9 @@ the package costs nothing on the happy path. See `faults.py` for the
 spec grammar and the FaultySocket wrapper, and `chaos.py` for the
 seeded multi-session chaos harness composed on top of it (imported on
 demand — it pulls in numpy/stepper machinery the fault plane does not
-need)."""
+need). `leaks.py` adds the per-test concurrency guard: lockcheck
+forced ON plus a thread/socket leak census around each distributed
+test."""
 
 from gol_tpu.testing.faults import (
     FaultPlan,
@@ -18,8 +20,11 @@ from gol_tpu.testing.faults import (
     install,
     wrap,
 )
+from gol_tpu.testing.leaks import assert_no_leaks, lockcheck_guard
 
 __all__ = [
+    "assert_no_leaks",
+    "lockcheck_guard",
     "FaultPlan",
     "FaultRule",
     "FaultSpecError",

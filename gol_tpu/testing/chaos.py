@@ -428,6 +428,10 @@ class ChaosRunner:
             os.path.abspath(gol_tpu.__file__)
         ))
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        # Chaos always runs with the deadlock/leak detector armed: a
+        # fault schedule that drives the server into a lock-order cycle
+        # or a held-too-long stall must fail the run, not hang it.
+        env.setdefault("GOL_TPU_LOCKCHECK", "1")
         if self.fault_spec:
             env["GOL_TPU_FAULTS"] = self.fault_spec
         log = open(self._log_path, "w")
@@ -616,6 +620,14 @@ class ChaosRunner:
                     f"{int(violations)} invariant violation(s) on the "
                     f"server"
                 )
+            lock_reports = parse_metric(
+                metrics, "gol_tpu_lockcheck_violations_total"
+            )
+            if lock_reports:
+                complaints.append(
+                    f"{int(lock_reports)} lockcheck report(s) on the "
+                    f"server (lock-order cycle or held-too-long)"
+                )
             report.update(
                 verbs=self._verb_count,
                 sessions_verified=verified,
@@ -633,6 +645,7 @@ class ChaosRunner:
                     metrics, "gol_tpu_server_degraded_recoveries_total"
                 ),
                 invariant_violations=int(violations),
+                lockcheck_violations=int(lock_reports),
             )
             ctl.close()
             boot_ctl.close()

@@ -88,7 +88,13 @@ LOWER_BETTER = re.compile(
     # healthy bench box, so any capture where it moves off a zero
     # baseline gates as an infinite regression — the SLO evaluator
     # itself saw the lane break.
-    r"|turn_age|alerts_firing)", re.I
+    r"|turn_age|alerts_firing"
+    # Concurrency plane (ISSUE 16): runtime lock-order cycles,
+    # held-too-long holds, and thread-ownership breaches sit at 0 on a
+    # healthy run — any capture that moves `lockcheck`/`lock_order`/
+    # `ownership` off a zero baseline is an infinite regression (the
+    # deadlock detector fired during a bench).
+    r"|lock_order|ownership|lockcheck)", re.I
 )
 
 

@@ -29,6 +29,9 @@ fail() { echo "chaos smoke: FAILED — $1" >&2; shift
          exit 1; }
 
 echo "chaos smoke: seed $SEED, workdir $WORK"
+# Deadlock/leak detector armed end-to-end (ISSUE 16): the server child
+# inherits it; the report must show zero lockcheck violations.
+export GOL_TPU_LOCKCHECK=1
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m gol_tpu.testing.chaos \
     --seed "$SEED" --workdir "$WORK" --storms 2 --verbs 12 --kills 1 \
     --faults "server:reset@send:50;server:reset@recv:80" \
@@ -44,6 +47,9 @@ if r.get("kills", 0) < 1:
     problems.append("the SIGKILL never happened")
 if r.get("invariant_violations", 1) != 0:
     problems.append(f"{r['invariant_violations']} invariant violations")
+if r.get("lockcheck_violations", 1) != 0:
+    problems.append(f"{r.get('lockcheck_violations')} lockcheck "
+                    "violations (lock-order cycle or held-too-long)")
 if r.get("degradations", 0) <= 0:
     problems.append("no slow-consumer degradation: the stalled "
                     "observers were never shed (or were evicted)")
@@ -62,5 +68,6 @@ print("chaos smoke: OK — "
       f"degradations={int(r['degradations'])} "
       f"recoveries={int(r['recoveries'])} "
       f"observer_verified_turn={r['observer_verified_turn']} "
-      f"invariant_violations={r['invariant_violations']}")
+      f"invariant_violations={r['invariant_violations']} "
+      f"lockcheck_violations={r['lockcheck_violations']}")
 EOF

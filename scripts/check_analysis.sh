@@ -12,6 +12,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# The race regression corpus first: every historically-shipped race in
+# tests/fixtures/concurrency/ must still be flagged by the concurrency
+# passes — an analyzer that stops seeing old bugs is a silent downgrade.
+python -m gol_tpu.analysis.concurrency.corpus tests/fixtures/concurrency
+
 if python -m gol_tpu.analysis --strict "$@"; then
     echo "analysis gate: clean (all findings fixed or allowlisted)"
 else

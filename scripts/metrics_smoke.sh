@@ -12,6 +12,10 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# Deadlock/leak detector armed for both runs (ISSUE 16): the lockcheck
+# violation counter must stay zero on every scrape below.
+export GOL_TPU_LOCKCHECK=1
+
 LOG=$(mktemp)
 OUT=$(mktemp -d)
 LOG2=$(mktemp)
@@ -242,8 +246,10 @@ assert val("gol_tpu_server_batch_turns_count") > 0, \
     "server encoded no batch frames"
 assert val("gol_tpu_server_batch_turns_sum") >= 64, \
     "batch frames carried almost no turns"
+assert val("gol_tpu_lockcheck_violations_total") == 0, \
+    "lockcheck reported a lock-order cycle or held-too-long hold"
 ' <<<"$METRICS2" || {
-    echo "metrics smoke: FAILED — gol_tpu_server_batch_turns not moving" >&2
+    echo "metrics smoke: FAILED — batch plane stuck or lockcheck fired" >&2
     exit 1
 }
 

@@ -1,0 +1,30 @@
+# lint-expect: thread-ownership
+"""Heartbeat-starvation regression, re-encoded: the liveness judge
+reads session state through a manager VERB, which waits out the
+manager lock — held across bucket compiles on the engine thread. A
+cold compile stalls the judge past the eviction deadline and live
+peers are dropped for beacons they sent on time (the pre-hardening
+shape; the shipped loop reads the lock-free `peek_turn` surface, per
+the thread-ownership table).
+"""
+
+import time
+
+
+class Server:
+    def __init__(self, manager):
+        self.manager = manager
+        self.conns = []
+        self.evict_secs = 6.0
+
+    def _heartbeat_loop(self):
+        while True:
+            now = time.monotonic()
+            for conn in list(self.conns):
+                # BUG (the starvation shape): manager.get is a verb —
+                # it waits on the manager lock the engine holds across
+                # compiles; the judge must use peek_turn/known.
+                sess = self.manager.get(conn.sid)
+                if sess is None or now - conn.last_beat > self.evict_secs:
+                    self.conns.remove(conn)
+            time.sleep(2.0)

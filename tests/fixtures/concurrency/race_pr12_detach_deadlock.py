@@ -1,0 +1,54 @@
+# lint-expect: lock-order
+"""PR 12 regression, re-encoded: the reader-drop path detaches a sink
+from the session manager while still holding the server's `_conn_lock`,
+and the engine thread — holding the manager lock inside verb dispatch —
+calls back into the server's drop path, which takes `_conn_lock`. Two
+threads, the same two locks, opposite orders: the deadlock PR 12 fixed
+by moving `manager.detach` OUTSIDE `_conn_lock` in `_drop_conn`.
+
+The static pass must merge the `_conn_lock -> SessionManager._lock`
+edge (reader_drop) with the `SessionManager._lock -> _conn_lock` edge
+(service -> drop_conn, through the call graph) and flag the cycle.
+"""
+
+import threading
+
+
+class SessionManager:
+    def __init__(self, server):
+        self._lock = threading.RLock()
+        self.server: SessionServer = server
+        self.sinks = []
+
+    def detach(self, sink):
+        with self._lock:
+            if sink in self.sinks:
+                self.sinks.remove(sink)
+
+    def service(self):
+        # Engine thread: verb dispatch under the manager lock notifies
+        # the server of closed sessions — taking _conn_lock inside.
+        with self._lock:
+            for sink in list(self.sinks):
+                if sink.closed:
+                    self.server.drop_conn(sink)
+
+
+class SessionServer:
+    def __init__(self):
+        self._conn_lock = threading.Lock()
+        self.manager = SessionManager(self)
+        self.conns = []
+
+    def drop_conn(self, conn):
+        with self._conn_lock:
+            if conn in self.conns:
+                self.conns.remove(conn)
+
+    def reader_drop(self, conn):
+        # BUG (the shipped PR 12 shape): detach re-enters the manager
+        # lock while _conn_lock is held — reversed against service().
+        with self._conn_lock:
+            if conn in self.conns:
+                self.conns.remove(conn)
+            self.manager.detach(conn)

@@ -1,0 +1,40 @@
+# lint-expect: lock-blocking
+"""PR 7 regression, re-encoded: `_admit` attaches the peer's sink to
+the session manager while holding `_conn_lock`. `manager.attach` can
+sit behind a cold bucket compile (seconds), so every path wanting
+`_conn_lock` — including the heartbeat judge — waits it out, and live
+peers get evicted for "missing" beacons they sent on time. PR 7's fix
+started the reader (and released the lock) before attaching.
+
+The static pass must see through the call: `attach` blocks (an event
+wait standing in for the engine-thread round trip), and `admit` calls
+it with `_conn_lock` held.
+"""
+
+import threading
+
+
+class Manager:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._done = threading.Event()
+
+    def attach(self, sink):
+        # Stand-in for the engine-thread round trip: a cold bucket's
+        # compile + dispatch finishes before the attach returns.
+        self._done.wait(60.0)
+        return {"sid": sink.sid}
+
+
+class Server:
+    def __init__(self):
+        self._conn_lock = threading.Lock()
+        self.manager = Manager()
+        self.conns = []
+
+    def admit(self, conn):
+        # BUG (the shipped PR 7 shape): the blocking attach runs under
+        # the connection lock the heartbeat judge also needs.
+        with self._conn_lock:
+            self.conns.append(conn)
+            self.manager.attach(conn)

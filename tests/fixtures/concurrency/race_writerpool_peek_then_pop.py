@@ -1,0 +1,40 @@
+# lint-expect: guarded-field
+"""Writer-pool regression, re-encoded: the service loop peeks the
+frame queue, sends, then pops — all outside the lock `enqueue` mutates
+the queue under. An `enqueue(front=True)` (urgent control frame)
+landing between peek and pop makes the pop remove the URGENT frame
+while the peeked data frame is re-sent: the race the shipped pool
+fixed by moving the in-flight frame to a `_sending` slot claimed under
+the lock.
+
+The static pass must notice `_q` is lock-guarded in one method and
+mutated bare in another.
+"""
+
+import threading
+from collections import deque
+
+
+class PoolHandle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = deque()
+        self._frames = 0
+
+    def enqueue(self, frame, front=False):
+        with self._lock:
+            if front:
+                self._q.appendleft(frame)
+            else:
+                self._q.append(frame)
+            self._frames += 1
+
+    def service(self, wsock):
+        # BUG (the shipped peek-then-pop shape): peek, send, THEN pop
+        # with no lock — racing enqueue(front=True) drops the urgent
+        # frame and double-sends the peeked one.
+        if not self._q:
+            return
+        frame = self._q[0]
+        wsock.send(frame)
+        self._q.popleft()

@@ -1,0 +1,37 @@
+# lint-expect: guarded-field
+"""WS-gauge regression, re-encoded: the reader-path drop decrements
+the peer gauge without the connection lock, racing the heartbeat
+evictor's locked decrement — one disconnect, two decrements, and the
+gauge goes negative (the double-decrement the relay fixed by routing
+every drop through one locked `_drop_from_reader`).
+
+The static pass must flag the bare `ws_peers -= 1` (and the bare
+`conns.remove`) against their locked twins.
+"""
+
+import threading
+
+
+class RelayNode:
+    def __init__(self):
+        self._conn_lock = threading.Lock()
+        self.ws_peers = 0
+        self.conns = []
+
+    def admit(self, conn):
+        with self._conn_lock:
+            self.conns.append(conn)
+            self.ws_peers += 1
+
+    def evict(self, conn):
+        with self._conn_lock:
+            if conn in self.conns:
+                self.conns.remove(conn)
+                self.ws_peers -= 1
+
+    def reader_drop(self, conn):
+        # BUG (the shipped double-decrement shape): the reader's drop
+        # path skips the lock — racing evict() decrements twice.
+        if conn in self.conns:
+            self.conns.remove(conn)
+        self.ws_peers -= 1

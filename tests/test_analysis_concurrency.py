@@ -1,0 +1,387 @@
+"""The concurrency plane (ISSUE 16): static lock-graph passes
+(lock-order cycles, blocking-under-lock, thread-ownership,
+guarded-field) on synthetic rights and wrongs, the shipped-race
+regression corpus, and the dynamic twin (GOL_TPU_LOCKCHECK tracked
+locks: runtime order graph, held-too-long watchdog, resource census).
+"""
+
+import pathlib
+import socket
+import textwrap
+import threading
+import time
+
+import pytest
+
+from gol_tpu.analysis.concurrency import CONCURRENCY_CHECKS, lockcheck
+from gol_tpu.analysis.concurrency.corpus import expected_checks, run_corpus
+from gol_tpu.analysis.jaxlint import lint_paths
+from gol_tpu.testing.leaks import assert_no_leaks, snapshot
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _lint(tmp_path, code, name="mod.py"):
+    """Stage a snippet inside the serving-plane scope the concurrency
+    checks are path-limited to, then run only those checks."""
+    d = tmp_path / "gol_tpu" / "distributed"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / name
+    f.write_text(textwrap.dedent(code))
+    return lint_paths([tmp_path / "gol_tpu"], tmp_path,
+                      checks=CONCURRENCY_CHECKS)
+
+
+def _checks(findings):
+    return {f.check for f in findings}
+
+
+# --- lock-order: acquisition-order cycles across the call graph ---
+
+
+def test_lock_order_flags_ab_ba_cycle(tmp_path):
+    findings = _lint(tmp_path, """
+        import threading
+
+        class Manager:
+            def __init__(self, server):
+                self._lock = threading.Lock()
+                self.server: Server = server
+
+            def service(self, sid):
+                with self._lock:
+                    self.server.drop_conn(sid)
+
+        class Server:
+            def __init__(self, manager):
+                self._conn_lock = threading.Lock()
+                self.manager: Manager = manager
+
+            def drop_conn(self, sid):
+                with self._conn_lock:
+                    pass
+
+            def reader_drop(self, sid):
+                with self._conn_lock:
+                    self.manager.service(sid)
+    """)
+    assert "lock-order" in _checks(findings)
+    msgs = [f.message for f in findings if f.check == "lock-order"]
+    assert any("Manager._lock" in m and "Server._conn_lock" in m
+               for m in msgs)
+
+
+def test_lock_order_clean_when_order_is_consistent(tmp_path):
+    findings = _lint(tmp_path, """
+        import threading
+
+        class Node:
+            def __init__(self):
+                self._board_lock = threading.Lock()
+                self._conn_lock = threading.Lock()
+
+            def publish(self):
+                with self._board_lock:
+                    with self._conn_lock:
+                        pass
+
+            def snapshot(self):
+                with self._board_lock:
+                    with self._conn_lock:
+                        pass
+    """)
+    assert "lock-order" not in _checks(findings)
+
+
+# --- lock-blocking: unbounded waits under a held lock ---
+
+
+def test_lock_blocking_flags_direct_sendall_under_lock(tmp_path):
+    findings = _lint(tmp_path, """
+        import threading
+
+        class Broadcaster:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self.sock = sock
+
+            def push(self, payload):
+                with self._lock:
+                    self.sock.sendall(payload)
+    """)
+    assert "lock-blocking" in _checks(findings)
+
+
+def test_lock_blocking_flags_transitive_blocking_call(tmp_path):
+    findings = _lint(tmp_path, """
+        import threading
+
+        class _Conn:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self.sock = sock
+
+            def _flush(self, payload):
+                self.sock.sendall(payload)
+
+            def push(self, payload):
+                with self._lock:
+                    self._flush(payload)
+    """)
+    msgs = [f.message for f in findings if f.check == "lock-blocking"]
+    assert msgs, "blocking reached through a helper call was missed"
+    assert any("_flush" in m for m in msgs)
+
+
+def test_lock_blocking_clean_when_send_is_outside_lock(tmp_path):
+    findings = _lint(tmp_path, """
+        import threading
+
+        class _Conn:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self.sock = sock
+                self.pending = []
+
+            def push(self, payload):
+                with self._lock:
+                    self.pending.append(payload)
+                self.sock.sendall(payload)
+    """)
+    assert "lock-blocking" not in _checks(findings)
+
+
+# --- thread-ownership: the who-may-do-what table ---
+
+
+def test_ownership_flags_send_outside_sanctioned_scope(tmp_path):
+    findings = _lint(tmp_path, """
+        class Broadcaster:
+            def push(self, sock, payload):
+                sock.sendall(payload)
+    """)
+    assert "thread-ownership" in _checks(findings)
+
+
+def test_ownership_flags_manager_verb_in_heartbeat_loop(tmp_path):
+    findings = _lint(tmp_path, """
+        class Server:
+            def _heartbeat_loop(self):
+                for conn in list(self.conns):
+                    sess = self.manager.get(conn.sid)
+    """)
+    msgs = [f.message for f in findings if f.check == "thread-ownership"]
+    assert msgs and any("peek_turn" in m for m in msgs)
+
+
+def test_ownership_clean_for_heartbeat_peek_surface(tmp_path):
+    findings = _lint(tmp_path, """
+        class Server:
+            def _heartbeat_loop(self):
+                for conn in list(self.conns):
+                    turn = self.manager.peek_turn(conn.sid)
+                    known = self.manager.known(conn.sid)
+    """)
+    assert "thread-ownership" not in _checks(findings)
+
+
+def test_ownership_flags_block_until_ready_in_serving_plane(tmp_path):
+    findings = _lint(tmp_path, """
+        class Pump:
+            def step(self, x):
+                x.block_until_ready()
+                return x
+    """)
+    assert "thread-ownership" in _checks(findings)
+
+
+def test_ownership_flags_manager_internal_verb_from_outside(tmp_path):
+    findings = _lint(tmp_path, """
+        class Admission:
+            def evict(self, sid):
+                self.manager._destroy(sid)
+    """)
+    assert "thread-ownership" in _checks(findings)
+
+
+# --- guarded-field: sometimes-locked mutations ---
+
+
+def test_guarded_field_flags_bare_mutation_of_locked_field(tmp_path):
+    findings = _lint(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+                self.peers = 0
+
+            def enqueue(self, item):
+                with self._lock:
+                    self._q.append(item)
+                    self.peers += 1
+
+            def service(self):
+                item = self._q.pop()
+                self.peers -= 1
+                return item
+    """)
+    msgs = [f.message for f in findings if f.check == "guarded-field"]
+    assert len(msgs) >= 2  # both _q.pop() and peers -= 1
+    assert any("_q" in m for m in msgs)
+    assert any("peers" in m for m in msgs)
+
+
+def test_guarded_field_clean_when_always_locked_and_init_exempt(tmp_path):
+    findings = _lint(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+                self._q.append(None)  # __init__ is pre-publication
+
+            def enqueue(self, item):
+                with self._lock:
+                    self._q.append(item)
+
+            def _drain_locked(self):
+                self._q.clear()
+    """)
+    assert "guarded-field" not in _checks(findings)
+
+
+# --- the regression corpus: every shipped race stays flagged ---
+
+
+def test_corpus_every_shipped_race_still_fires():
+    failures, fired = run_corpus(REPO / "tests" / "fixtures" / "concurrency")
+    assert failures == [], failures
+    assert len(fired) >= 3, (
+        f"corpus shrank below the ISSUE 16 floor: {sorted(fired)}"
+    )
+    all_fired = set().union(*fired.values())
+    assert {"lock-order", "lock-blocking",
+            "guarded-field", "thread-ownership"} <= all_fired
+
+
+def test_corpus_fixture_without_header_is_a_failure(tmp_path):
+    (tmp_path / "race_undeclared.py").write_text("x = 1\n")
+    failures, _ = run_corpus(tmp_path)
+    assert any("lint-expect" in f for f in failures)
+
+
+def test_expected_checks_parses_header():
+    src = "# lint-expect: lock-order, guarded-field\nclass A: pass\n"
+    assert expected_checks(src) == {"lock-order", "guarded-field"}
+
+
+# --- the dynamic twin: tracked locks, watchdog, census ---
+
+
+def test_make_lock_is_plain_when_lockcheck_off(monkeypatch):
+    monkeypatch.delenv("GOL_TPU_LOCKCHECK", raising=False)
+    lk = lockcheck.make_lock("Off.lock")
+    assert isinstance(lk, type(threading.Lock()))
+
+
+def test_runtime_order_cycle_is_reported_not_hung(monkeypatch):
+    monkeypatch.setenv("GOL_TPU_LOCKCHECK", "1")
+    a = lockcheck.make_lock("CycleT.A")
+    b = lockcheck.make_lock("CycleT.B")
+    before = lockcheck.reports_total()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=ab)
+    t.start()
+    t.join()
+    with b:       # the reversed order: closes the cycle, reported
+        with a:   # BEFORE this acquire (which succeeds — t is done)
+            pass
+    new = lockcheck.reports_total() - before
+    assert new == 1
+    last = lockcheck.reports()[-1]
+    assert last["kind"] == "lock-order"
+    assert "CycleT.A" in last["msg"] and "CycleT.B" in last["msg"]
+
+
+def test_reentrant_rlock_is_not_a_cycle(monkeypatch):
+    monkeypatch.setenv("GOL_TPU_LOCKCHECK", "1")
+    r = lockcheck.make_rlock("ReentT.R")
+    before = lockcheck.reports_total()
+    with r:
+        with r:
+            pass
+    assert lockcheck.reports_total() == before
+
+
+def test_held_too_long_watchdog_fires(monkeypatch):
+    monkeypatch.setenv("GOL_TPU_LOCKCHECK", "1")
+    monkeypatch.setenv("GOL_TPU_LOCKCHECK_MAX_HELD_SECS", "0.05")
+    lk = lockcheck.make_lock("SlowT.lock")
+    before = lockcheck.reports_total()
+    with lk:
+        time.sleep(0.3)
+    assert lockcheck.reports_total() - before >= 1
+    tail = [r for r in lockcheck.reports()
+            if r["kind"] == "held-too-long" and "SlowT.lock" in r["msg"]]
+    assert tail, "neither the watchdog nor the release check reported"
+
+
+def test_census_sees_listener_and_leak_assert_clears(monkeypatch):
+    before = snapshot()
+    srv = socket.create_server(("127.0.0.1", 0))
+    try:
+        grown = snapshot()
+        new = [s for s in grown["listen_sockets"]
+               if s not in before["listen_sockets"]]
+        assert new, "census missed a freshly bound listener"
+        with pytest.raises(AssertionError, match="resource leak"):
+            assert_no_leaks(before, grace=0.2)
+    finally:
+        srv.close()
+    assert_no_leaks(before)  # closed: the delta drains within grace
+
+
+def test_census_sees_non_daemon_thread(monkeypatch):
+    done = threading.Event()
+    before = snapshot()
+    t = threading.Thread(target=done.wait, name="census-probe",
+                         daemon=False)
+    t.start()
+    try:
+        grown = snapshot()
+        assert "census-probe" in grown["non_daemon_threads"]
+        with pytest.raises(AssertionError, match="resource leak"):
+            assert_no_leaks(before, grace=0.2)
+    finally:
+        done.set()
+        t.join()
+    assert_no_leaks(before)
+
+
+def test_shipped_serving_locks_route_through_factory():
+    """Every serving-plane lock must be built by make_lock/make_rlock —
+    a raw threading.Lock() in those modules is invisible to the
+    dynamic twin. (distributor.py is exempted down to its engine
+    internals only; its serving-side _req_lock is converted.)"""
+    import re
+    bad = []
+    for rel in ("distributed/server.py", "distributed/client.py",
+                "relay/writerpool.py", "relay/node.py",
+                "sessions/manager.py", "replay/server.py"):
+        src = (REPO / "gol_tpu" / rel).read_text()
+        for i, line in enumerate(src.splitlines(), 1):
+            if re.search(r"=\s*threading\.(R)?Lock\(\)", line):
+                bad.append(f"{rel}:{i}: {line.strip()}")
+    assert bad == [], (
+        "raw threading.Lock() in the serving plane — use "
+        "lockcheck.make_lock/make_rlock so the dynamic twin sees it: "
+        + "; ".join(bad)
+    )

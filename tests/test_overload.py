@@ -34,18 +34,15 @@ import pytest
 from gol_tpu import obs
 from gol_tpu.distributed import wire
 from gol_tpu.params import Params
+from gol_tpu.testing.leaks import lockcheck_guard
 
 
 @pytest.fixture(autouse=True)
 def _invariants_on(monkeypatch):
-    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
-    from gol_tpu.analysis.invariants import violations_total
-
-    before = violations_total()
-    yield
-    assert violations_total() - before == 0, (
-        "a runtime invariant broke during an overload scenario"
-    )
+    """Invariants AND lockcheck forced ON for every overload test:
+    zero invariant violations, zero lock-order/watchdog reports, and no
+    leaked non-daemon thread or listening socket at teardown."""
+    yield from lockcheck_guard(monkeypatch)
 
 
 def _series(name, **labels):

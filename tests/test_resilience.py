@@ -28,24 +28,16 @@ from gol_tpu.events import CellFlipped, FinalTurnComplete, TurnComplete
 from gol_tpu.io.pgm import read_pgm
 from gol_tpu.params import Params
 from gol_tpu.testing import FaultPlan, FaultSpecError, faults
+from gol_tpu.testing.leaks import lockcheck_guard
 from gol_tpu.visual.board import NumpyBoard
 
 
 @pytest.fixture(autouse=True)
 def _invariant_violation_guard(monkeypatch):
-    """Same contract as test_distributed: invariants ON, any violation
-    (even one swallowed by a daemon thread) fails through the registry
-    counter — injected faults must not break the protocol."""
-    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
-    from gol_tpu.analysis.invariants import violations_total
-
-    before = violations_total()
-    yield
-    grew = violations_total() - before
-    assert grew == 0, (
-        f"gol_tpu_invariant_violations_total grew by {grew}: an injected "
-        "fault corrupted the distributed protocol"
-    )
+    """Same contract as test_distributed, extended: invariants AND
+    lockcheck ON — injected faults must not break the protocol, order
+    locks inconsistently, or leak threads/listeners at teardown."""
+    yield from lockcheck_guard(monkeypatch)
 
 
 @pytest.fixture(autouse=True)

@@ -30,23 +30,16 @@ from gol_tpu.sessions import (
     Sink,
     valid_session_id,
 )
+from gol_tpu.testing.leaks import lockcheck_guard
 
 
 @pytest.fixture(autouse=True)
 def _invariants_on(monkeypatch):
-    """Runtime invariants forced ON for every session test; any
-    violation — even one swallowed by a daemon thread — fails the test
-    through the violations counter (the test_distributed guard)."""
-    monkeypatch.setenv("GOL_TPU_CHECK_INVARIANTS", "1")
-    from gol_tpu.analysis.invariants import violations_total
-
-    before = violations_total()
-    yield
-    grew = violations_total() - before
-    assert grew == 0, (
-        f"gol_tpu_invariant_violations_total grew by {grew} during a "
-        "session test"
-    )
+    """Runtime invariants AND lockcheck forced ON for every session
+    test (the test_distributed guard, extended): zero invariant
+    violations, zero lock-order/watchdog reports, and no leaked
+    non-daemon thread or listening socket at teardown."""
+    yield from lockcheck_guard(monkeypatch)
 
 
 def _soup(seed: int, side: int = 64, density: float = 0.3) -> np.ndarray:
