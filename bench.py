@@ -38,6 +38,7 @@ import contextlib
 import json
 import os
 import pathlib
+import statistics
 import subprocess
 import sys
 import time
@@ -832,6 +833,128 @@ def measure_wire_watched_batch(sweep=(16, 64, 256, 1024),
     return out
 
 
+def measure_wire_watched_accounting(measure_secs: float = 1.0,
+                                    batch_turns: int = 64,
+                                    settle_turns: int = 10_000,
+                                    rounds: int = 10) -> dict:
+    """Accounting-plane overhead A/B (ISSUE 17 acceptance lane): the
+    batched watched wire — the hottest per-event serving path, where
+    every send crosses the `_Conn` wire-bytes choke point — with the
+    meter ON (the default) vs OFF (`GOL_TPU_ACCOUNTING=0` semantics
+    via `accounting.set_enabled`, which leaves every call site a
+    single None-check). Reports
+
+        accounting_overhead_pct = (off_tps - on_tps) / off_tps * 100
+
+    LOWER_BETTER; the acceptance bar is <= 2%. The design is PAIRED:
+    one server + one controller serve the whole measurement, and the
+    meter toggles between alternating windows on that single live
+    stream, `rounds` times each way; the reported overhead is the
+    MEDIAN of the per-round off-vs-on deltas. Fresh-process-per-leg
+    A/Bs on a shared box swing tens of percent between runs (GC
+    pauses, scheduler preemption, shed/resync regime oscillation) —
+    adjacent paired windows share regime, and the median discards the
+    rounds where a regime flip landed between the pair. The final
+    on-window also proves the plane SAW the run: its grand totals
+    must carry nonzero wire bytes, or the A/B measured nothing."""
+    import queue as _q
+
+    import jax
+
+    from gol_tpu.distributed import Controller, EngineServer
+    from gol_tpu.events import TurnComplete
+    from gol_tpu.obs import accounting
+    from gol_tpu.params import Params
+    from gol_tpu.parallel.stepper import make_stepper
+
+    st = make_stepper(threads=1, height=H, width=W,
+                      devices=[jax.devices()[0]])
+    q0, c = st.step_n(st.put(_world(W)), settle_turns)
+    int(c)
+    settled = st.fetch(q0)
+    p = Params(turns=10**9, threads=1, image_width=W, image_height=H,
+               chunk=0, tick_seconds=60.0, image_dir="images",
+               out_dir="out", cycle_detect=True)
+    server = EngineServer(p, port=0, initial_world=settled).start()
+    ctl = Controller(*server.address, want_flips=True, batch=True,
+                     batch_turns=batch_turns, batch_flip_events=False)
+
+    def drain_window(budget: float):
+        n = 0
+        t0 = time.perf_counter()
+        end = t0 + budget
+        while time.perf_counter() < end:
+            try:
+                evs = ctl.events.get_batch(65536, timeout=0.2)
+            except _q.Empty:
+                continue
+            if evs is None:
+                break
+            n += sum(1 for e in evs if isinstance(e, TurnComplete))
+        return n, time.perf_counter() - t0
+
+    turns = {"meter_on": 0, "meter_off": 0}
+    secs = {"meter_on": 0.0, "meter_off": 0.0}
+    deltas = []
+    try:
+        drain_window(1.0)  # warm: measure the flowing steady state
+        # meter_off first, meter_on last: each enable mints a fresh
+        # meter, so the payload read below holds exactly the last
+        # on-window's charges.
+        for _ in range(rounds):
+            pair = {}
+            for name, on in (("meter_off", False), ("meter_on", True)):
+                accounting.set_enabled(on)
+                n, dt = drain_window(measure_secs)
+                turns[name] += n
+                secs[name] += dt
+                pair[name] = n / dt if dt else 0.0
+            if pair["meter_off"]:
+                deltas.append((pair["meter_off"] - pair["meter_on"])
+                              / pair["meter_off"] * 100.0)
+        totals = accounting.payload().get("totals", {})
+    finally:
+        accounting.set_enabled(True)
+        with contextlib.suppress(Exception):
+            ctl.detach(30)
+        server.shutdown()
+        ctl.close()
+    if not (turns["meter_on"] and turns["meter_off"] and deltas):
+        return {"error": f"a leg delivered no turns: {turns}"}
+    # Wire bytes are the evidence the meter saw the stream: with
+    # cycle_detect the engine rides the proven cycle, so zero device
+    # dispatches (and zero charged turns) is the CORRECT bill here.
+    if not totals.get("wire_bytes", 0):
+        return {"error": f"meter-on windows charged nothing: {totals}"}
+    on_tps = turns["meter_on"] / secs["meter_on"]
+    off_tps = turns["meter_off"] / secs["meter_off"]
+    med = statistics.median(deltas)
+    return {
+        "batch_turns": batch_turns,
+        "rounds": rounds,
+        # Clamped at zero: a negative median means the meter's cost is
+        # below this box's noise floor, and a negative baseline would
+        # turn any later healthy capture into a fake bench_compare
+        # regression (LOWER_BETTER against a negative denominator).
+        # The raw median and spread sit beside it, informational.
+        "accounting_overhead_pct": round(max(0.0, med), 2),
+        "median_delta_pct": round(med, 2),
+        "delta_pct_spread": {
+            "min": round(min(deltas), 2), "max": round(max(deltas), 2),
+        },
+        # "delta", not "overhead": the pooled number keeps the regime
+        # noise the median exists to discard — informational only, must
+        # not match bench_compare's LOWER_BETTER `overhead` token.
+        "aggregate_delta_pct": round(
+            (off_tps - on_tps) / off_tps * 100.0, 2),
+        "meter_on": {"turns_per_sec": round(on_tps, 1),
+                     "turns": turns["meter_on"]},
+        "meter_off": {"turns_per_sec": round(off_tps, 1),
+                      "turns": turns["meter_off"]},
+        "usage_totals": {k: v for k, v in totals.items() if v},
+    }
+
+
 def measure_activity(side: int = 32768, tile: int = 1024,
                      turns: int = 64, soup_side: int = 512,
                      seed: int = 7) -> dict:
@@ -1609,6 +1732,14 @@ def main() -> None:
         )
     except Exception as e:
         detail["wire_watched_512x512_batch"] = {"error": repr(e)}
+    # Accounting-plane overhead A/B (ISSUE 17): meter-on vs meter-off
+    # on the same batched watched path; the gate is <= 2% overhead.
+    try:
+        detail["wire_watched_accounting"] = _lane(
+            measure_wire_watched_accounting
+        )
+    except Exception as e:
+        detail["wire_watched_accounting"] = {"error": repr(e)}
     try:
         detail["wire_watched_512x512_coords"] = measure_wire_watched(
             delta=False

@@ -38,6 +38,10 @@ _OBS_MODULES = (
     "gol_tpu.obs.flight",
     "gol_tpu.obs.device",
     "gol_tpu.obs.console",
+    # PR 17: metering is host-side at dispatch/event granularity —
+    # a charge() inside a traced function would bake one Python-time
+    # sample into the compiled program.
+    "gol_tpu.obs.accounting",
 )
 
 

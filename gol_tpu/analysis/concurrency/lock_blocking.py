@@ -33,7 +33,11 @@ from gol_tpu.analysis.concurrency.graph import blocking_op, index_for
 CHECK = "lock-blocking"
 
 SCOPE_PREFIX = ("gol_tpu/distributed/", "gol_tpu/relay/",
-                "gol_tpu/sessions/", "gol_tpu/replay/", "gol_tpu/engine/")
+                "gol_tpu/sessions/", "gol_tpu/replay/", "gol_tpu/engine/",
+                # PR 17: the accounting plane's contract is that ledger
+                # file I/O never runs under a lock the serving path
+                # takes — the meter's lock only guards dict updates.
+                "gol_tpu/obs/accounting")
 
 
 def run_project(ctxs: Sequence[ModuleContext]) -> Iterator[Finding]:

@@ -114,6 +114,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "at /alerts, transitions counted and noted in "
                          "the flight recorder; a parse error is a "
                          "STARTUP error, never a runtime crash")
+    ap.add_argument("--session-budget-flops", type=float, default=None,
+                    dest="session_budget_flops", metavar="FLOPS",
+                    help="with --serve --sessions: soft per-tenant "
+                         "modeled-FLOPs budget (accounting plane, "
+                         "docs/OBSERVABILITY.md) — over-budget tenants "
+                         "raise gol_tpu_usage_over_budget (alert-rule "
+                         "food) and show BUDG=OVER in obs.console; "
+                         "deliberately never enforced")
+    ap.add_argument("--session-budget-bytes", type=float, default=None,
+                    dest="session_budget_bytes", metavar="BYTES",
+                    help="with --serve --sessions: soft per-tenant "
+                         "wire-bytes budget — same advisory semantics "
+                         "as --session-budget-flops")
     ap.add_argument("--profile-dir", default=None, dest="profile_dir",
                     metavar="DIR",
                     help="capture a jax.profiler device trace into DIR "
@@ -423,6 +436,19 @@ def main(argv: Optional[list[str]] = None) -> int:
     # stops it at exit (atexit inside start_profile).
     device.install_compile_watcher()
     device.enable_cost_probes()
+    # Accounting plane (docs/OBSERVABILITY.md "Accounting plane"):
+    # engines and serving tiers keep a crash-safe usage ledger under
+    # <out>/usage and honor the soft budgets; a --connect controller
+    # spends on the server's bill, not its own. All no-ops under
+    # GOL_TPU_ACCOUNTING=0 (zero ledger I/O).
+    from gol_tpu.obs import accounting
+
+    if args.connect is None:
+        accounting.configure(
+            out_dir=args.out,
+            budget_flops=args.session_budget_flops,
+            budget_bytes=args.session_budget_bytes,
+        )
     if args.profile_dir:
         if device.start_profile(args.profile_dir):
             print(f"jax profiler capturing to {args.profile_dir}")
@@ -498,6 +524,15 @@ def main(argv: Optional[list[str]] = None) -> int:
         raise SystemExit(
             "error: --park-idle-secs applies to --serve --sessions "
             "(hibernation is a session-plane policy)"
+        )
+    if (args.session_budget_flops is not None
+            or args.session_budget_bytes is not None) \
+            and not args.sessions:
+        # A silently ignored budget would leave an operator believing
+        # tenants are being watched.
+        raise SystemExit(
+            "error: --session-budget-flops/--session-budget-bytes "
+            "apply to --serve --sessions (per-tenant accounting)"
         )
     if args.record and not args.sessions:
         raise SystemExit(

@@ -49,7 +49,7 @@ import numpy as np
 
 from gol_tpu import obs
 from gol_tpu.checkpoint import snapshot_turn
-from gol_tpu.obs import flight, tracing
+from gol_tpu.obs import accounting, flight, tracing
 from gol_tpu.obs.freshness import ServerFreshness
 from gol_tpu.distributed import wire
 from gol_tpu.relay.writerpool import PoolFull, WriterPool
@@ -195,6 +195,16 @@ class _LagHandle:
         self._family.remove_child(self._child)
 
 
+#: Every per-peer labeled family is declared to the shared
+#: entity-eviction helper (obs.registry): teardown calls ONE
+#: `evict_entity("peer", token)` instead of remembering each family,
+#: so a new per-peer series added later inherits eviction by
+#: declaring itself here-adjacent rather than patching every detach
+#: path (the bounded-cardinality audit, docs/OBSERVABILITY.md).
+obs.track_entity_series("peer", "gol_tpu_server_peer_lag_frames",
+                        topk=True)
+
+
 def install_lag_gauge(conn: "_Conn") -> None:
     """Per-peer backpressure visibility: how many frames behind this
     peer's writer queue is. Bounded-cardinality discipline: children
@@ -207,8 +217,18 @@ def install_lag_gauge(conn: "_Conn") -> None:
 
 def remove_lag_gauge(conn: "_Conn") -> None:
     if conn.lag_metric is not None:
-        conn.lag_metric.remove()
+        obs.evict_entity("peer", conn.token)
     conn.lag_metric = None
+
+
+def _forget_peer_usage(conn: "_Conn") -> None:
+    """Evict a detached peer's usage series (accounting plane). Only
+    peer-scoped principals go: a session-attached connection bills to
+    its TENANT, whose usage outlives any one socket — the manager
+    forgets it at destroy/park."""
+    m = accounting.meter()
+    if m is not None and conn.principal.startswith("peer:"):
+        m.forget(conn.principal)
 
 
 class _Conn:
@@ -321,6 +341,11 @@ class _Conn:
         self.levels = levels
         #: Matches this connection to the BoardSync it requested.
         self.token = _Conn._next_token()
+        #: Accounting principal every resource this conn spends is
+        #: attributed to (gol_tpu.obs.accounting): peer-token by
+        #: default; the SessionServer re-points it at the session id
+        #: once the peer attaches one.
+        self.principal = f"peer:{self.token}"
         # No events flow until this connection's BoardSync has been sent:
         # a controller's first message is always the board state, never a
         # TurnComplete it has no context for.
@@ -563,6 +588,10 @@ class _Conn:
         self.last_tx = time.monotonic()
         _METRICS.frames.inc()
         _METRICS.frame_bytes.inc(len(payload))
+        # Accounting plane: wire bytes attributed at the ONE choke
+        # point every tier's sends pass through (EngineServer,
+        # SessionServer, relay, WS conns all enqueue here).
+        accounting.charge(self.principal, wire_bytes=len(payload))
         if not self.writer_started:
             # Pre-attach (handshake replies): direct, no queue yet.
             self._send_now(payload)
@@ -605,6 +634,7 @@ class _Conn:
         payload = json.dumps(msg, separators=(",", ":")).encode()
         _METRICS.frames.inc()
         _METRICS.frame_bytes.inc(len(payload))
+        accounting.charge(self.principal, wire_bytes=len(payload))
         if self._handle is not None:
             # Pool mode: jump the backlog instead of bypassing the
             # queue — the pool serializes the socket, so a true bypass
@@ -1105,6 +1135,7 @@ class EngineServer:
             _METRICS.detaches.inc()
             remove_lag_gauge(conn)
             self.freshness.forget(conn.token)
+            _forget_peer_usage(conn)
             tracing.event("server.detach", "lifecycle", role=conn.role,
                           token=conn.token)
             flight.note("server.detach", role=conn.role, token=conn.token)
@@ -1225,6 +1256,17 @@ class EngineServer:
             # idle peer's turn age keeps moving even when the
             # broadcaster has nothing to fan out.
             self.freshness.sample((c, None) for c in conns)
+            # Accounting sweep on the same cadence: a peer's writer
+            # backlog occupies event-queue memory whether or not the
+            # broadcaster is emitting — queued frames × sweep interval
+            # is the frame-seconds each principal held.
+            _meter = accounting.meter()
+            if _meter is not None:
+                for c in conns:
+                    q = c.queued()
+                    if q:
+                        _meter.charge(c.principal,
+                                      queue_frame_seconds=q * interval)
             for conn in conns:
                 if not conn.writer_started:
                     # Mid-handshake: the attach-ack (which carries the
@@ -1323,12 +1365,19 @@ class EngineServer:
         ride only to peers that advertised the capability).
         `delta_words` is the shared per-turn (bitmap, words) pair for
         delta peers (see _delta_words)."""
+        m = accounting.meter()
+        t0 = time.perf_counter() if m is not None else 0.0
         with tracing.span("wire.encode_flips", "wire", turn=turn):
             _encode_and_send_flips(
                 conn, turn, flips, flips_levels,
                 self.params.image_width, self.params.image_height,
                 delta_words,
             )
+        if m is not None:
+            # Host encode tax at the PR 5 span boundary — attributed
+            # to the peer whose negotiated encoding we just paid for.
+            m.charge(conn.principal,
+                     host_seconds=time.perf_counter() - t0)
 
     def _send_stream_event(self, conn: _Conn, ev) -> None:
         """One post-sync event in this connection's encoding.
@@ -1710,6 +1759,8 @@ class _SessionSink:
                     return
                 tracing.event("turn.emit", "wire", turn=last,
                               session=sid, batch=k)
+                m = accounting.meter()
+                t0 = time.perf_counter() if m is not None else 0.0
                 with tracing.span("wire.encode_batch", "wire", turn=last,
                                   session=sid, turns=k):
                     frames = encode_batch_frames(
@@ -1717,6 +1768,11 @@ class _SessionSink:
                         self._width, self._height, conn.batch,
                         time.time(),
                     )
+                if m is not None:
+                    # Host encode tax, attributed to the session this
+                    # sink serves (conn.principal == sid here).
+                    m.charge(conn.principal,
+                             host_seconds=time.perf_counter() - t0)
                 for f in frames:
                     conn.send_raw(f)
                 conn.note_written(last)
@@ -1760,10 +1816,15 @@ class _SessionSink:
                 # shed frame never advances this peer's delta chain.
                 if not conn.offer_stream():
                     return
+                m = accounting.meter()
+                t0 = time.perf_counter() if m is not None else 0.0
                 with tracing.span("wire.encode_flips", "wire", turn=turn,
                                   session=sid):
                     _encode_and_send_flips(conn, turn, coords, None,
                                            self._width, self._height)
+                if m is not None:
+                    m.charge(conn.principal,
+                             host_seconds=time.perf_counter() - t0)
             except (wire.WireError, OSError):
                 self._server._drop_conn(conn, detach_sink=False)
                 raise
@@ -2113,6 +2174,12 @@ class SessionServer:
                      high_water=self.high_water,
                      drain_secs=self.drain_secs,
                      pool=self.pool)
+        if sid is not None:
+            # Session-attached peers bill to their TENANT, not the
+            # transient socket: everything this connection moves or
+            # occupies joins the session's usage record (the same
+            # principal the manager charges dispatch shares to).
+            conn.principal = sid
         if sid is not None and role == "drive":
             with self._conn_lock:
                 busy = sid in self._drivers
@@ -2305,6 +2372,7 @@ class SessionServer:
             _METRICS.detaches.inc()
             remove_lag_gauge(conn)
             self.freshness.forget(conn.token)
+            _forget_peer_usage(conn)
             tracing.event("server.detach", "lifecycle", role=conn.role,
                           token=conn.token)
         if entry is not None and detach_sink and not self._shutdown.is_set():
@@ -2575,6 +2643,15 @@ class SessionServer:
             self.freshness.sample(
                 (c, sids[c]) for c in conns if c in sids
             )
+            # Accounting sweep (same rationale as the EngineServer's):
+            # writer-queue occupancy in frame-seconds per principal.
+            _meter = accounting.meter()
+            if _meter is not None:
+                for c in conns:
+                    q = c.queued()
+                    if q:
+                        _meter.charge(c.principal,
+                                      queue_frame_seconds=q * interval)
             for conn in conns:
                 if not conn.writer_started:
                     continue

@@ -43,7 +43,7 @@ import numpy as np
 
 from gol_tpu import obs
 from gol_tpu.engine.cycles import CycleDetector
-from gol_tpu.obs import device, flight, tracing
+from gol_tpu.obs import accounting, device, flight, tracing
 from gol_tpu.events import (
     AliveCellsCount,
     BoardSync,
@@ -63,6 +63,17 @@ from gol_tpu.params import Params
 from gol_tpu.parallel import make_stepper
 from gol_tpu.utils.cell import cells_from_mask, xy_from_mask
 from gol_tpu.analysis.concurrency import lockcheck
+
+
+def _charge_legacy(seconds: float, turns: int) -> None:
+    """Accounting plane: the singleton engine serves the anonymous
+    `legacy` tier — every dispatch is one tenant's spend, priced off
+    the published engine.step cost (gol_tpu.obs.accounting)."""
+    m = accounting.meter()
+    if m is not None:
+        m.charge(accounting.LEGACY, dispatch_seconds=seconds,
+                 flops=m.price_flops("engine.step") * turns,
+                 turns=turns)
 
 
 def _is_gen_rule(rule) -> bool:
@@ -699,6 +710,7 @@ class Engine:
                 _METRICS.dispatches["diff"].inc()
                 _METRICS.turns["diff"].inc()
                 _METRICS.dispatch_seconds["diff"].observe(elapsed)
+                _charge_legacy(elapsed, 1)
                 tracing.add_span("engine.dispatch", "engine",
                                  time.time() - elapsed, elapsed,
                                  {"kind": "diff", "turn": turn,
@@ -788,6 +800,10 @@ class Engine:
                 _METRICS.dispatches["chunk"].inc()
                 _METRICS.turns["chunk"].inc(k)
                 _METRICS.effective_chunk.set(self.effective_chunk)
+                # Fused chunks charge the enqueue leg (nothing is
+                # realized per chunk — same boundary as the device
+                # split above).
+                _charge_legacy(time.perf_counter() - tick, k)
                 if self.timeline:
                     int(count)  # realize: spans measure true device time
                     elapsed = time.perf_counter() - tick
@@ -1265,6 +1281,7 @@ class Engine:
         _METRICS.dispatches["diffs"].inc()
         _METRICS.turns["diffs"].inc(k)
         _METRICS.dispatch_seconds["diffs"].observe(now - start)
+        _charge_legacy(now - start, k)
         tracing.add_span(
             "engine.dispatch", "engine",
             time.time() - (now - start), now - start,

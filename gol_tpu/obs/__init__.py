@@ -54,6 +54,7 @@ from gol_tpu.obs.registry import (
     atomic_write_text,
     counter,
     enabled,
+    evict_entity,
     exponential_buckets,
     gauge,
     histogram,
@@ -62,6 +63,7 @@ from gol_tpu.obs.registry import (
     registry,
     remove,
     set_enabled,
+    track_entity_series,
 )
 
 __all__ = [
@@ -75,6 +77,7 @@ __all__ = [
     "atomic_write_text",
     "counter",
     "enabled",
+    "evict_entity",
     "exponential_buckets",
     "gauge",
     "histogram",
@@ -83,6 +86,7 @@ __all__ = [
     "registry",
     "remove",
     "set_enabled",
+    "track_entity_series",
 ]
 
 

@@ -21,6 +21,15 @@ merging the latency histograms across endpoints before taking
 percentiles (`merge_cumulative_buckets`) — fleet percentiles are NOT
 averages of per-endpoint percentiles.
 
+Each scrape also fetches the sidecar's `/usage` payload (accounting
+plane, PR 17): the per-endpoint payloads join into ONE fleet
+TOP-by-cost table — a row per principal summed across tiers, ranked
+on `--sort-usage`, a BUDG column for soft-budget state, a TOTAL row
+equal to the summed per-process grand totals, and `--principal ID`
+drills one tenant down to which endpoint billed what. Sidecars that
+predate the plane (404) or opted out (`GOL_TPU_ACCOUNTING=0`) simply
+contribute no usage rows.
+
 `--once` prints a single non-interactive snapshot (no rates — there is
 no previous sample) and exits 0 as long as every endpoint answered —
 the CI mode `scripts/metrics_smoke.sh` drives. Live mode redraws with
@@ -54,9 +63,11 @@ __all__ = [
     "histogram_buckets",
     "label_value",
     "main",
+    "merge_usage",
     "parse_prometheus",
     "render",
     "render_tree",
+    "render_usage",
     "sum_series",
 ]
 
@@ -178,6 +189,7 @@ class Endpoint:
             # The CLI banner prints the full .../metrics URL — pasting
             # it verbatim must work, not 404 on /metrics/metrics.
             base = base[: -len("/metrics")]
+        self.base = base
         self.url = base + "/metrics"
         self.prev: Optional[Tuple[float, Series]] = None
         self.last_error: Optional[str] = None
@@ -197,8 +209,27 @@ class Endpoint:
         now = time.monotonic()
         metrics = parse_prometheus(text)
         row = self._row(metrics, now)
+        row["usage"] = self._fetch_usage()
         self.prev = (now, metrics)
         return row
+
+    def _fetch_usage(self) -> Optional[dict]:
+        """The sidecar's `/usage` payload (accounting plane), or None
+        — a pre-accounting sidecar 404s and an opted-out process
+        answers `{"enabled": false}`; both degrade to 'no usage
+        columns', never to a DOWN row (the endpoint's /metrics already
+        answered)."""
+        try:
+            with urllib.request.urlopen(
+                self.base + "/usage", timeout=_SCRAPE_TIMEOUT
+            ) as resp:
+                payload = json.loads(resp.read().decode("utf-8",
+                                                        "replace"))
+        except Exception:
+            return None
+        if not isinstance(payload, dict) or not payload.get("enabled"):
+            return None
+        return payload
 
     def _turns(self, metrics: Series) -> Optional[float]:
         parts = [sum_series(metrics, "gol_tpu_engine_turns_total"),
@@ -391,11 +422,50 @@ def render_tree(tree: List[dict], out=None) -> None:
             line(n, 0)
 
 
-def fleet_snapshot(endpoints: List[Endpoint]) -> dict:
+def merge_usage(rows: List[dict],
+                sort_key: str = "flops") -> Optional[dict]:
+    """Join every endpoint's `/usage` payload into the fleet view:
+    per-principal resource sums across processes (a tenant served by
+    a session server AND billed wire bytes by a relay is ONE row),
+    ranked most-expensive-first on `sort_key`, plus a fleet TOTAL
+    equal to the sum of the per-process `totals` blocks (which include
+    already-forgotten principals — the fleet bill survives eviction).
+    None when no scraped endpoint exposes the accounting plane."""
+    by: Dict[str, dict] = {}
+    total: Dict[str, float] = {}
+    budgets: Dict[str, float] = {}
+    seen = False
+    for r in rows:
+        u = r.get("usage")
+        if not u:
+            continue
+        seen = True
+        for p, res in (u.get("principals") or {}).items():
+            dst = by.setdefault(p, {"over_budget": False})
+            for k, v in res.items():
+                if k == "over_budget":
+                    dst["over_budget"] = bool(dst["over_budget"] or v)
+                else:
+                    dst[k] = dst.get(k, 0.0) + float(v)
+        for k, v in (u.get("totals") or {}).items():
+            total[k] = total.get(k, 0.0) + float(v)
+        for k, v in (u.get("budgets") or {}).items():
+            if v is not None:
+                budgets[k] = v
+    if not seen:
+        return None
+    ranked = sorted(by, key=lambda p: (-by[p].get(sort_key, 0.0), p))
+    return {"by_principal": by, "ranked": ranked, "total": total,
+            "budgets": budgets, "sort": sort_key}
+
+
+def fleet_snapshot(endpoints: List[Endpoint],
+                   usage_sort: str = "flops") -> dict:
     """Scrape every endpoint once; returns {"rows": [...], "total":
-    {...}, "down": [spec, ...], "tree": [...]} — `tree` is the relay
-    fan-out forest (build_tree). The TOTAL row merges latency
-    histograms across endpoints BEFORE taking percentiles."""
+    {...}, "down": [spec, ...], "tree": [...], "usage": {...}|None} —
+    `tree` is the relay fan-out forest (build_tree), `usage` the
+    fleet-joined TOP-by-cost view (merge_usage). The TOTAL row merges
+    latency histograms across endpoints BEFORE taking percentiles."""
     # Concurrent scrapes: one black-holed endpoint (a hanging TCP
     # connect eats its whole 5s timeout) must not freeze the healthy
     # rows' refresh — a partial outage is when the console matters.
@@ -442,7 +512,8 @@ def fleet_snapshot(endpoints: List[Endpoint]) -> dict:
         } if merged_lat else None,
     }
     return {"rows": rows, "total": total, "down": down,
-            "tree": build_tree(rows)}
+            "tree": build_tree(rows),
+            "usage": merge_usage(live, usage_sort)}
 
 
 # --- rendering -----------------------------------------------------------
@@ -508,7 +579,70 @@ def _cells(row: dict) -> list:
     return cells
 
 
-def render(snap: dict, out=None, clear: bool = False) -> None:
+#: TOP-by-cost columns: (resource key, header, width, unit).
+_USAGE_COLUMNS = (
+    ("flops", "FLOPS", 9, ""),
+    ("dispatch_seconds", "DISP", 8, "s"),
+    ("host_seconds", "HOST", 8, "s"),
+    ("wire_bytes", "WIRE", 7, "bytes"),
+    ("queue_frame_seconds", "QOCC", 8, "s"),
+    ("turns", "TURNS", 9, ""),
+)
+
+
+def render_usage(usage: Optional[dict], out=None, top: int = 10,
+                 principal: Optional[str] = None,
+                 rows: Optional[List[dict]] = None) -> None:
+    """The fleet TOP-by-cost table: one row per principal (session id,
+    peer:<token>, or the anonymous `legacy` tier), most expensive
+    first on the snapshot's sort key, a BUDG column for soft-budget
+    state (OVER is advisory — the accounting plane never enforces),
+    and a TOTAL row summing the per-process grand totals. With
+    `principal` set, a drill-down follows: that tenant's share at each
+    scraped endpoint (which tier billed what)."""
+    out = out or sys.stdout
+    w = out.write
+    if usage is None:
+        return
+    by = usage["by_principal"]
+    ranked = usage["ranked"]
+    w(f"usage — top by {usage.get('sort', 'flops')} "
+      f"({len(ranked)} principals)\n")
+    header = f"{'PRINCIPAL':<21}  " + "  ".join(
+        f"{title:>{width}}" for _, title, width, _ in _USAGE_COLUMNS
+    ) + "  BUDG"
+    w(header + "\n")
+
+    def line(name, res):
+        cells = "  ".join(
+            f"{_num(res.get(key), unit):>{width}}"
+            for key, _, width, unit in _USAGE_COLUMNS
+        )
+        budg = "OVER" if res.get("over_budget") else "-"
+        w(f"{name[:21]:<21}  {cells}  {budg:>4}\n")
+
+    for p in ranked[:max(0, top)]:
+        line(p, by[p])
+    if len(ranked) > top:
+        w(f"… {len(ranked) - top} more principals\n")
+    line("TOTAL", usage.get("total") or {})
+    if principal is not None:
+        w(f"usage drill-down — {principal}:\n")
+        found = False
+        for r in rows or []:
+            u = r.get("usage") or {}
+            res = (u.get("principals") or {}).get(principal)
+            if res is None:
+                continue
+            found = True
+            line(f"  @{r.get('endpoint', '?')}", res)
+        if not found:
+            w("  (no endpoint reports this principal)\n")
+
+
+def render(snap: dict, out=None, clear: bool = False,
+           usage_top: int = 10,
+           principal: Optional[str] = None) -> None:
     out = out or sys.stdout
     w = out.write
     if clear:
@@ -542,6 +676,8 @@ def render(snap: dict, out=None, clear: bool = False) -> None:
     tree = snap.get("tree") or []
     if any(n["children"] or n.get("upstream") for n in tree):
         render_tree(tree, out)
+    render_usage(snap.get("usage"), out, top=usage_top,
+                 principal=principal, rows=snap["rows"])
     for a in snap["total"].get("alerts") or []:
         w(f"!! ALERT firing on {a['endpoint']}: {a['rule']}\n")
     viol = snap["total"].get("violations")
@@ -568,11 +704,23 @@ def main(argv: Optional[list] = None) -> int:
                     help="live-mode refresh cadence (default 2)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the snapshot as JSON instead of the table")
+    ap.add_argument("--sort-usage", default="flops",
+                    choices=("flops", "dispatch_seconds", "host_seconds",
+                             "wire_bytes", "queue_frame_seconds",
+                             "turns"),
+                    help="resource the TOP-by-cost usage table ranks on "
+                         "(default flops)")
+    ap.add_argument("--usage-top", type=int, default=10, metavar="N",
+                    help="labeled rows in the usage table before the "
+                         "'… more' fold (default 10)")
+    ap.add_argument("--principal", default=None, metavar="ID",
+                    help="drill into one tenant: its usage share at "
+                         "every scraped endpoint")
     args = ap.parse_args(argv)
 
     eps = [Endpoint(spec) for spec in args.endpoints]
     if args.once:
-        snap = fleet_snapshot(eps)
+        snap = fleet_snapshot(eps, usage_sort=args.sort_usage)
         if args.as_json:
             snap = {**snap, "rows": [
                 {k: v for k, v in r.items() if k != "latency_buckets"}
@@ -580,7 +728,8 @@ def main(argv: Optional[list] = None) -> int:
             ]}
             print(json.dumps(snap, indent=1))
         else:
-            render(snap)
+            render(snap, usage_top=args.usage_top,
+                   principal=args.principal)
         if snap["down"]:
             return 1
         # Firing alerts are a CI failure too (freshness plane): the
@@ -589,11 +738,12 @@ def main(argv: Optional[list] = None) -> int:
         return 2 if snap["total"].get("alerts") else 0
     try:
         while True:
-            snap = fleet_snapshot(eps)
+            snap = fleet_snapshot(eps, usage_sort=args.sort_usage)
             if args.as_json:
                 print(json.dumps(snap["total"]))
             else:
-                render(snap, clear=True)
+                render(snap, clear=True, usage_top=args.usage_top,
+                       principal=args.principal)
             time.sleep(max(0.2, args.interval))
     except KeyboardInterrupt:
         return 0

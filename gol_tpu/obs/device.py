@@ -275,6 +275,14 @@ def publish_cost(program: str, fn: Callable, *args, **kw) -> dict:
         return {}
     out = cost_of(fn, *args, **kw)
     if "error" not in out:
+        # The accounting plane prices modeled-FLOPs attribution off
+        # exactly these published program costs (price x dispatched
+        # turns — gol_tpu.obs.accounting).
+        from gol_tpu.obs import accounting
+
+        m = accounting.meter()
+        if m is not None:
+            m.set_price(program, out)
         obs.gauge(
             "gol_tpu_device_cost_flops",
             "cost_analysis FLOPs per call of the named program",

@@ -21,7 +21,13 @@ library code): a ThreadingHTTPServer on its own daemon thread serving
               (gol_tpu.obs.freshness, CLI --alert-rules): every rule
               with its ok/pending/firing state and last value, plus
               the firing count — sane (empty rules, firing 0) when no
-              rules are loaded.
+              rules are loaded;
+- `/usage`    the accounting plane's per-principal usage snapshot
+              (gol_tpu.obs.accounting): dispatch seconds, modeled
+              FLOPs, host encode seconds, wire bytes and queue
+              occupancy per tenant, process totals, budget state —
+              `{"enabled": false}` under GOL_TPU_ACCOUNTING=0, so a
+              biller can tell "disabled" from "idle".
 
 With the plane disabled (`GOL_TPU_METRICS=0`) the last two return an
 explicit `{"enabled": false}` payload so a scraper can tell "disabled"
@@ -112,6 +118,15 @@ class MetricsServer:
                             else {"rules": [], "firing": 0})
                     self._reply(200, json.dumps(body, indent=1).encode(),
                                 "application/json")
+                elif path == "/usage":
+                    from gol_tpu.obs import accounting
+
+                    self._reply(
+                        200,
+                        json.dumps(accounting.payload(),
+                                   indent=1).encode(),
+                        "application/json",
+                    )
                 elif path == "/healthz":
                     try:
                         info = dict(health()) if health is not None \

@@ -57,6 +57,7 @@ __all__ = [
     "atomic_write_text",
     "counter",
     "enabled",
+    "evict_entity",
     "exponential_buckets",
     "gauge",
     "histogram",
@@ -65,6 +66,7 @@ __all__ = [
     "registry",
     "remove",
     "set_enabled",
+    "track_entity_series",
 ]
 
 #: Module-global enablement flag — ONE attribute read on every metric
@@ -452,6 +454,16 @@ class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: "Dict[Tuple[str, _LabelsKey], _Metric]" = {}
+        #: Per-entity series declarations (bounded-cardinality audit):
+        #: label -> family names whose per-entity children must leave
+        #: the registry with the entity. Plain families mint one
+        #: labeled series per entity ({label: value}); topk families
+        #: are single TopKGauge entries whose CHILDREN are keyed by the
+        #: entity. `evict_entity` is the one teardown path every churny
+        #: plane (sessions, peers, usage principals) routes through —
+        #: pinned by the 1000-tenant churn test.
+        self._entity_plain: "Dict[str, set]" = {}
+        self._entity_topk: "Dict[str, set]" = {}
 
     def _get_or_create(self, cls, name, help, labels, **kw):
         key = (name, _labels_key(labels))
@@ -487,6 +499,44 @@ class Registry:
         cardinality is O(cap) however many children are live."""
         return self._get_or_create(TopKGauge, name, help, labels,
                                    label=label, cap=cap)
+
+    def get(self, name: str, labels: Optional[dict] = None
+            ) -> Optional[_Metric]:
+        """The registered metric under one identity, or None — a peek
+        that never creates (evict_entity and tests use it)."""
+        with self._lock:
+            return self._metrics.get((name, _labels_key(labels)))
+
+    def track_entity_series(self, label: str, *names: str,
+                            topk: bool = False) -> None:
+        """Declare per-entity metric families: every series of `names`
+        keyed by `{label: <entity>}` (or, with topk=True, every
+        TopKGauge child keyed by the entity) is evicted by ONE
+        `evict_entity(label, entity)` call at teardown. Idempotent;
+        declaration order is free (a family may be tracked before it
+        is ever registered)."""
+        with self._lock:
+            dst = self._entity_topk if topk else self._entity_plain
+            dst.setdefault(label, set()).update(names)
+
+    def evict_entity(self, label: str, value) -> int:
+        """Remove every tracked per-entity series of one entity — the
+        shared bounded-cardinality teardown (sessions at destroy/park,
+        peers at disconnect, usage principals at forget). Returns the
+        number of series/children actually removed; unknown entities
+        are a harmless 0."""
+        with self._lock:
+            plain = tuple(self._entity_plain.get(label, ()))
+            topk = tuple(self._entity_topk.get(label, ()))
+        n = 0
+        for name in plain:
+            if self.remove(name, {label: str(value)}):
+                n += 1
+        for name in topk:
+            m = self.get(name)
+            if isinstance(m, TopKGauge) and m.remove_child(value):
+                n += 1
+        return n
 
     def remove(self, name: str, labels: Optional[dict] = None) -> bool:
         """Evict one labeled series (e.g. a destroyed session's child
@@ -580,3 +630,11 @@ def histogram(name: str, help: str = "", labels: Optional[dict] = None,
 
 def remove(name: str, labels: Optional[dict] = None) -> bool:
     return REGISTRY.remove(name, labels)
+
+
+def track_entity_series(label: str, *names: str, topk: bool = False) -> None:
+    REGISTRY.track_entity_series(label, *names, topk=topk)
+
+
+def evict_entity(label: str, value) -> int:
+    return REGISTRY.evict_entity(label, value)

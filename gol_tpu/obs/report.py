@@ -19,6 +19,15 @@ Two subcommands (stdlib only, no engine import):
       the recorded dispatch commits, stall windows, reconnect storms,
       eviction and invariant-violation history, and the biggest metric
       deltas. `render` on a bare path is the default subcommand.
+
+  python -m gol_tpu.obs.report usage LEDGER-DIR [DIR ...]
+      Aggregate the accounting plane's crash-safe usage ledgers
+      (`gol_tpu.obs.accounting`): every `usage-*.jsonl` segment under
+      the given directories — across rollovers, process generations
+      and a torn tail from a SIGKILL mid-append — summed into one
+      per-principal bill. Intact records all count, corrupt lines are
+      skipped, the command never raises on a damaged ledger; `--json`
+      emits the machine form, `--sort` picks the ranking resource.
 """
 
 from __future__ import annotations
@@ -399,13 +408,51 @@ def _cmd_render(args) -> int:
     return 0
 
 
+# --- usage ---------------------------------------------------------------
+
+
+def _cmd_usage(args) -> int:
+    """Offline twin of the console's TOP-by-cost view, fed by ledger
+    segments instead of live sidecars — the bill survives every crash
+    the processes did."""
+    from gol_tpu.obs.accounting import RESOURCES, read_ledger
+
+    totals: dict = {}
+    for d in args.dirs:
+        for p, res in read_ledger(d).items():
+            dst = totals.setdefault(p, {})
+            for k, v in res.items():
+                dst[k] = dst.get(k, 0.0) + v
+    if args.as_json:
+        print(json.dumps({"principals": totals, "sort": args.sort},
+                         indent=1, sort_keys=True))
+        return 0
+    ranked = sorted(totals,
+                    key=lambda p: (-totals[p].get(args.sort, 0.0), p))
+    print(f"usage ledger — {len(ranked)} principals over "
+          f"{len(args.dirs)} dir(s), sorted by {args.sort}")
+    hdr = f"{'PRINCIPAL':<21}  " + "  ".join(
+        f"{r:>19}" for r in RESOURCES
+    )
+    print(hdr)
+    rows = list(ranked) + ["TOTAL"]
+    grand = {r: sum(t.get(r, 0.0) for t in totals.values())
+             for r in RESOURCES}
+    for p in rows:
+        res = grand if p == "TOTAL" else totals[p]
+        cells = "  ".join(f"{res.get(r, 0.0):>19.6g}" for r in RESOURCES)
+        print(f"{p[:21]:<21}  {cells}")
+    return 0
+
+
 # --- entry ---------------------------------------------------------------
 
 
 def main(argv: Optional[list] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # Bare-path convenience: `report FLIGHT.json` renders it.
-    if argv and argv[0] not in ("merge", "render", "-h", "--help"):
+    if argv and argv[0] not in ("merge", "render", "usage",
+                                "-h", "--help"):
         argv.insert(0, "render")
     ap = argparse.ArgumentParser(
         prog="python -m gol_tpu.obs.report",
@@ -454,6 +501,20 @@ def main(argv: Optional[list] = None) -> int:
                                        "flight-recorder dump")
     rp.add_argument("path")
     rp.set_defaults(fn=_cmd_render)
+    up = sub.add_parser("usage", help="aggregate crash-safe usage "
+                                      "ledger segments into one "
+                                      "per-principal bill")
+    up.add_argument("dirs", nargs="+", metavar="LEDGER-DIR",
+                    help="directories holding usage-*.jsonl segments "
+                         "(the CLI writes <out>/usage/)")
+    up.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable totals instead of the table")
+    up.add_argument("--sort", default="flops",
+                    choices=("flops", "dispatch_seconds", "host_seconds",
+                             "wire_bytes", "queue_frame_seconds",
+                             "turns"),
+                    help="resource the table ranks on (default flops)")
+    up.set_defaults(fn=_cmd_usage)
     args = ap.parse_args(argv)
     return args.fn(args)
 

@@ -54,10 +54,11 @@ from gol_tpu.distributed.client import apply_fbatch_raster, \
     sanitize_retry_after
 from gol_tpu.distributed.server import (
     _Conn,
+    _forget_peer_usage,
     install_lag_gauge,
     remove_lag_gauge,
 )
-from gol_tpu.obs import flight, tracing
+from gol_tpu.obs import accounting, flight, tracing
 from gol_tpu.obs.freshness import ServerFreshness, sane_lag
 from gol_tpu.relay import ws as wsproto
 from gol_tpu.relay.writerpool import WriterPool
@@ -817,6 +818,7 @@ class RelayNode:
         if removed:
             remove_lag_gauge(conn)
             self.freshness.forget(conn.token)
+            _forget_peer_usage(conn)
             tracing.event("relay.detach", "lifecycle", token=conn.token)
         conn.close()
 
@@ -973,6 +975,16 @@ class RelayNode:
             now = time.monotonic()
             conns = self._all_conns()
             self.freshness.sample((c, None) for c in conns)
+            # Accounting sweep (the servers' discipline, per hop):
+            # each downstream's writer backlog in frame-seconds —
+            # wire bytes are already charged at the _Conn choke point.
+            _meter = accounting.meter()
+            if _meter is not None:
+                for c in conns:
+                    q = c.queued()
+                    if q:
+                        _meter.charge(c.principal,
+                                      queue_frame_seconds=q * interval)
             for conn in conns:
                 if not conn.writer_started:
                     continue

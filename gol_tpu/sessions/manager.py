@@ -24,7 +24,7 @@ import numpy as np
 
 from gol_tpu import obs
 from gol_tpu.models.rules import GenRule, LIFE, Rule, get_rule
-from gol_tpu.obs import device, flight, tracing
+from gol_tpu.obs import accounting, device, flight, tracing
 from gol_tpu.analysis.concurrency import lockcheck
 
 #: Session ids are path components (checkpoints live under
@@ -38,6 +38,12 @@ PER_SESSION_SERIES = (
     "gol_tpu_session_turns_total",
     "gol_tpu_session_watchers",
 )
+
+# Bounded-cardinality audit: every per-session series is declared to
+# the registry's shared eviction helper, so ONE evict_entity call at
+# destroy/park removes the whole set (and the churn test can assert
+# the registry ends where it started).
+obs.track_entity_series("session", *PER_SESSION_SERIES)
 
 #: Board-dimension sanity bound for wire-driven creates: a hostile
 #: create must not make the server allocate an arbitrary raster.
@@ -269,10 +275,16 @@ class _Bucket:
             zero = np.zeros((height, width), np.uint8)
             self.stack = self.bs.put_all([zero] * capacity)
         if device.cost_probes_enabled():
-            device.publish_cost(
+            cost = device.publish_cost(
                 "bucket.step",
                 lambda st: self.bs.step_n(st, 1)[0], self.stack,
             )
+            m = accounting.meter()
+            if m is not None:
+                # Per-bucket FLOPs price: one step of the WHOLE stack
+                # — the accounting plane splits it across the bucket's
+                # live tenants at dispatch time.
+                m.set_price(f"bucket.step:{self.key}", cost)
         #: Free slots, lowest first (pop from the end).
         self.free = list(range(capacity - 1, -1, -1))
         self.sessions: "dict[int, Session]" = {}   # slot -> Session
@@ -280,6 +292,10 @@ class _Bucket:
         #: Total turns this bucket has stepped since creation — every
         #: occupied slot advances by exactly this clock.
         self.ticks = 0
+        #: Per-slot activity weights (changed-word counts) of the last
+        #: watched dispatch — the accounting plane's bucket-split rule;
+        #: None after a fused dispatch (equal turn-weighted shares).
+        self.last_weights: "Optional[dict]" = None
         #: Adaptive per-turn changed-words cap for the compact path
         #: (None = not yet enabled; next watched chunk runs plain
         #: diffs to observe activity). Pow2 with 2x headroom, exactly
@@ -942,9 +958,12 @@ class SessionManager:
         if reason != "shutdown":
             self._write_manifest()
         # Bounded-cardinality contract: the per-session children leave
-        # the registry WITH the session (pinned by test_sessions).
-        for name in PER_SESSION_SERIES:
-            obs.registry().remove(name, {"session": sid})
+        # the registry WITH the session (pinned by test_sessions),
+        # and so does its live usage view (history stays in the ledger).
+        obs.evict_entity("session", sid)
+        m = accounting.meter()
+        if m is not None:
+            m.forget(sid)
         _METRICS.destroys.inc()
         _METRICS.active.set(len(self._by_id))
         tracing.event("session.destroy", "lifecycle", session=sid,
@@ -1030,8 +1049,10 @@ class SessionManager:
         # sweep defers it to ONE commit per sweep (see _park_idle).
         if not self._deferring_manifest:
             self._write_manifest()
-        for name in PER_SESSION_SERIES:
-            obs.registry().remove(name, {"session": sid})
+        obs.evict_entity("session", sid)
+        m = accounting.meter()
+        if m is not None:
+            m.forget(sid)
         _METRICS.hibernates.inc()
         _METRICS.parked.set(len(self._parked))
         _METRICS.active.set(len(self._by_id))
@@ -1202,6 +1223,22 @@ class SessionManager:
         dt = time.perf_counter() - t0
         _METRICS.dispatches[path].inc()
         _METRICS.dispatch_seconds[path].observe(dt)
+        m = accounting.meter()
+        if m is not None and b.sessions:
+            # Attribute the ONE shared vmapped dispatch to its tenants:
+            # activity-weighted when the diff headers produced per-slot
+            # changed-word counts, equal turn-weighted on the fused
+            # path. Conservation-checked inside (shares sum to dt).
+            items = list(b.sessions.items())
+            w = b.last_weights if path != "fused" else None
+            m.charge_bucket(
+                [s.id for _, s in items],
+                None if w is None else [w.get(slot, 0.0)
+                                        for slot, _ in items],
+                seconds=dt,
+                flops=m.price_flops(f"bucket.step:{b.key}") * k,
+                turns=k, what=b.key,
+            )
         tracing.add_span(
             "session.dispatch", "engine", wall0, dt,
             {"bucket": b.key, "path": path, "turns": k,
@@ -1265,10 +1302,14 @@ class SessionManager:
             host0 = time.perf_counter()
             rows_by_slot = {}
             chunks_by_slot = {}
+            weights = {}
             peak = 0
             for slot, s in b.sessions.items():
                 hs = hdr[slot]
                 peak = max(peak, int(hs[:, 0].max()) if hs.size else 0)
+                # Activity weight = this tenant's changed words across
+                # the chunk (the accounting plane's split rule).
+                weights[slot] = float(hs[:, 0].sum()) if hs.size else 0.0
                 sinks = b.sinks.get(s.id)
                 if not sinks:
                     continue
@@ -1287,6 +1328,7 @@ class SessionManager:
                     rows_by_slot[slot] = list(compact_decode_rows(
                         hs, vals[slot], b.bs.total_words
                     ))
+            b.last_weights = weights
             b.adapt_cap(peak)
         else:
             enq0 = time.perf_counter()
@@ -1300,9 +1342,11 @@ class SessionManager:
             host0 = time.perf_counter()
             rows_by_slot = {}
             chunks_by_slot = {}
+            weights = {}
             peak = 0
             for slot, s in b.sessions.items():
                 d = host[slot]
+                weights[slot] = float(np.count_nonzero(d))
                 if b.bs.packed:
                     peak = max(
                         peak,
@@ -1326,6 +1370,7 @@ class SessionManager:
                     rows_by_slot[slot] = [
                         d[t].reshape(-1) for t in range(k)
                     ]
+            b.last_weights = weights
             if b.bs.packed:
                 b.adapt_cap(peak)
         self._emit(b, k, rows_by_slot, chunks_by_slot)

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Merge the accounting-plane overhead lane into BENCH_DETAIL.json —
+the bounded capture form for containers without the TPU attached (the
+`wire_batch_capture.py` pattern applied to ISSUE 17's acceptance A/B).
+
+Runs `bench.measure_wire_watched_accounting` — a real EngineServer on
+the settled 512² fixture with one batching watcher, the usage meter
+toggled between alternating paired windows on that single live stream
+(meter ON, the default, vs OFF, the `GOL_TPU_ACCOUNTING=0` fast path)
+— with the device plane bracketed (`_lane`), and writes the result
+under
+
+    BENCH_DETAIL.json["wire_watched_accounting"]
+
+stamping the substrate platform. The headline
+`accounting_overhead_pct` is the MEDIAN of the per-round paired
+deltas; the raw spread is recorded beside it so a reader can see the
+box's noise floor instead of trusting one pooled number. No other
+lane is touched, so `bench_compare` against an older capture sees new
+keys, never a fake regression.
+
+Usage: python scripts/accounting_capture.py   (CPU-safe; ~1 min)
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+#: ISSUE 17 acceptance: metering the hot watched path costs <= 2%.
+OVERHEAD_BAR_PCT = 2.0
+
+
+def main() -> int:
+    import jax
+
+    from gol_tpu.obs import device
+
+    device.install_compile_watcher()
+
+    import bench
+
+    entry = bench._lane(bench.measure_wire_watched_accounting)
+    entry["platform"] = jax.devices()[0].platform
+
+    detail_path = REPO / "BENCH_DETAIL.json"
+    detail = json.loads(detail_path.read_text())
+    detail["wire_watched_accounting"] = entry
+    detail_path.write_text(json.dumps(detail, indent=1))
+    print(json.dumps(entry, indent=1))
+    if "error" in entry:
+        print(f"wire_watched_accounting: FAIL ({entry['error']})")
+        return 1
+    pct = entry.get("accounting_overhead_pct")
+    charged = entry.get("usage_totals", {}).get("wire_bytes", 0)
+    ok = pct is not None and pct <= OVERHEAD_BAR_PCT and charged > 0
+    print(f"wire_watched_accounting: {pct:+.2f}% median paired "
+          f"overhead, {charged:,.0f} wire bytes charged "
+          f"({'PASS' if ok else 'ABOVE'} the "
+          f"{OVERHEAD_BAR_PCT:g}% acceptance bar)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,7 +94,25 @@ LOWER_BETTER = re.compile(
     # healthy run — any capture that moves `lockcheck`/`lock_order`/
     # `ownership` off a zero baseline is an infinite regression (the
     # deadlock detector fired during a bench).
-    r"|lock_order|ownership|lockcheck)", re.I
+    r"|lock_order|ownership|lockcheck"
+    # Accounting plane (ISSUE 17): the meter-on-vs-off A/B's
+    # accounting_overhead_pct regresses UP (already matched by the
+    # generic `overhead` token above — spelled here so the lane's gate
+    # survives a rename of that token); the lane's usage_totals stay
+    # informational, and its conservation `violations` ride the
+    # off-zero invariant rule above.
+    r"|accounting_overhead_pct)", re.I
+)
+INFORMATIONAL = re.compile(
+    # Accounting lane (ISSUE 17): the per-leg throughputs and whatever
+    # the meter happened to bill during its nondeterministic paired
+    # windows are evidence the plane ran, not a perf surface — only the
+    # lane's accounting_overhead_pct (the median paired delta, clamped
+    # at zero) gates. Without this override the generic `bytes` /
+    # `per_sec` tokens would turn window-to-window billing noise into
+    # fake regressions.
+    r"wire_watched_accounting\.(usage_totals|meter_on|meter_off"
+    r"|delta_pct_spread)\.", re.I,
 )
 
 
@@ -134,6 +152,8 @@ def load_metrics(path: str) -> Dict[str, float]:
 
 def direction(key: str) -> int:
     """+1 = higher is better, -1 = lower is better, 0 = informational."""
+    if INFORMATIONAL.search(key):
+        return 0
     if HIGHER_BETTER.search(key):
         return +1
     if LOWER_BETTER.search(key):

@@ -4,11 +4,16 @@
 # live sidecar, and assert the core series are present and moving.
 # Then SIGTERM a real --serve run and assert it leaves a readable
 # flight-recorder dump that `python -m gol_tpu.obs.report` renders.
+# Finally the accounting plane (ISSUE 17): a `--serve --sessions` run
+# with two tenants of very different sizes must rank them correctly on
+# /usage, keep the conservation violation counter at zero, mark the
+# soft-budget breach, and join with the first sidecar into the
+# console's fleet TOP-by-cost view.
 # Exercises the full opt-in path (cli flag -> gol_tpu.obs.http ->
 # process registry/tracer/black box) the way an operator's probe would
 # — no pytest, no mocks.
 #
-# Usage: scripts/metrics_smoke.sh   (CPU-safe; ~30s)
+# Usage: scripts/metrics_smoke.sh   (CPU-safe; ~60s)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -20,12 +25,16 @@ LOG=$(mktemp)
 OUT=$(mktemp -d)
 LOG2=$(mktemp)
 OUT2=$(mktemp -d)
+LOG3=$(mktemp)
+OUT3=$(mktemp -d)
 cleanup() {
     kill "$PID" 2>/dev/null || true
     wait "$PID" 2>/dev/null || true
     [ -n "${PID2:-}" ] && kill "$PID2" 2>/dev/null || true
     [ -n "${PID2:-}" ] && wait "$PID2" 2>/dev/null || true
-    rm -rf "$LOG" "$OUT" "$LOG2" "$OUT2"
+    [ -n "${PID3:-}" ] && kill "$PID3" 2>/dev/null || true
+    [ -n "${PID3:-}" ] && wait "$PID3" 2>/dev/null || true
+    rm -rf "$LOG" "$OUT" "$LOG2" "$OUT2" "$LOG3" "$OUT3"
 }
 
 python -m gol_tpu -noVis -t 2 -w 64 -h 64 -turns 1000000000 \
@@ -280,8 +289,167 @@ python -m gol_tpu.obs.report render "$DUMP" >/dev/null || {
     exit 1
 }
 
+# --- the accounting plane (ISSUE 17): /usage ranks tenants by cost ---
+
+python -m gol_tpu -noVis -w 64 -h 64 --platform cpu \
+    --serve 127.0.0.1:0 --sessions --out "$OUT3" \
+    --session-budget-bytes 1000 --metrics-port 0 >"$LOG3" 2>&1 &
+PID3=$!
+BASE3=""
+ADDR3=""
+for _ in $(seq 1 240); do
+    BASE3=$(sed -n 's#^metrics serving on \(http://[^/]*\)/metrics$#\1#p' "$LOG3" | head -1)
+    ADDR3=$(sed -n 's#^session engine serving on \(.*\)$#\1#p' "$LOG3" | head -1)
+    [ -n "$BASE3" ] && [ -n "$ADDR3" ] && break
+    if ! kill -0 "$PID3" 2>/dev/null; then
+        echo "metrics smoke: FAILED — sessions server died during startup:" >&2
+        cat "$LOG3" >&2
+        exit 1
+    fi
+    sleep 0.5
+done
+if [ -z "$BASE3" ] || [ -z "$ADDR3" ]; then
+    echo "metrics smoke: FAILED — sessions server printed no addresses" >&2
+    cat "$LOG3" >&2
+    exit 1
+fi
+
+# Two tenants, 16x apart in cells, each watched over the real wire so
+# every cost lane moves: bucket dispatch splits, host encode seconds,
+# wire bytes at the _Conn choke point.
+if ! JAX_PLATFORMS=cpu python - "${ADDR3%:*}" "${ADDR3##*:}" <<'PYEOF'
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from gol_tpu.distributed import Controller, SessionControl
+from gol_tpu.events import TurnComplete
+
+host, port = sys.argv[1], int(sys.argv[2])
+ctl = SessionControl(host, port)
+ctl.create("big", width=128, height=128, seed=1)
+ctl.create("small", width=32, height=32, seed=2)
+for sid in ("big", "small"):
+    w = Controller(host, port, want_flips=True, batch=True, session=sid)
+    assert w.wait_sync(60), f"no board sync for {sid}"
+    seen, deadline = 0, time.monotonic() + 60
+    for ev in w.events:
+        if isinstance(ev, TurnComplete):
+            seen = ev.completed_turns
+            if seen >= 12:
+                break
+        assert time.monotonic() < deadline, f"{sid} stream stalled"
+    w.detach(20)
+    w.close()
+ctl.close()
+print("TENANTS_OK")
+PYEOF
+then
+    echo "metrics smoke: FAILED — could not drive the two tenants" >&2
+    cat "$LOG3" >&2
+    exit 1
+fi
+
+USAGE1=$(fetch "$BASE/usage")
+USAGE3=$(fetch "$BASE3/usage")
+python -c '
+import json, sys
+u = json.loads(sys.argv[1])
+assert u["enabled"] is True, u
+per = u["principals"]
+assert "big" in per and "small" in per, sorted(per)
+# The 16x-larger board must out-bill the small one on modeled FLOPs
+# (a ~32x margin, robust on any host) — this IS the TOP-by-cost
+# ranking on the default sort. The wire/host lanes track DELIVERED
+# work, not board size (a shed frame is never encoded), so they only
+# have to be present and nonzero where a watched stream ran.
+assert per["big"]["flops"] > per["small"]["flops"] > 0, per
+for res in ("dispatch_seconds", "wire_bytes", "turns"):
+    assert per["big"][res] > 0 and per["small"][res] > 0, (res, per)
+# The soft budget (1000 wire bytes) is breached by both sync frames,
+# marked but never enforced (the tenants kept streaming).
+assert "big" in u["over_budget"], u["over_budget"]
+assert u["budgets"]["bytes"] == 1000.0, u["budgets"]
+' "$USAGE3" || {
+    echo "metrics smoke: FAILED — /usage mis-ranked the tenants: $USAGE3" >&2
+    exit 1
+}
+
+METRICS3=$(fetch "$BASE3/metrics")
+python -c '
+import sys
+m = sys.stdin.read()
+def val(prefix):
+    return sum(float(l.split()[-1]) for l in m.splitlines()
+               if l.startswith(prefix) and not l.startswith("#"))
+assert val("gol_tpu_invariant_violations_total{checker=\"accounting-conservation\"}") == 0, \
+    "bucket split lost resources (conservation invariant)"
+assert val("gol_tpu_usage_over_budget") >= 1, "budget breach not on the gauge"
+assert "gol_tpu_usage_dispatch_seconds{principal=" in m, \
+    "no live per-principal usage series"
+' <<<"$METRICS3" || {
+    echo "metrics smoke: FAILED — accounting series wrong on /metrics" >&2
+    exit 1
+}
+
+# The fleet join: console --once --json over BOTH live sidecars must
+# carry the ranked usage table, and its fleet TOTAL must sit between
+# the sum of per-process /usage totals fetched before and after the
+# scrape (both processes keep charging — monotone bounds are the
+# honest equality).
+SNAP=$(python -m gol_tpu.obs.console --once --json "$BASE" "$BASE3") || {
+    echo "metrics smoke: FAILED — console could not scrape both sidecars" >&2
+    exit 1
+}
+USAGE1B=$(fetch "$BASE/usage")
+USAGE3B=$(fetch "$BASE3/usage")
+python -c '
+import json, sys
+snap, u1, u3, u1b, u3b = (json.loads(a) for a in sys.argv[1:6])
+usage = snap["usage"]
+assert usage is not None, "console joined no usage payloads"
+ranked = usage["ranked"]
+# Fleet TOP-by-cost (default flops): the long-running singleton
+# engine legitimately tops the bill; within the tenants, big > small.
+assert ranked.index("big") < ranked.index("small"), ranked
+for res in ("dispatch_seconds", "turns", "wire_bytes"):
+    lo = u1["totals"][res] + u3["totals"][res]
+    hi = u1b["totals"][res] + u3b["totals"][res]
+    tot = usage["total"][res]
+    assert lo <= tot <= hi, (res, lo, tot, hi)
+# The singleton engine bills the anonymous legacy tier.
+assert "legacy" in usage["by_principal"], sorted(usage["by_principal"])
+assert usage["by_principal"]["big"]["over_budget"] is True
+' "$SNAP" "$USAGE1" "$USAGE3" "$USAGE1B" "$USAGE3B" || {
+    echo "metrics smoke: FAILED — fleet usage join inconsistent" >&2
+    exit 1
+}
+
+# The crash-safe ledger: segments exist under <out>/usage and the
+# offline report agrees the big tenant out-billed the small one.
+kill -TERM "$PID3"
+for _ in $(seq 1 60); do
+    kill -0 "$PID3" 2>/dev/null || break
+    sleep 0.5
+done
+wait "$PID3" 2>/dev/null || true
+ls "$OUT3"/usage/usage-*.jsonl >/dev/null 2>&1 || {
+    echo "metrics smoke: FAILED — no ledger segments under $OUT3/usage" >&2
+    exit 1
+}
+python -m gol_tpu.obs.report usage "$OUT3/usage" --json | python -c '
+import json, sys
+per = json.load(sys.stdin)["principals"]
+assert per["big"]["flops"] > per["small"]["flops"] > 0, per
+' || {
+    echo "metrics smoke: FAILED — report usage disagrees with /usage" >&2
+    exit 1
+}
+
 echo "metrics smoke: OK ($BASE — /metrics, /healthz, /vars, /trace,"
 echo "  /flightrecorder all live; device plane carries compiles/cost/"
 echo "  watermark/split; obs.console --once rendered $BASE2;"
 echo "  batch plane moved (gol_tpu_server_batch_turns) under a real"
-echo "  hello-batch client; SIGTERM dump at $DUMP renders clean)"
+echo "  hello-batch client; SIGTERM dump at $DUMP renders clean;"
+echo "  accounting plane ranked big>small on /usage with conservation"
+echo "  intact, budget breach marked, fleet TOTAL joined, ledger at"
+echo "  $OUT3/usage aggregated by report usage)"
