@@ -231,6 +231,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "frames (thousands of sockets per thread; "
                          "default 2, 0 restores a writer thread per "
                          "connection)")
+    ap.add_argument("--control", default=None, metavar="SPEC.json",
+                    help="run as the FLEET CONTROLLER (gol_tpu.control): "
+                         "own the declarative topology in SPEC.json and "
+                         "reconcile observed state toward it — heal dead "
+                         "relays (spawn + re-point the orphaned subtree), "
+                         "grow/shrink the relay tree, migrate sessions "
+                         "bit-exactly between engines, and roll managed "
+                         "engines behind --resume latest "
+                         "(docs/CONTROL.md)")
     ap.add_argument("--connect", default=None, metavar="HOST:PORT",
                     help="run as a controller attached to a remote engine")
     ap.add_argument("--session", default=None, metavar="ID",
@@ -423,7 +432,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     from gol_tpu.obs import device, flight, tracing
 
     tracing.set_process_label(
-        "replay" if args.replay is not None
+        "control" if args.control is not None
+        else "replay" if args.replay is not None
         else "serve" if args.serve is not None
         else "connect" if args.connect is not None else "local"
     )
@@ -520,6 +530,24 @@ def main(argv: Optional[list[str]] = None) -> int:
             "browsers through a co-located relay: start one with "
             "--relay HOST:PORT --serve PORT --ws-port N)"
         )
+    if args.control is not None:
+        # The fleet controller is its own process mode: it OWNS serving
+        # processes rather than being one, and it applies --resume
+        # latest to the engines it rolls, never to itself.
+        if (args.serve is not None or args.sessions
+                or args.relay is not None or args.connect is not None
+                or args.replay is not None):
+            raise SystemExit(
+                "error: --control is its own mode — it cannot combine "
+                "with --serve/--sessions/--relay/--connect/--replay"
+            )
+        if resume_path is not None:
+            raise SystemExit(
+                "error: --resume applies to an engine; the controller "
+                "itself holds no board state (it rolls engines with "
+                "--resume latest on their behalf)"
+            )
+        return _control_plane(args)
     if args.park_idle_secs is not None and not args.sessions:
         raise SystemExit(
             "error: --park-idle-secs applies to --serve --sessions "
@@ -924,6 +952,38 @@ def _relay(args) -> int:
             pass
     except KeyboardInterrupt:
         relay.shutdown()
+    finally:
+        if metrics is not None:
+            metrics.close()
+    return 0
+
+
+def _control_plane(args) -> int:
+    """Fleet controller (gol_tpu.control; docs/CONTROL.md): load the
+    declarative spec (a parse error aborts AT STARTUP, exactly the
+    --alert-rules discipline), then reconcile forever. The sidecar
+    serves the controller's own metrics + /healthz, so the console —
+    and another controller — can observe the observer."""
+    from gol_tpu.control import Controller, SpecError, load_spec
+
+    try:
+        spec = load_spec(args.control)
+        ctl = Controller(spec, out_dir=args.out)
+    except SpecError as e:
+        raise SystemExit(f"error: {e}") from None
+    print(f"controller reconciling {args.control} "
+          f"(root {spec.root}, {len(spec.engines)} engine(s), "
+          f"relays {spec.relay_min}..{spec.relay_max})")
+    metrics = _start_metrics(args, health=ctl.health)
+    from gol_tpu.obs import flight as _flight
+
+    _flight.set_state_provider(ctl.health)
+    ctl.start()
+    try:
+        while not ctl.wait(timeout=1.0):
+            pass
+    except KeyboardInterrupt:
+        ctl.shutdown()
     finally:
         if metrics is not None:
             metrics.close()

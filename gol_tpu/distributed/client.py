@@ -1256,6 +1256,30 @@ class SessionControl:
                           idempotent=True)
         return {"id": r.get("id"), "turn": r.get("turn")}
 
+    def adopt(self, sid: str, source: str) -> dict:
+        """Materialize a session hibernated under ANOTHER engine's
+        out tree (control-plane migration, PR 18): the server reads
+        `source`'s sidecar + latest snapshot, creates the session
+        resident at the snapshot turn, and re-checkpoints into its
+        OWN tree before acking. Idempotent under retry: an adopt
+        whose first attempt landed answers ok on the rid re-send."""
+        r = self._checked(
+            {"t": "session", "op": "adopt", "id": sid,
+             "source": source},
+            idempotent=True,
+        )
+        return r["session"]
+
+    def drain(self) -> dict:
+        """Checkpoint every resident session and stop admitting new
+        session attaches — the safe prelude to a rolling restart with
+        `--resume latest` (control plane, PR 18). Idempotent: a
+        retried drain re-checkpoints and stays draining."""
+        r = self._checked({"t": "session", "op": "drain"},
+                          idempotent=True)
+        return {"checkpointed": r.get("checkpointed"),
+                "draining": bool(r.get("draining"))}
+
     def close(self) -> None:
         if self._sock is None:
             return

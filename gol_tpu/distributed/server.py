@@ -85,7 +85,7 @@ class _ServerMetrics:
                 "gol_tpu_server_rejects_total",
                 "Attaches rejected by reason", {"reason": r},
             ) for r in ("bad-hello", "unauthorized", "busy",
-                        "at-capacity")
+                        "at-capacity", "draining")
         }
         self.attaches = {
             r: obs.counter(
@@ -2037,6 +2037,12 @@ class SessionServer:
         self._shutdown = threading.Event()
         self.done = threading.Event()
         self._threads: "list[threading.Thread]" = []
+        #: Drain verb (control plane, PR 18): once set, every live
+        #: session has a fresh checkpoint on disk and NEW session
+        #: attaches are refused — the safe prelude to a rolling
+        #: restart with `--resume latest`. Plain bool, GIL-atomic:
+        #: read on the accept path, written by the verb.
+        self.draining = False
 
     # --- lifecycle ---
 
@@ -2095,6 +2101,8 @@ class SessionServer:
         with self._conn_lock:
             info["peers"] = len(self._conns)
         info["address"] = list(self.address)
+        if self.draining:
+            info["draining"] = True
         if self._shutdown.is_set() and info.get("status") == "ok":
             info["status"] = "shutting-down"
         return info
@@ -2154,6 +2162,20 @@ class SessionServer:
             return
         role = ("observe" if hello.get("role") == "observe" else "drive")
         sid = hello.get("session")
+        if sid is not None and self.draining:
+            # A drained server is about to restart (control plane
+            # roll): session attaches bounce with a come-back hint —
+            # the client backoff rides the restart gap and resumes
+            # through BoardSync on the fresh incarnation. Bare control
+            # connections stay admitted (operators still list/verb).
+            _METRICS.rejects["draining"].inc()
+            with contextlib.suppress(Exception):
+                wire.send_msg(sock, {
+                    "t": "error", "reason": "draining",
+                    "retry_after": self.retry_after_secs,
+                })
+            sock.close()
+            return
         if sid is not None and (
             not valid_session_id(sid) or not self.manager.known(sid)
         ):
@@ -2492,6 +2514,40 @@ class SessionServer:
                 replayed=True,
             )
             return True
+        if op == "adopt" and reason == "exists":
+            # A retried adopt whose first attempt landed (or a
+            # controller resume re-issuing a committed migration leg):
+            # success iff the resident/parked session matches the
+            # SOURCE sidecar's geometry+rule — a pre-existing
+            # different session under the same id stays a real
+            # duplicate.
+            import os as _os
+
+            from gol_tpu.checkpoint import session_checkpoint_dir
+
+            sid = msg.get("id")
+            info = next(
+                (i for i in self.manager.list_sessions()
+                 if i["id"] == sid), None)
+            if info is None:
+                return False
+            try:
+                with open(_os.path.join(
+                    session_checkpoint_dir(str(msg.get("source"))),
+                    sid, "session.json",
+                )) as f:
+                    side = json.load(f)
+                same = (
+                    info.get("width") == int(side["width"])
+                    and info.get("height") == int(side["height"])
+                    and str(info.get("rule")) == str(side.get("rule"))
+                )
+            except (OSError, ValueError, KeyError, TypeError):
+                return False
+            if not same:
+                return False
+            reply.update(ok=True, session=info, replayed=True)
+            return True
         if op == "create" and reason == "exists":
             from gol_tpu.models.rules import get_rule
 
@@ -2598,6 +2654,16 @@ class SessionServer:
             elif op == "park":
                 r = self.manager.park(msg.get("id"))
                 reply.update(ok=True, **r)
+            elif op == "adopt":
+                # Control-plane migration (PR 18): materialize a
+                # session parked under ANOTHER engine's out tree. The
+                # manager re-checkpoints locally before this acks.
+                info = self.manager.adopt(msg.get("id"),
+                                          msg.get("source"))
+                reply.update(ok=True, session=info)
+            elif op == "drain":
+                n = self._drain()
+                reply.update(ok=True, checkpointed=n, draining=True)
             else:
                 reply.update(ok=False, reason="unknown-op")
         except SessionError as e:
@@ -2627,6 +2693,30 @@ class SessionServer:
             self._replay_record(rid, reply)
         with contextlib.suppress(wire.WireError, OSError):
             conn.send(reply)
+
+    def _drain(self) -> int:
+        """The roll verb's first half (control plane, PR 18):
+        checkpoint every RESIDENT session crash-atomically and flip
+        the draining flag so new session attaches bounce with a
+        retry hint. After this acks, a SIGTERM + `--resume latest`
+        restart loses nothing — parked sessions already sit on their
+        hibernation snapshots. Idempotent by construction: a retried
+        drain re-checkpoints (same turn, same bytes) and stays
+        draining. Returns the number checkpointed."""
+        from gol_tpu.sessions import SessionError
+
+        self.draining = True
+        n = 0
+        for info in self.manager.list_sessions():
+            if info.get("parked"):
+                continue
+            with contextlib.suppress(SessionError, TimeoutError,
+                                     OSError):
+                self.manager.checkpoint(info["id"])
+                n += 1
+        tracing.event("server.drain", "lifecycle", checkpointed=n)
+        flight.note("server.drain", checkpointed=n)
+        return n
 
     # --- liveness (the EngineServer discipline, per session) ---
 

@@ -112,6 +112,12 @@ class _RelayMetrics:
             "Downstream attaches rejected (bad hello, capability "
             "mismatch, capacity, auth)",
         )
+        self.repoints = obs.counter(
+            "gol_tpu_relay_repoints_total",
+            "Upstream re-point verbs applied (control plane heal: the "
+            "old link is torn down and the node re-attaches to a new "
+            "upstream with a fresh BoardSync)",
+        )
         self.forward_latency = obs.histogram(
             "gol_tpu_relay_forward_latency_seconds",
             "Root emit stamp -> frame arrival at THIS hop, on the "
@@ -247,6 +253,10 @@ class RelayNode:
         self._up_hb_secs = 0.0
         self.reconnects = 0
         self.synced = threading.Event()
+        #: Set by repoint(): the upstream loop treats the next link
+        #: death as a FRESH start (attempt/window reset) — a re-point
+        #: is an operator action, not a failure of the new target.
+        self._repointed = threading.Event()
         self._conns: "list[_Conn]" = []
         self._conn_lock = lockcheck.make_lock("RelayNode._conn_lock")
         self._shutdown = threading.Event()
@@ -343,6 +353,58 @@ class RelayNode:
             "reconnects": self.reconnects,
         }
 
+    def repoint(self, addr: "tuple[str, int]") -> dict:
+        """Re-point the upstream link at a NEW address (control plane
+        heal, PR 18): tear the current link, swap `self.upstream`, and
+        let the supervised `_upstream_loop` re-dial the new target with
+        a FRESH reconnect window and a fresh BoardSync. Downstream
+        peers never notice beyond the same brief stall an ordinary
+        upstream reconnect causes — their frames resume byte-exact
+        once the new upstream's board sync lands.
+
+        Returns {"upstream": "host:port", "changed": bool}; raises
+        ValueError for an address that would make the relay feed
+        itself (same guard as the constructor)."""
+        new = (str(addr[0]), int(addr[1]))
+        for own in (self.address, self.ws_address):
+            if own is not None and (
+                new[1] == own[1] and new[0] in (own[0], "localhost")
+            ):
+                raise ValueError(
+                    f"repoint target {new} loops back to this relay's "
+                    "own listener — a relay cannot feed itself"
+                )
+        with self._up_lock:
+            changed = new != self.upstream
+            old_labels = self._info_labels()
+            self.upstream = new
+            sock, self._up_sock = self._up_sock, None
+        if changed:
+            # Swap the info-gauge child BEFORE the re-dial: the
+            # console/controller tree join must see the new edge on
+            # the very next scrape, not after the link comes up.
+            obs.registry().remove("gol_tpu_relay_node_info", old_labels)
+            self._info_gauge()
+            self.clock_offset = None
+            self.upstream_rtt = None
+            _METRICS.repoints.inc()
+            tracing.event("relay.repoint", "lifecycle",
+                          upstream=f"{new[0]}:{new[1]}")
+            flight.note("relay.repoint", upstream=f"{new[0]}:{new[1]}")
+        self.synced.clear()
+        self._repointed.set()
+        if sock is not None:
+            # Killing the socket makes _forward_stream raise; the
+            # supervised loop then re-dials self.upstream — which now
+            # names the new target. Works identically when the loop is
+            # parked in a backoff wait (the _repointed flag resets its
+            # attempt counter and window).
+            with contextlib.suppress(OSError):
+                sock.shutdown(socket.SHUT_RDWR)
+            with contextlib.suppress(OSError):
+                sock.close()
+        return {"upstream": f"{new[0]}:{new[1]}", "changed": changed}
+
     # --- upstream: one batching binary client ---
 
     def _dial_upstream(self) -> socket.socket:
@@ -426,6 +488,16 @@ class RelayNode:
                 log.warning("upstream dial failed (%s) — retrying", e)
                 continue
             self._up_sock = sock
+            if self._repointed.is_set():
+                # A repoint landed while this dial was in flight: the
+                # socket may still point at the OLD upstream. Drop it
+                # and re-dial — self.upstream now names the new target.
+                self._repointed.clear()
+                with contextlib.suppress(OSError):
+                    sock.close()
+                self._up_sock = None
+                attempt, deadline = 0, None
+                continue
             if attempt:
                 self.reconnects += 1
                 _METRICS.reconnects.inc()
@@ -456,6 +528,12 @@ class RelayNode:
     def _backoff(self, attempt, deadline, hint):
         """One supervised retry wait; returns (attempt, deadline,
         exhausted)."""
+        if self._repointed.is_set():
+            # A repoint landed mid-backoff: the NEW target deserves a
+            # fresh attempt counter and window, whatever the old
+            # address had burned dialing a dead upstream.
+            self._repointed.clear()
+            attempt, deadline = 0, None
         if deadline is None:
             deadline = time.monotonic() + self._window
         if hint is not None:
@@ -839,6 +917,19 @@ class RelayNode:
         t = msg.get("t")
         if t == "clk":
             self._clk_reply(conn, msg)
+        elif t == "repoint":
+            # Control-plane heal verb (PR 18): re-point this relay's
+            # upstream at a new address. Rides the ordinary downstream
+            # link, so the relay-secret handshake already gates it.
+            try:
+                host, _, port = str(msg.get("addr", "")).rpartition(":")
+                result = self.repoint((host, int(port)))
+                reply = {"t": "repoint-r", "ok": True, **result}
+            except (ValueError, TypeError) as e:
+                reply = {"t": "repoint-r", "ok": False,
+                         "reason": str(e) or "bad-addr"}
+            with contextlib.suppress(Exception):
+                conn.send_direct(reply)
         elif t == "key":
             if msg.get("key") == "q":
                 self._drop_from_reader(conn)

@@ -117,6 +117,11 @@ class _SessionMetrics:
             "gol_tpu_session_rehydrates_total",
             "Parked sessions restored into a bucket slot on attach",
         )
+        self.adoptions = obs.counter(
+            "gol_tpu_session_adoptions_total",
+            "Sessions adopted from ANOTHER manager's checkpoint tree "
+            "(control-plane migration: park on A, adopt on B)",
+        )
         paths = ("fused", "diffs", "compact")
         self.dispatches = {
             p: obs.counter(
@@ -473,6 +478,28 @@ class SessionManager:
         ("parked") when already hibernated. The next attach
         rehydrates it bit-exactly."""
         return self._exec(lambda: self._park(sid))
+
+    def adopt(self, sid: str, source_dir: "str | os.PathLike") -> dict:
+        """Adopt a session hibernated under ANOTHER manager's out tree
+        (control-plane migration, PR 18: park on engine A, adopt on
+        engine B, flip the serving endpoint). Reads the source tree's
+        `session.json` sidecar + latest snapshot — the same bit-exact
+        state a local rehydrate would load — creates the session
+        resident HERE at the snapshot turn, and immediately
+        re-checkpoints into THIS manager's own tree so the adopted
+        session is durable locally (B's resume never depends on A's
+        disk again).
+
+        The source tree is read-only: the parked record on A stays
+        A's to destroy (the controller's two-phase migration record
+        sequences that). Raises SessionError("exists") for a duplicate
+        id, ("unknown-session") when the source has no such session or
+        it is tombstoned there, ("unrecoverable") for a torn source
+        tree."""
+        if not valid_session_id(sid):
+            raise SessionError("bad-session-id")
+        return self._exec(
+            lambda: self._adopt(sid, os.fspath(source_dir)))
 
     def park_idle(self) -> int:
         """Park every session idle (no sink) past `park_idle_secs` —
@@ -1121,6 +1148,61 @@ class SessionManager:
                       turn=turn)
         flight.note("session.rehydrate", session=sid, turn=turn)
         return self._by_id[sid]
+
+    def _adopt(self, sid: str, source_dir: str) -> dict:
+        """Owner-thread half of `adopt`: load the FOREIGN tree's
+        sidecar + snapshot (read-only), create resident, re-checkpoint
+        locally. Mirrors `_rehydrate`'s torn-tree discipline — every
+        malformed field is a SessionError, never a crash."""
+        from gol_tpu.checkpoint import (
+            is_tombstoned,
+            latest_any_snapshot,
+            session_checkpoint_dir,
+            snapshot_turn,
+        )
+        from gol_tpu.io.pgm import read_pgm
+
+        if sid in self._by_id or sid in self._parked:
+            raise SessionError("exists")
+        if is_tombstoned(source_dir, sid):
+            # Destroyed at the source: adopting it would resurrect a
+            # session some verb already acked as gone.
+            raise SessionError("unknown-session")
+        d = os.path.join(session_checkpoint_dir(source_dir), sid)
+        try:
+            with open(os.path.join(d, "session.json")) as f:
+                side = json.load(f)
+        except (OSError, ValueError):
+            raise SessionError("unknown-session") from None
+        try:
+            w, h = int(side["width"]), int(side["height"])
+            rule = get_rule(side.get("rule") or str(self.default_rule))
+            turn = int(side.get("turn", 0))
+        except (KeyError, TypeError, ValueError):
+            raise SessionError("unrecoverable") from None
+        if w <= 0 or h <= 0 or w * h > MAX_SESSION_CELLS:
+            raise SessionError("unrecoverable")
+        board = None
+        found = latest_any_snapshot(d)
+        if found is not None:
+            path, _w, _h = found
+            with contextlib.suppress(OSError, ValueError):
+                board = read_pgm(path)
+                turn = snapshot_turn(path)
+        if board is None or board.shape != (h, w):
+            # No complete snapshot (or one of a different geometry
+            # than the sidecar claims): nothing bit-exact to adopt.
+            raise SessionError("unrecoverable")
+        info = self._create(sid, w, h, rule, board, turn)
+        # Durability lands HERE before the verb acks: the adopted
+        # session must resume from THIS tree even if the source
+        # engine's disk disappears the moment the migration commits.
+        self._checkpoint(sid)
+        _METRICS.adoptions.inc()
+        tracing.event("session.adopt", "lifecycle", session=sid,
+                      turn=turn, source=source_dir)
+        flight.note("session.adopt", session=sid, turn=turn)
+        return info
 
     def _attach(self, sid: str, sink: Sink) -> dict:
         s = self._by_id.get(sid)

@@ -101,7 +101,19 @@ LOWER_BETTER = re.compile(
     # survives a rename of that token); the lane's usage_totals stay
     # informational, and its conservation `violations` ride the
     # off-zero invariant rule above.
-    r"|accounting_overhead_pct)", re.I
+    r"|accounting_overhead_pct"
+    # Control plane (ISSUE 18): the control_heal lane's
+    # heal_wall_seconds / heal_action_seconds regress UP (already
+    # matched by the generic `seconds` token — spelled here so the
+    # lane's gate survives a rename of that token), and the
+    # controller's failure counters are off-zero-gated: action_errors
+    # (reconcile verbs that threw) and stale_refusals (destructive
+    # verbs refused on stale evidence) both sit at 0 on a healthy
+    # bench box — either moving off a zero baseline means the control
+    # loop started fighting the fleet it reconciles, an infinite
+    # regression. The lane's invariant_violations ride the off-zero
+    # `violations` rule above.
+    r"|heal_wall|heal_action|action_errors|stale_refusals)", re.I
 )
 INFORMATIONAL = re.compile(
     # Accounting lane (ISSUE 17): the per-leg throughputs and whatever

@@ -1,0 +1,321 @@
+#!/usr/bin/env bash
+# Control-plane smoke (ISSUE 18 / ROADMAP item 6 acceptance): boot a
+# real root engine with a 2-deep relay chain under it (root -> R1 ->
+# R2, both operator-started), put a live leaf client on R2, then run
+# the fleet controller over the whole tree and
+#   - SIGKILL the MID-TREE relay R1: the controller must detect the
+#     death (down_rounds missed scrapes), spawn a replacement relay on
+#     the dead node's upstream, and re-point the orphaned R2 at it —
+#     asserted via the console's `--once --json` topology (root ->
+#     replacement -> R2) and timed (the control_heal bench lane);
+#   - the leaf's board stays BIT-IDENTICAL to a direct-attach client
+#     of the same run (compared after pausing the engine so every
+#     stream quiesces at one turn) — the heal rode BoardSync, it
+#     didn't fork the world;
+#   - attaching an observer horde past relays.observers_per_relay
+#     makes the scale rule GROW the tree (a fresh controller-spawned
+#     relay appears in the manifest);
+#   - zero invariant violations across the fleet, zero controller
+#     action errors, zero stale refusals.
+#
+# Usage: scripts/control_smoke.sh   (CPU-safe; ~2-3 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+LOG_ROOT=$(mktemp) LOG_R1=$(mktemp) LOG_R2=$(mktemp) LOG_CTL=$(mktemp)
+OUT=$(mktemp -d)
+cleanup() {
+    # Controller FIRST (its shutdown never takes the data plane down,
+    # and a live reconcile loop would heal the nodes we kill next),
+    # then every child it spawned (manifest pids), then our own tree.
+    [ -n "${PID_CTL:-}" ] && kill "$PID_CTL" 2>/dev/null || true
+    [ -n "${PID_CTL:-}" ] && wait "$PID_CTL" 2>/dev/null || true
+    python - "$OUT/ctl/controller.json" <<'PYEOF' 2>/dev/null || true
+import json, os, signal, sys
+try:
+    with open(sys.argv[1]) as f:
+        man = json.load(f)
+except OSError:
+    sys.exit(0)
+for kind in ("relays", "engines"):
+    for meta in (man.get("spawned", {}).get(kind) or {}).values():
+        pid = meta.get("pid")
+        if pid:
+            try:
+                os.kill(int(pid), signal.SIGKILL)
+            except OSError:
+                pass
+PYEOF
+    for p in "${PID_R2:-}" "${PID_R1:-}" "${PID_ROOT:-}"; do
+        [ -n "$p" ] && kill -9 "$p" 2>/dev/null || true
+    done
+    for p in "${PID_R2:-}" "${PID_R1:-}" "${PID_ROOT:-}"; do
+        [ -n "$p" ] && wait "$p" 2>/dev/null || true
+    done
+    rm -rf "$LOG_ROOT" "$LOG_R1" "$LOG_R2" "$LOG_CTL" "$OUT"
+}
+trap cleanup EXIT
+
+wait_addr() {  # $1 log, $2 sed pattern -> prints host:port
+    local addr=""
+    for _ in $(seq 1 240); do
+        addr=$(sed -n "$2" "$1" | head -1)
+        [ -n "$addr" ] && break
+        sleep 0.5
+    done
+    if [ -z "$addr" ]; then
+        echo "control smoke: FAILED — no address in $1:" >&2
+        cat "$1" >&2
+        exit 1
+    fi
+    echo "$addr"
+}
+
+python -m gol_tpu --serve 127.0.0.1:0 -noVis -t 2 -w 256 -h 256 \
+    -turns 1000000000 --images fixtures/images --out "$OUT/root" \
+    --platform cpu --metrics-port 0 >"$LOG_ROOT" 2>&1 &
+PID_ROOT=$!
+ROOT=$(wait_addr "$LOG_ROOT" 's#^engine serving on \(.*\)$#\1#p')
+ROOT_MX=$(wait_addr "$LOG_ROOT" \
+    's#^metrics serving on http://\([^/]*\)/metrics$#\1#p')
+echo "root at $ROOT (metrics $ROOT_MX)"
+
+python -m gol_tpu --relay "$ROOT" --serve 127.0.0.1:0 --platform cpu \
+    --metrics-port 0 >"$LOG_R1" 2>&1 &
+PID_R1=$!
+R1=$(wait_addr "$LOG_R1" 's#^relay serving on \([^ ]*\) .*$#\1#p')
+R1_MX=$(wait_addr "$LOG_R1" \
+    's#^metrics serving on http://\([^/]*\)/metrics$#\1#p')
+echo "relay1 at $R1 (metrics $R1_MX)"
+
+python -m gol_tpu --relay "$R1" --serve 127.0.0.1:0 --platform cpu \
+    --metrics-port 0 >"$LOG_R2" 2>&1 &
+PID_R2=$!
+R2=$(wait_addr "$LOG_R2" 's#^relay serving on \([^ ]*\) .*$#\1#p')
+R2_MX=$(wait_addr "$LOG_R2" \
+    's#^metrics serving on http://\([^/]*\)/metrics$#\1#p')
+echo "relay2 at $R2 (metrics $R2_MX)"
+
+# Desired state: the chain we just built IS compliant (min 2 relays,
+# none over 64 observers), so the controller's first rounds are
+# no-ops — the level to trigger on arrives with the SIGKILL. Budget 1
+# keeps the heal round from also growing against the mid-kill dip.
+cat > "$OUT/fleet.json" <<EOF
+{
+  "root": "$ROOT",
+  "scrape": ["$ROOT_MX", "$R1_MX", "$R2_MX"],
+  "relays": {"min": 2, "max": 4, "observers_per_relay": 64},
+  "interval_secs": 0.5,
+  "stale_secs": 10.0,
+  "down_rounds": 2,
+  "actions_per_round": 1,
+  "spawn_args": ["--platform", "cpu"]
+}
+EOF
+
+python -m gol_tpu --control "$OUT/fleet.json" --out "$OUT/ctl" \
+    --metrics-port 0 >"$LOG_CTL" 2>&1 &
+PID_CTL=$!
+CTL_MX=$(wait_addr "$LOG_CTL" \
+    's#^metrics serving on http://\([^/]*\)/metrics$#\1#p')
+echo "controller up (metrics $CTL_MX)"
+
+JAX_PLATFORMS=cpu python - "$ROOT" "$R2" "$ROOT_MX" "$R2_MX" \
+    "$CTL_MX" "$PID_R1" "$OUT/ctl/controller.json" <<'PYEOF'
+import json
+import os
+import selectors
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from gol_tpu.distributed import Controller, wire
+
+
+def addr(spec):
+    h, _, p = spec.rpartition(":")
+    return h, int(p)
+
+
+ROOT, R2 = addr(sys.argv[1]), addr(sys.argv[2])
+ROOT_MX, R2_MX, CTL_MX = sys.argv[3], sys.argv[4], sys.argv[5]
+PID_R1, MANIFEST = int(sys.argv[6]), sys.argv[7]
+
+
+def metric(base, name, *labels):
+    # Label order in the exposition is sorted, not authored — match
+    # each wanted label pair independently.
+    text = urllib.request.urlopen(f"http://{base}/metrics",
+                                  timeout=15).read().decode()
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            head = line.split(" ")[0]
+            if head == name or head.startswith(name + "{"):
+                if all(lb in head for lb in labels):
+                    total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.1)
+    raise SystemExit(f"control smoke: FAILED — timed out waiting for "
+                     f"{what}")
+
+
+def spawned_relays():
+    try:
+        with open(MANIFEST) as f:
+            return json.load(f).get("spawned", {}).get("relays", {})
+    except (OSError, ValueError):
+        return {}
+
+
+# A leaf on R2 (the subtree the heal must carry over) and a direct
+# client at the root (the oracle view for bit-identity).
+direct = Controller(*ROOT, want_flips=True, batch=True,
+                    batch_turns=256, observe=True,
+                    batch_flip_events=False)
+leaf = Controller(*R2, want_flips=True, batch=True, batch_turns=256,
+                  observe=True, batch_flip_events=False)
+assert direct.wait_sync(120) and leaf.wait_sync(120), "tier sync failed"
+print("direct + leaf clients synced")
+
+# Let the controller observe the compliant steady state first: the
+# heal must be triggered by the kill, not by boot-time churn.
+wait_for(lambda: metric(CTL_MX, "gol_tpu_controller_rounds_total") >= 3,
+         60, "3 reconcile rounds")
+assert not spawned_relays(), "controller spawned into a compliant fleet"
+
+# --- the kill: SIGKILL the MID-TREE relay ---------------------------
+t0 = time.monotonic()
+os.kill(PID_R1, signal.SIGKILL)
+print("SIGKILLed mid-tree relay (pid %d)" % PID_R1)
+wait_for(lambda: metric(CTL_MX, "gol_tpu_controller_actions_total",
+                        'verb="heal"', 'outcome="ok"') >= 1,
+         90, "the heal action")
+heal_wall = time.monotonic() - t0
+heal_action = metric(CTL_MX, "gol_tpu_controller_last_heal_seconds")
+print(f"healed in {heal_wall:.2f}s wall "
+      f"(spawn+repoint {heal_action:.2f}s)")
+
+relays = spawned_relays()
+assert len(relays) == 1, f"expected 1 spawned replacement: {relays}"
+(repl_listen, repl_meta), = relays.items()
+repl_mx = repl_meta["metrics"]
+print(f"replacement relay at {repl_listen} (metrics {repl_mx})")
+
+# Healed topology via the console, exactly as an operator would ask:
+# root -> replacement -> R2 (R2's upstream gauge flips on repoint).
+def tree_healed():
+    p = subprocess.run(
+        [sys.executable, "-m", "gol_tpu.obs.console", ROOT_MX, R2_MX,
+         repl_mx, CTL_MX, "--once", "--json"],
+        capture_output=True, text=True)
+    if p.returncode != 0:
+        return None
+    snap = json.loads(p.stdout)
+    for root in snap.get("tree", []):
+        for child in root.get("children", []):
+            if child.get("listen") == repl_listen and any(
+                g.get("listen") == f"{R2[0]}:{R2[1]}"
+                for g in child.get("children", [])
+            ):
+                return snap
+    return None
+
+
+holder = {}
+wait_for(lambda: holder.update(s=tree_healed()) or holder["s"],
+         60, "console tree root -> replacement -> R2")
+snap = holder["s"]
+assert not snap["down"], snap["down"]
+assert not (snap["total"].get("violations") or 0), \
+    "invariant violations after heal"
+print("console tree OK: root -> replacement -> R2")
+
+# --- observer growth: push R2 past observers_per_relay --------------
+sel = selectors.DefaultSelector()
+horde = []
+
+
+def drain_loop():
+    while True:
+        for key, _ in sel.select(0.2):
+            try:
+                while key.fileobj.recv(1 << 16):
+                    pass
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError:
+                try:
+                    sel.unregister(key.fileobj)
+                except (KeyError, ValueError):
+                    pass
+
+
+threading.Thread(target=drain_loop, daemon=True).start()
+for _ in range(130):
+    s = socket.create_connection(R2, timeout=30)
+    s.settimeout(30)
+    wire.send_msg(s, {"t": "hello", "want_flips": True,
+                      "binary": True, "role": "observe"})
+    s.setblocking(False)
+    sel.register(s, selectors.EVENT_READ)
+    horde.append(s)
+print("130 observers attached to R2")
+wait_for(lambda: len(spawned_relays()) >= 2, 90,
+         "the scale rule growing the tree")
+grown = [l for l in spawned_relays() if l != repl_listen]
+print(f"scale rule grew the tree: {grown}")
+
+# --- bit-identity through the healed path ---------------------------
+driver = Controller(*ROOT, want_flips=False)
+assert driver.wait_sync(60)
+driver.send_key("p")
+prev = None
+for _ in range(120):
+    time.sleep(0.5)
+    cur = (direct.sync_turn, np.count_nonzero(direct.board),
+           np.count_nonzero(leaf.board))
+    if cur == prev:
+        break
+    prev = cur
+np.testing.assert_array_equal(
+    leaf.board != 0, direct.board != 0,
+    err_msg="leaf behind the healed relay diverges from the direct "
+            "client",
+)
+print("bit-identity OK through the healed path")
+
+# --- gates ----------------------------------------------------------
+for mx in (ROOT_MX, R2_MX, repl_mx, CTL_MX):
+    v = metric(mx, "gol_tpu_invariant_violations_total")
+    assert v == 0, f"invariant violations on {mx}: {v}"
+errors = metric(CTL_MX, "gol_tpu_controller_actions_total",
+                'outcome="error"')
+assert errors == 0, f"controller action errors: {errors}"
+stale = metric(CTL_MX, "gol_tpu_controller_stale_refusals_total")
+assert stale == 0, f"controller stale refusals: {stale}"
+
+print(json.dumps({"control_heal": {
+    "heal_wall_seconds": round(heal_wall, 3),
+    "heal_action_seconds": round(heal_action, 3),
+    "action_errors": int(errors),
+    "stale_refusals": int(stale),
+    "invariant_violations": 0,
+}}))
+print("CONTROL SMOKE PASS")
+PYEOF
+
+echo "control smoke: PASS"

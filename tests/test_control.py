@@ -1,0 +1,746 @@
+"""gol_tpu.control — the reconciling fleet controller (ISSUE 18).
+
+Pins the control plane's contracts:
+
+- SPEC: strict validation — every malformed field is a SpecError
+  naming it; a controller must refuse to boot on a typo'd spec.
+- MANIFEST: two-phase migration records are crash-atomic — an open
+  intent survives a reload (the SIGKILL shape) and re-begin returns
+  the SAME rid; done/abort close it; spawned-node and roll registries
+  round-trip.
+- REPOINT (satellite): the `repoint` wire verb swaps a live relay's
+  upstream and the SAME downstream connection receives a fresh
+  BoardSync from the NEW target — bit-identical to the new root's
+  board; feeding a relay to itself is refused with the link intact.
+- MIGRATE (satellite): park on manager A / adopt on manager B is
+  bit-exact, evicts A's per-session metric children at park, and
+  grows fresh ones on B; the wire legs are state-based idempotent.
+- RECONCILE fault sweep: stale scrapes refuse destructive actions,
+  the per-round budget clips a flapping-alert storm (and backoff
+  defers the failed key), a dead relay heals by spawn + orphan
+  re-point, retire is drain-then-kill, and a controller "killed"
+  between migration legs resumes idempotently — no duplicate
+  session, the manifest record driven to done.
+"""
+
+import contextlib
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import obs
+from gol_tpu.control import (
+    Controller,
+    ControllerManifest,
+    FleetSpec,
+    SpecError,
+    load_spec,
+    repoint_relay,
+)
+from gol_tpu.distributed import wire
+from gol_tpu.ops import life
+from gol_tpu.sessions import SessionError, SessionManager
+from gol_tpu.testing.leaks import lockcheck_guard
+
+
+@pytest.fixture(autouse=True)
+def _invariants_on(monkeypatch):
+    yield from lockcheck_guard(monkeypatch)
+
+
+def _world(seed=7, w=64, h=64, density=0.3):
+    rng = np.random.default_rng(seed)
+    return ((rng.random((h, w)) < density).astype(np.uint8) * 255)
+
+
+def _wait(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+# --- spec validation -----------------------------------------------------
+
+
+def test_spec_minimal_defaults():
+    s = FleetSpec({"root": "127.0.0.1:8100"})
+    assert s.root == "127.0.0.1:8100"
+    assert s.relay_min == 0 and s.relay_max == 8
+    assert s.observers_per_relay == 64
+    assert s.interval_secs == 2.0 and s.stale_secs == 15.0
+    assert s.down_rounds == 2 and s.actions_per_round == 2
+    assert s.engines == [] and s.sessions == {}
+    assert s.roll_generation == 0
+
+
+@pytest.mark.parametrize("raw,field", [
+    ({}, "root"),
+    ({"root": "nocolon"}, "root"),
+    ({"root": "127.0.0.1:8100", "scrape": "9100"}, "scrape"),
+    ({"root": "127.0.0.1:8100", "secret": 7}, "secret"),
+    ({"root": "127.0.0.1:8100", "relays": {"min": 4, "max": 2}},
+     "relays.max"),
+    ({"root": "127.0.0.1:8100",
+      "relays": {"observers_per_relay": 0}},
+     "relays.observers_per_relay"),
+    ({"root": "127.0.0.1:8100", "engines": [{"addr": "bad"}]},
+     "engines[0].addr"),
+    ({"root": "127.0.0.1:8100",
+      "engines": [{"addr": "127.0.0.1:8030"}]}, "engines[0].out"),
+    ({"root": "127.0.0.1:8100",
+      "engines": [{"addr": "127.0.0.1:8030", "out": "o",
+                   "args": "x"}]}, "engines[0].args"),
+    ({"root": "127.0.0.1:8100",
+      "engines": [{"addr": "127.0.0.1:8030", "out": "a"},
+                  {"addr": "127.0.0.1:8030", "out": "b"}]},
+     "duplicate"),
+    ({"root": "127.0.0.1:8100",
+      "sessions": {"s1": "127.0.0.1:9999"}}, "sessions['s1']"),
+    ({"root": "127.0.0.1:8100", "interval_secs": 0}, "interval_secs"),
+    ({"root": "127.0.0.1:8100", "actions_per_round": 0},
+     "actions_per_round"),
+    ({"root": "127.0.0.1:8100", "heal_alerts": [3]}, "heal_alerts"),
+])
+def test_spec_rejects_malformed_fields(raw, field):
+    with pytest.raises(SpecError) as e:
+        FleetSpec(raw)
+    assert field.split(".")[0].split("[")[0] in str(e.value), (
+        f"SpecError must name the offending field: {e.value}"
+    )
+
+
+def test_load_spec_unreadable_and_bad_json(tmp_path):
+    with pytest.raises(SpecError, match="cannot read spec"):
+        load_spec(tmp_path / "missing.json")
+    p = tmp_path / "garbage.json"
+    p.write_text("{not json")
+    with pytest.raises(SpecError, match="not valid JSON"):
+        load_spec(p)
+    p2 = tmp_path / "ok.json"
+    p2.write_text('{"root": "127.0.0.1:8100", "relays": {"min": 1}}')
+    assert load_spec(p2).relay_min == 1
+
+
+# --- controller manifest (the crash-atomic WAL) --------------------------
+
+
+def test_manifest_two_phase_survives_reload(tmp_path):
+    path = tmp_path / "controller.json"
+    m = ControllerManifest(path)
+    rid = m.migration_begin("s1", "127.0.0.1:1", "127.0.0.1:2")
+    # Re-begin for the same sid is the CRASH-RESUME path: same rid,
+    # no second record.
+    assert m.migration_begin("s1", "127.0.0.1:1", "127.0.0.1:2") == rid
+    assert list(m.pending_migrations()) == [rid]
+    assert m.serving("s1") == "127.0.0.1:1"
+    # A controller SIGKILL is a reload: the intent is still open.
+    m2 = ControllerManifest(path)
+    assert list(m2.pending_migrations()) == [rid]
+    m2.migration_done(rid, serving="127.0.0.1:2")
+    assert m2.pending_migrations() == {}
+    assert m2.serving("s1") == "127.0.0.1:2"
+    # ...and done is durable too.
+    m3 = ControllerManifest(path)
+    assert m3.pending_migrations() == {}
+    assert m3.migration(rid)["phase"] == "done"
+    # A NEW migration for the same sid gets a NEW rid (seq moved on).
+    rid2 = m3.migration_begin("s1", "127.0.0.1:2", "127.0.0.1:1")
+    assert rid2 != rid
+
+
+def test_manifest_abort_registries_and_garbage(tmp_path):
+    path = tmp_path / "controller.json"
+    m = ControllerManifest(path)
+    rid = m.migration_begin("s9", "127.0.0.1:1", "127.0.0.1:2")
+    m.migration_abort(rid, "observed on neither")
+    assert m.pending_migrations() == {}
+    rec = ControllerManifest(path).migration(rid)
+    assert rec["phase"] == "aborted"
+    assert rec["reason"] == "observed on neither"
+    # The session stayed where it was: serving never flipped.
+    assert ControllerManifest(path).serving("s9") == "127.0.0.1:1"
+    # Spawned-node + roll registries round-trip.
+    m.record_spawn("relays", "127.0.0.1:7001", "127.0.0.1:9101", 4242)
+    m.roll_start(3)
+    m.roll_mark("127.0.0.1:8030")
+    m2 = ControllerManifest(path)
+    assert m2.spawned("relays")["127.0.0.1:7001"] == {
+        "metrics": "127.0.0.1:9101", "pid": 4242}
+    assert m2.roll_state() == {"generation": 3,
+                               "done": ["127.0.0.1:8030"]}
+    # roll_start on the SAME generation preserves mid-roll progress.
+    m2.roll_start(3)
+    assert m2.roll_done() == ["127.0.0.1:8030"]
+    m2.forget_spawn("relays", "127.0.0.1:7001")
+    assert ControllerManifest(path).spawned("relays") == {}
+    # Hand-edited garbage reads as a FRESH controller, never a crash.
+    path.write_text("}{ not json")
+    assert ControllerManifest(path).pending_migrations() == {}
+
+
+# --- relay repoint (satellite 2) -----------------------------------------
+
+
+def _fake_root(board):
+    """A scripted quiet root serving `board`: accepts a relay, acks,
+    sends one board frame, echoes clk probes. Returns (listener,
+    stop_event)."""
+    listener = socket.create_server(("127.0.0.1", 0))
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            try:
+                s, _ = listener.accept()
+            except OSError:
+                return
+            try:
+                s.settimeout(30)
+                wire.recv_msg(s, allow_binary=False)  # hello
+                wire.send_msg(s, {"t": "attach-ack", "clock": True,
+                                  "depth": 0, "batch": 16})
+                s.sendall(wire.frame_bytes(
+                    wire.board_to_frame(0, board, 0)))
+                while not stop.wait(0.2):
+                    try:
+                        s.settimeout(0.05)
+                        m = wire.recv_msg(s, allow_binary=False)
+                    except TimeoutError:
+                        continue
+                    except (wire.WireError, OSError):
+                        break
+                    if m is None:
+                        break
+                    if m.get("t") == "clk":
+                        wire.send_msg(s, {"t": "clk", "t0": m.get("t0"),
+                                          "ts": time.time()})
+            except Exception:
+                pass
+            finally:
+                with contextlib.suppress(OSError):
+                    s.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+    return listener, stop
+
+
+def _attach(address, **extra):
+    s = socket.create_connection(address, timeout=30)
+    s.settimeout(30)
+    wire.send_msg(s, {"t": "hello", "want_flips": True, "binary": True,
+                      "role": "observe", **extra})
+    return s, wire.recv_msg(s, allow_binary=False)
+
+
+def _next_board(sock, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            m = wire.recv_msg(sock)
+        except TimeoutError:
+            continue
+        assert m is not None, "stream ended while waiting for a board"
+        if m.get("t") == "board":
+            _, b = wire.msg_to_board(m)
+            return np.array(b, np.uint8)
+    pytest.fail("no board frame arrived")
+
+
+def test_relay_repoint_resyncs_from_new_upstream():
+    """The heal verb's data-plane half: `repoint` over the wire swaps
+    a live relay's upstream, and the SAME downstream connection is
+    made whole by a fresh BoardSync from the NEW target — bit-exact
+    by construction, exactly what the orphaned subtree rides during a
+    controller heal."""
+    from gol_tpu.relay import RelayNode, node as relay_node
+
+    board_a, board_b = _world(11), _world(22)
+    la, stopa = _fake_root(board_a)
+    lb, stopb = _fake_root(board_b)
+    relay = RelayNode(la.getsockname(), port=0,
+                      reconnect_window=60.0, reconnect_seed=3).start()
+    try:
+        assert relay.synced.wait(30)
+        leaf, ack = _attach(relay.address)
+        assert ack.get("t") == "attach-ack"
+        np.testing.assert_array_equal(
+            _next_board(leaf) != 0, board_a != 0,
+            err_msg="leaf never saw the OLD upstream's board",
+        )
+        rp0 = obs.registry().counter(
+            "gol_tpu_relay_repoints_total").value
+        target = "127.0.0.1:%d" % lb.getsockname()[1]
+        r = repoint_relay("127.0.0.1:%d" % relay.address[1], target)
+        assert r.get("ok") and r.get("upstream") == target
+        # The new upstream's sync fans out on the SAME leaf link.
+        deadline = time.monotonic() + 30
+        while True:
+            got = _next_board(leaf, timeout=max(
+                0.1, deadline - time.monotonic()))
+            if np.array_equal(got != 0, board_b != 0):
+                break
+            assert time.monotonic() < deadline, (
+                "leaf never resynced from the NEW upstream"
+            )
+        assert relay.upstream == ("127.0.0.1", lb.getsockname()[1])
+        assert obs.registry().counter(
+            "gol_tpu_relay_repoints_total").value == rp0 + 1
+        leaf.close()
+    finally:
+        stopa.set()
+        stopb.set()
+        la.close()
+        lb.close()
+        relay.shutdown()
+
+
+def test_relay_repoint_refuses_feeding_itself():
+    """The constructor's loopback guard holds for the live verb too —
+    both in-process and over the wire, and a refused repoint leaves
+    the relay serving."""
+    from gol_tpu.relay import RelayNode
+
+    l, stop = _fake_root(_world(1))
+    relay = RelayNode(l.getsockname(), port=0).start()
+    try:
+        assert relay.synced.wait(30)
+        with pytest.raises(ValueError, match="feed itself"):
+            relay.repoint(relay.address)
+        own = "127.0.0.1:%d" % relay.address[1]
+        with pytest.raises(wire.WireError, match="repoint refused"):
+            repoint_relay(own, own)
+        # Still serving: a fresh observer acks and syncs.
+        s, ack = _attach(relay.address)
+        assert ack.get("t") == "attach-ack"
+        _next_board(s)
+        s.close()
+        assert relay.upstream == ("127.0.0.1", l.getsockname()[1])
+    finally:
+        stop.set()
+        l.close()
+        relay.shutdown()
+
+
+# --- park/adopt migration legs (satellite 3) -----------------------------
+
+
+def test_park_on_a_adopt_on_b_bit_exact(tmp_path):
+    """The migration's data move, bare: park on manager A, adopt on
+    manager B from A's out tree — B rehydrates bit-identically to the
+    dense oracle at the parked turn, keeps stepping exactly, A's
+    per-session metric children are evicted at park and B grows fresh
+    ones."""
+    b0 = _world(31, density=0.25)
+    a = SessionManager(out_dir=str(tmp_path / "outA"),
+                       bucket_capacity=4)
+    b = SessionManager(out_dir=str(tmp_path / "outB"),
+                       bucket_capacity=4)
+    a.create("mig1", width=64, height=64, board=b0)
+    a.pump(12, chunk=4)
+    parked = a.park("mig1")
+    assert parked["turn"] == 12
+    assert not any('session="mig1"' in k
+                   for k in obs.registry().snapshot()), (
+        "park must evict A's per-session metric children"
+    )
+    info = b.adopt("mig1", str(tmp_path / "outA"))
+    assert info["turn"] == 12
+    want = np.asarray(life.step_n(b0, 12))
+    np.testing.assert_array_equal(
+        b.fetch_board("mig1"), want,
+        err_msg="adopted session diverges from the oracle at the "
+                "parked turn",
+    )
+    # B's copy is durable LOCALLY (its resume never touches A again)
+    # and keeps stepping on the same trajectory.
+    assert os.path.exists(os.path.join(
+        str(tmp_path / "outB"), "sessions", "mig1", "session.json"))
+    b.pump(8, chunk=4)
+    np.testing.assert_array_equal(
+        b.fetch_board("mig1"), np.asarray(life.step_n(b0, 20)),
+        err_msg="adopted session diverged after resuming stepping",
+    )
+    assert any('session="mig1"' in k
+               for k in obs.registry().snapshot()), (
+        "the adopted session must carry fresh metric children on B"
+    )
+    # Duplicate adopt is a durable rejection; the source staying
+    # parked on A is the controller's rollback state.
+    with pytest.raises(SessionError, match="exists"):
+        b.adopt("mig1", str(tmp_path / "outA"))
+    assert [s["id"] for s in a.list_sessions()] == ["mig1"]
+    a.destroy("mig1")  # the controller's final leg
+    assert a.list_sessions() == []
+    b.destroy("mig1")
+    a.close()
+    b.close()
+
+
+def test_wire_adopt_and_drain_idempotent(tmp_path):
+    """The migration/roll legs over TCP: adopt retried after it
+    landed answers ok (state-based — survives a lost replay window),
+    drain checkpoints residents and bounces session attaches with
+    `draining` while bare control links stay admitted."""
+    from gol_tpu.distributed import SessionControl, SessionServer
+    from gol_tpu.params import Params
+
+    def srv(sub):
+        p = Params(turns=10 ** 9, threads=1, image_width=64,
+                   image_height=64, out_dir=str(tmp_path / sub))
+        return SessionServer(p, port=0, watched_chunk=4,
+                             idle_chunk=8).start()
+
+    sa, sb = srv("outA"), srv("outB")
+    try:
+        ca = SessionControl(*sa.address)
+        cb = SessionControl(*sb.address)
+        ca.create("w1", width=64, height=64, seed=5)
+        ca.park("w1")
+        src = os.path.abspath(str(tmp_path / "outA"))
+        info = cb.adopt("w1", src)
+        assert info["id"] == "w1"
+        # Retried adopt (new rid, effect already in place): ok, same
+        # session, no duplicate.
+        again = cb.adopt("w1", src)
+        assert again["id"] == "w1"
+        assert [s["id"] for s in cb.list()] == ["w1"]
+        # Park on a parked sid converges the same way (crash resume).
+        assert ca.park("w1")["id"] == "w1"
+        # Drain: checkpoints the resident, flips the gate.
+        r = cb.drain()
+        assert r["draining"] and r["checkpointed"] == 1
+        assert cb.drain()["draining"]  # idempotent re-drain
+        s = socket.create_connection(sb.address, timeout=10)
+        s.settimeout(10)
+        wire.send_msg(s, {"t": "hello", "session": "w1",
+                          "want_flips": True, "binary": True})
+        m = wire.recv_msg(s, allow_binary=False)
+        assert m.get("t") == "error" and m.get("reason") == "draining"
+        assert m.get("retry_after") is not None
+        s.close()
+        # Bare control links still admitted on a draining server.
+        c2 = SessionControl(*sb.address)
+        assert [x["id"] for x in c2.list()] == ["w1"]
+        c2.close()
+        ca.close()
+        cb.close()
+    finally:
+        sa.shutdown()
+        sb.shutdown()
+
+
+# --- reconcile loop fault sweep (satellite 6) ----------------------------
+
+
+def _ctl(tmp_path, raw, seed=0):
+    return Controller(FleetSpec(raw), out_dir=str(tmp_path / "ctl"),
+                      seed=seed)
+
+
+def _snap(rows=(), down=()):
+    return {"rows": list(rows), "down": list(down), "tree": [],
+            "usage": None}
+
+
+def _relay_row(endpoint, listen, upstream, peers=0, ws=0, alerts=()):
+    return {"endpoint": endpoint, "up": True, "listen": listen,
+            "upstream": upstream, "relay_peers": peers, "ws_peers": ws,
+            "peers": None, "alerts": list(alerts)}
+
+
+def test_reconcile_heals_dead_relay_and_repoints_orphans(
+        tmp_path, monkeypatch):
+    """A relay missing `down_rounds` consecutive scrapes is healed:
+    a replacement spawns on the dead node's upstream and every
+    orphaned child is re-pointed at it; the dead node's books are
+    retired with it."""
+    ctl = _ctl(tmp_path, {
+        "root": "127.0.0.1:8100",
+        "scrape": ["127.0.0.1:9101", "127.0.0.1:9102"],
+        "actions_per_round": 4,
+    })
+    spawned, repointed = [], []
+    monkeypatch.setattr(
+        Controller, "_spawn_relay",
+        lambda self, up: (spawned.append(up)
+                          or ("127.0.0.1:7009", "127.0.0.1:9109")))
+    import gol_tpu.control.controller as mod
+    monkeypatch.setattr(
+        mod, "repoint_relay",
+        lambda child, new, secret=None, **kw:
+            repointed.append((child, new)))
+    r1 = _relay_row("127.0.0.1:9101", "127.0.0.1:7001",
+                    "127.0.0.1:8100")
+    r2 = _relay_row("127.0.0.1:9102", "127.0.0.1:7002",
+                    "127.0.0.1:7001")
+    now = 1000.0
+    s = ctl.reconcile_once(snapshot=_snap([r1, r2]), now=now)
+    assert s["planned"] == 0 and s["observed"] == 2
+    # Two rounds of silence from r1: heal fires on the second.
+    s = ctl.reconcile_once(
+        snapshot=_snap([r2], down=["127.0.0.1:9101"]), now=now + 2)
+    assert s["planned"] == 0, "one missed scrape must NOT heal yet"
+    s = ctl.reconcile_once(
+        snapshot=_snap([r2], down=["127.0.0.1:9101"]), now=now + 4)
+    assert [a for a in s["applied"]
+            if a["verb"] == "heal" and a["ok"]], s
+    assert spawned == ["127.0.0.1:8100"], (
+        "the replacement must attach where the dead relay hung"
+    )
+    assert repointed == [("127.0.0.1:7002", "127.0.0.1:7009")], (
+        "the orphaned child must be re-pointed at the replacement"
+    )
+    # The dead node's books are gone: no re-heal next round.
+    s = ctl.reconcile_once(
+        snapshot=_snap([r2], down=["127.0.0.1:9101"]), now=now + 6)
+    assert not [a for a in s["applied"] if a["verb"] == "heal"]
+    ctl.shutdown()
+
+
+def test_reconcile_refuses_stale_evidence(tmp_path, monkeypatch):
+    """An alert-driven heal carries evidence (the alerting row's
+    endpoint) and is REFUSED when that endpoint's last answered
+    scrape is older than stale_secs — acting on a stale picture is
+    how controllers kill healthy nodes."""
+    ctl = _ctl(tmp_path, {
+        "root": "127.0.0.1:8100", "stale_secs": 1.0,
+        "heal_alerts": ["relay_turn_age"], "actions_per_round": 4,
+    })
+    healed = []
+    monkeypatch.setattr(Controller, "_heal_relay",
+                        lambda self, s, i, r: healed.append(s))
+    row = _relay_row("127.0.0.1:9101", "127.0.0.1:7001",
+                     "127.0.0.1:8100", alerts=["relay_turn_age"])
+    refusals0 = ctl._metrics.stale_refusals.value
+    ctl._last_ok["127.0.0.1:9101"] = 990.0  # 10s old: stale
+    s = ctl.reconcile_once(snapshot=_snap([row]), now=1000.0)
+    assert s["stale_refused"] == 1 and healed == []
+    assert ctl._metrics.stale_refusals.value == refusals0 + 1
+    # Fresh evidence: the same alert now heals.
+    ctl._last_ok["127.0.0.1:9101"] = 999.5
+    s = ctl.reconcile_once(snapshot=_snap([row]), now=1000.0)
+    assert s["stale_refused"] == 0 and healed == ["127.0.0.1:9101"]
+    ctl.shutdown()
+
+
+def test_reconcile_budget_clips_flapping_alerts_and_backs_off(
+        tmp_path, monkeypatch):
+    """Two relays flap their heal alert with a one-action budget: one
+    heal per round, budget_exhausted counts the clip. A FAILING heal
+    is backed off under seeded jitter — the immediate next round
+    defers that key instead of spawn-storming."""
+    ctl = _ctl(tmp_path, {
+        "root": "127.0.0.1:8100", "stale_secs": 5.0,
+        "heal_alerts": ["relay_turn_age"], "actions_per_round": 1,
+    })
+    healed = []
+    monkeypatch.setattr(Controller, "_heal_relay",
+                        lambda self, s, i, r: healed.append(s))
+    rows = [
+        _relay_row("127.0.0.1:9101", "127.0.0.1:7001",
+                   "127.0.0.1:8100", alerts=["relay_turn_age"]),
+        _relay_row("127.0.0.1:9102", "127.0.0.1:7002",
+                   "127.0.0.1:8100", alerts=["relay_turn_age"]),
+    ]
+    ctl._last_ok["127.0.0.1:9101"] = 1000.0
+    ctl._last_ok["127.0.0.1:9102"] = 1000.0
+    clipped0 = ctl._metrics.budget_exhausted.value
+    s = ctl.reconcile_once(snapshot=_snap(rows), now=1000.0)
+    assert s["planned"] == 2 and len(s["applied"]) == 1
+    assert ctl._metrics.budget_exhausted.value == clipped0 + 1
+    assert len(healed) == 1
+    # Now the heal FAILS: the key enters backoff; the immediate next
+    # round defers it rather than retrying in a tight loop.
+    def boom(self, s, i, r):
+        raise RuntimeError("spawn failed")
+    monkeypatch.setattr(Controller, "_heal_relay", boom)
+    s = ctl.reconcile_once(snapshot=_snap(rows[:1]), now=1000.0)
+    assert s["applied"] and not s["applied"][0]["ok"]
+    key = s["applied"][0]["key"]
+    assert ctl._backoff[key][1] > 1000.0
+    s = ctl.reconcile_once(snapshot=_snap(rows[:1]), now=1000.0)
+    assert s["deferred"] == 1 and s["applied"] == []
+    # Past the backoff window (but inside the evidence's freshness
+    # window) the key is retried — and the attempt counter keeps
+    # growing the delay.
+    s = ctl.reconcile_once(snapshot=_snap(rows[:1]), now=1002.0)
+    assert s["applied"] and not s["applied"][0]["ok"]
+    assert ctl._backoff[key][0] == 2
+    ctl.shutdown()
+
+
+def test_reconcile_scale_is_drain_then_kill(tmp_path, monkeypatch):
+    """Growth follows the observers_per_relay rule; retirement is
+    drain-then-kill: children re-pointed and the victim marked
+    retiring in one round, the SIGTERM only on a LATER round whose
+    fresh scrape observes zero peers — never kill-then-hope."""
+    ctl = _ctl(tmp_path, {
+        "root": "127.0.0.1:8100",
+        "relays": {"min": 0, "max": 8, "observers_per_relay": 2},
+        "actions_per_round": 4, "stale_secs": 5.0,
+    })
+    grown, repointed, killed = [], [], []
+    monkeypatch.setattr(
+        Controller, "_spawn_relay",
+        lambda self, up: (grown.append(up)
+                          or ("127.0.0.1:7008", "127.0.0.1:9108")))
+    import gol_tpu.control.controller as mod
+    monkeypatch.setattr(
+        mod, "repoint_relay",
+        lambda child, new, secret=None, **kw:
+            repointed.append((child, new)))
+    monkeypatch.setattr(Controller, "_terminate",
+                        lambda self, key, pid: killed.append(key))
+    # A root carrying 5 peers wants ceil(5/2)=3 relays; one exists.
+    root = {"endpoint": "127.0.0.1:9100", "up": True,
+            "listen": "127.0.0.1:8100", "upstream": None, "peers": 5,
+            "relay_peers": None, "ws_peers": None, "alerts": []}
+    r1 = _relay_row("127.0.0.1:9101", "127.0.0.1:7001",
+                    "127.0.0.1:8100")
+    s = ctl.reconcile_once(snapshot=_snap([root, r1]), now=1000.0)
+    assert len(grown) == 2 and [a["verb"] for a in s["applied"]] == [
+        "scale", "scale"]
+    # Shrink: the controller only retires relays IT spawned.
+    ctl.manifest.record_spawn("relays", "127.0.0.1:7002",
+                              "127.0.0.1:9102", None)
+    r2 = _relay_row("127.0.0.1:9102", "127.0.0.1:7002",
+                    "127.0.0.1:8100", peers=1)
+    child = _relay_row("127.0.0.1:9103", "127.0.0.1:7003",
+                       "127.0.0.1:7002")
+    quiet_root = dict(root, peers=0)
+    ctl._last_ok.update({"127.0.0.1:9102": 2000.0,
+                         "127.0.0.1:9103": 2000.0})
+    s = ctl.reconcile_once(
+        snapshot=_snap([quiet_root, r2, child]), now=2000.0)
+    retire = [a for a in s["applied"] if a["key"].startswith(
+        "scale:retire")]
+    assert retire and retire[0]["ok"]
+    assert repointed == [("127.0.0.1:7003", "127.0.0.1:8100")], (
+        "the retiree's child must move to its upstream FIRST"
+    )
+    assert killed == [], "retire must NOT kill before an observed drain"
+    assert "127.0.0.1:7002" in ctl._retiring
+    # Next round: the victim is observed drained on a fresh scrape —
+    # NOW the kill lands.
+    drained = dict(r2, relay_peers=0, ws_peers=0)
+    ctl._last_ok["127.0.0.1:9102"] = 2002.0
+    s = ctl.reconcile_once(
+        snapshot=_snap([quiet_root, drained, child]), now=2002.0)
+    assert killed == ["127.0.0.1:7002"]
+    assert "127.0.0.1:7002" not in ctl._retiring
+    ctl.shutdown()
+
+
+def test_reconcile_holds_growth_while_liveness_ambiguous(tmp_path,
+                                                         monkeypatch):
+    """A relay that missed a scrape but is not yet confirmed dead by
+    down_rounds makes `have` ambiguous: the scale rule must NOT grow
+    against that dip (the node either comes back or gets healed into
+    the same slot — growing would double-provision). Once the death
+    is confirmed, heal outranks the now-released grow."""
+    ctl = _ctl(tmp_path, {
+        "root": "127.0.0.1:8100",
+        "relays": {"min": 2, "max": 8},
+        "actions_per_round": 1, "down_rounds": 2, "stale_secs": 5.0,
+    })
+    grown, healed = [], []
+    monkeypatch.setattr(
+        Controller, "_spawn_relay",
+        lambda self, up: (grown.append(up)
+                          or ("127.0.0.1:7008", "127.0.0.1:9108")))
+    monkeypatch.setattr(Controller, "_heal_relay",
+                        lambda self, s, i, r: healed.append(s))
+    r1 = _relay_row("127.0.0.1:9101", "127.0.0.1:7001",
+                    "127.0.0.1:8100")
+    r2 = _relay_row("127.0.0.1:9102", "127.0.0.1:7002",
+                    "127.0.0.1:8100")
+    s = ctl.reconcile_once(snapshot=_snap([r1, r2]), now=1000.0)
+    assert s["planned"] == 0
+    # One missed scrape: neither heal (debouncing) nor grow (held).
+    s = ctl.reconcile_once(
+        snapshot=_snap([r1], down=["127.0.0.1:9102"]), now=1000.5)
+    assert s["planned"] == 0 and grown == []
+    # Confirmed dead: heal planned AND the grow released — but heal
+    # outranks it under the 1-action budget, so the slot is filled by
+    # the replacement, not a second spawn.
+    s = ctl.reconcile_once(
+        snapshot=_snap([r1], down=["127.0.0.1:9102"]), now=1001.0)
+    assert s["planned"] == 2
+    assert [a["verb"] for a in s["applied"]] == ["heal"]
+    assert healed == ["127.0.0.1:9102"] and grown == []
+    ctl.shutdown()
+
+
+def test_migration_controller_crash_resumes_idempotently(tmp_path):
+    """The tentpole's crash matrix entry: a controller killed between
+    the park and adopt legs resumes from the manifest intent — the
+    re-driven legs converge (park answers parked-ok, adopt lands
+    once, destroy retires the source), the record reaches `done`, and
+    exactly ONE copy of the session exists. A pre-crash intent for a
+    vanished session aborts instead of inventing one."""
+    from gol_tpu.distributed import SessionControl, SessionServer
+    from gol_tpu.params import Params
+
+    def srv(sub):
+        p = Params(turns=10 ** 9, threads=1, image_width=64,
+                   image_height=64, out_dir=str(tmp_path / sub))
+        return SessionServer(p, port=0, watched_chunk=4,
+                             idle_chunk=8).start()
+
+    sa, sb = srv("outA"), srv("outB")
+    a_addr = "127.0.0.1:%d" % sa.address[1]
+    b_addr = "127.0.0.1:%d" % sb.address[1]
+    raw = {
+        "root": "127.0.0.1:8100",
+        "engines": [
+            {"addr": a_addr, "out": str(tmp_path / "outA")},
+            {"addr": b_addr, "out": str(tmp_path / "outB")},
+        ],
+        "sessions": {"m1": b_addr},
+        "actions_per_round": 4,
+    }
+    try:
+        ca = SessionControl(*sa.address)
+        ca.create("m1", width=64, height=64, seed=5)
+        # Controller incarnation 1: records intent, drives ONE leg
+        # (park on A), then "dies" — we reload the manifest cold,
+        # exactly what a SIGKILL leaves behind.
+        out = str(tmp_path / "ctl")
+        m1 = ControllerManifest(os.path.join(out, "controller.json"))
+        os.makedirs(out, exist_ok=True)
+        rid = m1.migration_begin("m1", a_addr, b_addr)
+        ca.park("m1")
+        ghost = m1.migration_begin("ghost", a_addr, b_addr)
+        # Incarnation 2: boots on the same out dir, finds both open
+        # intents, re-drives them to done/aborted in one round.
+        c2 = Controller(FleetSpec(raw), out_dir=out, seed=1)
+        assert set(c2.manifest.pending_migrations()) == {rid, ghost}
+        s = c2.reconcile_once(snapshot=_snap(), now=1000.0)
+        migs = [a for a in s["applied"] if a["verb"] == "migrate"]
+        assert len(migs) == 2 and all(a["ok"] for a in migs), s
+        assert c2.manifest.migration(rid)["phase"] == "done"
+        assert c2.manifest.migration(rid)["serving"] == b_addr
+        assert c2.manifest.migration(ghost)["phase"] == "aborted"
+        assert "neither" in c2.manifest.migration(ghost)["reason"]
+        # Exactly one copy, on B; the source is gone.
+        cb = SessionControl(*sb.address)
+        assert [x["id"] for x in cb.list()] == ["m1"]
+        assert ca.list() == []
+        # Level-triggered quiescence: the next round plans nothing —
+        # observed placement already matches the spec.
+        s = c2.reconcile_once(snapshot=_snap(), now=1002.0)
+        assert s["planned"] == 0, s
+        cb.destroy("m1")
+        ca.close()
+        cb.close()
+        c2.shutdown()
+    finally:
+        sa.shutdown()
+        sb.shutdown()
