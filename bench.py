@@ -213,6 +213,55 @@ def measure_ring_rate(side: int, turns: int, latency: float) -> dict:
     return _sustained_rate(s, side, turns, latency)
 
 
+def measure_mesh2d(side: int = 512, turns: int = 4_000,
+                   geoms=("1x4", "2x2", "4x1", "2x4")) -> dict:
+    """The 2-D mesh lane (ISSUE 19): the packed mesh2d backend swept
+    over forced-host-device geometries, recording turns/s and the
+    per-turn halo link traffic `Stepper.halo_cost` prices. Each
+    geometry runs in a FRESH subprocess with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` — the flag
+    only takes effect before jax initializes, and this process has
+    typically claimed the real chip already — so the lane measures the
+    SCALING SHAPE of the mesh program on CPU devices, not absolute
+    device rate (the real-chip rate lives in ring1_*/device_rates).
+
+    The acceptance series is `halo_bytes_per_host`: the per-turn
+    ``rows``-axis bytes ONE mesh row emits, 2·(W + 2·cols)·4 — the
+    board perimeter, which must stay flat (±10%) from 1×4 to 2×4.
+    `bench_compare` gates it LOWER_BETTER; the flatness ratio key
+    avoids the `bytes` token and stays informational."""
+    pp = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    env = {
+        **os.environ,
+        "PYTHONPATH": pp.rstrip(os.pathsep),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    out: dict = {"board": f"{side}x{side}",
+                 "platform": "cpu (forced host devices)"}
+    for g in geoms:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "mesh_capture.py"),
+             "--probe", g, str(side), str(turns)],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd="/tmp",
+        )
+        if proc.returncode != 0:
+            out[f"mesh_{g}"] = {"error": (proc.stderr or proc.stdout)
+                                .strip()[-400:]}
+            continue
+        line = next(l for l in proc.stdout.splitlines()
+                    if l.startswith("{"))
+        out[f"mesh_{g}"] = json.loads(line)
+    a = out.get("mesh_1x4", {}).get("halo_bytes_per_host")
+    b = out.get("mesh_2x4", {}).get("halo_bytes_per_host")
+    if a and b:
+        # Keyed WITHOUT a `bytes` token on purpose: a ratio has no
+        # lower-is-better direction, it is the ±10% acceptance gate.
+        out["halo_flat_ratio_2x4_vs_1x4"] = round(b / a, 3)
+    return out
+
+
 def measure_engine_rate(headline_tps: float) -> dict:
     """The PRODUCT path (VERDICT r1 Weak #2): a full Engine — turn loop,
     commits, ticker, final PGM + FinalTurnComplete — running headless
@@ -1709,6 +1758,13 @@ def main() -> None:
             )
         except Exception as e:
             detail[f"ring1_{side}x{side}"] = {"error": repr(e)}
+    # 2-D mesh scaling shape (ISSUE 19): forced-host-device subprocess
+    # sweep — deliberately NOT bracketed with _lane, the geometries run
+    # in fresh subprocesses so this process's device plane sees nothing.
+    try:
+        detail["mesh_2d_512x512"] = measure_mesh2d()
+    except Exception as e:
+        detail["mesh_2d_512x512"] = {"error": repr(e)}
     # Product-path (Engine) throughput and cold-start liveness — the
     # machine-captured versions of VERDICT r1 Weak #2 and Weak #6.
     try:

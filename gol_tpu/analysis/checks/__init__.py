@@ -7,6 +7,7 @@ from gol_tpu.analysis.checks import (
     dtype_drift,
     host_sync,
     obs_in_jit,
+    partition_spec,
     recompile,
     tracer_branch,
 )
@@ -17,7 +18,8 @@ from gol_tpu.analysis.concurrency import CONCURRENCY_CHECKS
 #: guarded-field) lives in gol_tpu.analysis.concurrency and registers
 #: here like any other check.
 ALL_CHECKS = [host_sync, tracer_branch, recompile, dtype_drift, donation,
-              obs_in_jit, blocking_io] + CONCURRENCY_CHECKS
+              obs_in_jit, blocking_io, partition_spec] + CONCURRENCY_CHECKS
 
 __all__ = ["ALL_CHECKS", "blocking_io", "donation", "dtype_drift",
-           "host_sync", "obs_in_jit", "recompile", "tracer_branch"]
+           "host_sync", "obs_in_jit", "partition_spec", "recompile",
+           "tracer_branch"]

@@ -1,0 +1,77 @@
+"""partition-spec — sharding construction outside the partition table.
+
+ISSUE 19 moved every ``Mesh``/``NamedSharding``/``PartitionSpec``
+construction in the parallel layer into ``parallel/partition.py``: the
+ordered rule table is the ONE place device placement is decided, so an
+operator override (``--partition-rule``) provably reaches every array
+a stepper owns. A backend that quietly builds its own spec re-opens
+the hole this PR closed — its arrays stop being overridable and the
+1-D-ring hard-coding creeps back in.
+
+Flagged, in ``gol_tpu/parallel`` modules other than ``partition.py``:
+
+- any import of ``jax.sharding`` (module or from-names) — backends get
+  specs from the table (``partition.table_for(...).resolve``) or the
+  ``partition.spec``/``partition.named_sharding``/``partition.REPLICATED``
+  constructors;
+- any call spelled ``Mesh(...)``, ``NamedSharding(...)``,
+  ``PartitionSpec(...)`` or dotted equivalents — construction, not the
+  mere type mention (annotations and docstrings stay legal).
+
+Strict from day one: the refactor left zero violations, so the check
+carries no allowlist entries and none may be added for new code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from gol_tpu.analysis.core import Finding, ModuleContext
+
+CHECK = "partition-spec"
+
+_CONSTRUCTORS = {"Mesh", "NamedSharding", "PartitionSpec"}
+
+
+def _in_scope(ctx: ModuleContext) -> bool:
+    return ("parallel/" in ctx.rel
+            and not ctx.rel.endswith("parallel/partition.py"))
+
+
+def run(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _in_scope(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.startswith("jax.sharding"):
+                yield ctx.finding(
+                    CHECK, node,
+                    "import from jax.sharding outside partition.py — "
+                    "resolve specs through partition.table_for / "
+                    "partition.spec so operator overrides reach this "
+                    "array",
+                )
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith("jax.sharding"):
+                    yield ctx.finding(
+                        CHECK, node,
+                        "import of jax.sharding outside partition.py — "
+                        "the partition table is the one sharding "
+                        "constructor in the parallel layer",
+                    )
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            name = None
+            if isinstance(fn, ast.Name):
+                name = fn.id
+            elif isinstance(fn, ast.Attribute):
+                name = fn.attr
+            if name in _CONSTRUCTORS:
+                yield ctx.finding(
+                    CHECK, node,
+                    f"direct {name}(...) construction outside "
+                    "partition.py — build it through the partition "
+                    "table so --partition-rule can override it",
+                )

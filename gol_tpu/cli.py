@@ -58,6 +58,22 @@ def build_parser() -> argparse.ArgumentParser:
                     help="kernel family (default auto: bit-packed SWAR "
                          "when the grid allows, single-device or "
                          "sharded; pallas is single-device only)")
+    ap.add_argument("--mesh", default=None, metavar="ROWSxCOLS",
+                    help="force a 2-D device mesh (e.g. 2x4): the "
+                         "packed board shards over word-rows AND word-"
+                         "columns with mesh-generic halo exchange "
+                         "(parallel/mesh2d.py); per-host halo bytes "
+                         "stay flat as the column count grows. "
+                         "Packed-only; exclusive with --tile")
+    ap.add_argument("--partition-rule", default=None, dest="partition_rule",
+                    metavar="RULES",
+                    help="partition-table overrides, prepended to the "
+                         "backend family's defaults (first match wins): "
+                         "'PATTERN=AXES;...' with AXES a comma list of "
+                         "rows/cols/* or '-' for replicated, plus "
+                         "'layout=NAME' to select a registered kernel "
+                         "layout (e.g. layout=lane-coupled). See "
+                         "docs/PERF.md '2D mesh sharding'")
     ap.add_argument("--chunk", type=int, default=None, metavar="K",
                     help="turns fused per device dispatch when no per-turn "
                          "consumer is attached; 0 auto-calibrates to ~0.1s "
@@ -410,7 +426,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     # A flag mismatch between job processes would build divergent SPMD
     # programs that deadlock at the first collective; fail fast instead.
     multihost.verify_job_config(
-        args.w, args.h, args.t, args.rule, args.backend
+        args.w, args.h, args.t, args.rule, args.backend,
+        args.mesh, args.partition_rule,
     )
 
     if jax.process_count() > 1 and not multihost.is_coordinator():
@@ -419,7 +436,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         from gol_tpu.parallel.stepper import make_stepper
 
         s = make_stepper(threads=args.t, height=args.h, width=args.w,
-                         rule=args.rule, backend=args.backend)
+                         rule=args.rule, backend=args.backend,
+                         mesh=args.mesh,
+                         partition_rules=args.partition_rule)
         multihost.spmd_worker_loop(s, args.h, args.w)
         return 0
 
@@ -501,6 +520,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         autosave_seconds=args.autosave_secs,
         cycle_detect=args.cycle_detect,
         tile=args.tile,
+        mesh=args.mesh,
+        partition_rules=args.partition_rule,
     )
 
     # Checkpoint restart (local or --serve): boot from a snapshot,

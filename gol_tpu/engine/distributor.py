@@ -385,6 +385,8 @@ class Engine:
             rule=params.rule,
             backend=params.backend,
             tile=params.tile,
+            mesh=params.mesh,
+            partition_rules=params.partition_rules,
         )
         self.io = io_service or IOService(params.image_dir, params.out_dir)
         self._own_io = io_service is None
@@ -471,7 +473,7 @@ class Engine:
             CycleDetector(min(cycle_check_seconds, 1.0))
             if params.cycle_detect else None
         )
-        if getattr(self.stepper, "tiled", None) is not None:
+        if self.stepper.offers("tiled"):
             # Activity-driven tiled backend: the whole-board cycle
             # machinery stands down. Per-tile period-riding (the ride
             # cache inside parallel/tiled.py) subsumes it at finer
@@ -667,7 +669,7 @@ class Engine:
             if self._stop_reason is not None:
                 break
             if self.emit_flips:
-                if self.stepper.step_n_with_diffs is not None:
+                if self.stepper.offers("step_n_with_diffs"):
                     if self._ride is not None:
                         new_turn = self._ride_step(turn)
                         if new_turn != turn:
@@ -690,7 +692,7 @@ class Engine:
                         self._maybe_create_ride(turn)
                         if self._ride is not None:
                             continue
-                    if self.stepper.fetch_diffs is None:
+                    if not self.stepper.offers("fetch_diffs"):
                         # Single-device: overlap each chunk's transfer
                         # with the previous chunk's fan-out.
                         turn = self._diff_pipeline_step(turn)
@@ -1076,7 +1078,7 @@ class Engine:
         chunks)."""
         p = self.p
         pipelined = self._pending_diffs is not None or (
-            self.stepper.fetch_diffs is None
+            not self.stepper.offers("fetch_diffs")
         )
         k = min(self._diff_chunk_budget(), self._diff_chunk_cap(pipelined),
                 p.turns - turn)
@@ -1098,7 +1100,7 @@ class Engine:
                    "compact_cap": None, "tick": time.perf_counter()}
         with device.cause("diff-chunk"):
             if (self._sparse_cap is not None
-                    and self.stepper.step_n_with_diffs_compact is not None):
+                    and self.stepper.offers("step_n_with_diffs_compact")):
                 # Variable-length compact chunk (r6): the fetch pays for
                 # headers + actual activity, not the cap — preferred over
                 # fixed-width sparse rows whenever the stepper offers it.
@@ -1173,7 +1175,7 @@ class Engine:
         p = self.p
         budget = DIFF_STACK_BUDGET // (2 if pipelined else 1)
         per_turn = p.image_height * p.image_width
-        if self.stepper.packed_diffs:
+        if self.stepper.offers("packed_diffs"):
             per_turn //= 8
         return max(1, budget // max(per_turn, 1))
 
@@ -1187,7 +1189,7 @@ class Engine:
         optimization, never a requirement)."""
         return (self.emit_flip_chunks and self.emit_flip_batches
                 and self._gens_levels is None
-                and bool(self.stepper.packed_diffs)
+                and self.stepper.offers("packed_diffs")
                 and self.p.image_height % 32 == 0)
 
     def _diff_consume(self, turn: int, pending: dict) -> int:
@@ -1261,7 +1263,7 @@ class Engine:
                 )
 
                 chunk = sparse_chunk_from_dense(np.asarray(host_diffs))
-                if self.stepper.step_n_with_diffs_sparse is not None:
+                if self.stepper.offers("step_n_with_diffs_sparse"):
                     counts_c = chunk[0]
                     self._adapt_sparse_cap(
                         int(counts_c.max()) if counts_c.size else 0
@@ -1491,7 +1493,7 @@ class Engine:
     def _observe_diff_activity(self, rows) -> None:
         """After a plain packed chunk: enable sparse encoding when the
         observed peak changed-word count fits a worthwhile cap."""
-        if self.stepper.step_n_with_diffs_sparse is None:
+        if not self.stepper.offers("step_n_with_diffs_sparse"):
             return
         if not rows or rows[0].dtype != np.uint32:
             return  # dense-mask backends stay on the plain path
@@ -1577,7 +1579,7 @@ class Engine:
         """Alive-cell mask of a fetched (gray-level) world for event
         payloads: nonzero for two-state rules, the stepper's own notion
         for multi-state backends where dying cells are nonzero grays."""
-        if self.stepper.alive_mask is not None:
+        if self.stepper.offers("alive_mask"):
             return self.stepper.alive_mask(host_world)
         return host_world
 

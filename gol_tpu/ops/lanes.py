@@ -1,0 +1,71 @@
+"""Lane-split (width-chunked) packed stepping — the ilp_study probe as
+a library op.
+
+PR 4's ``scripts/ilp_study.py`` proved the lane axis is a legal
+interleave dimension for the packed SWAR step: split the board into k
+width-chunks, ghost-extend each by ONE column from its ring neighbours,
+run the plain toroidal turn on the extended chunk, and slice the
+interior back out — the extended chunk's own lane wrap only corrupts
+the ghost columns, which are discarded (the row-slice interleave
+argument, rotated 90°). The probe lived in the bench script; the
+partition layer now selects it as a named layout
+(``--partition-rule layout=lane-coupled``), so the core moves here
+where backends and tests can reach it. ilp_study keeps its pallas
+VMEM-resident variant and imports the split from this module.
+
+The structural cost is unchanged from the study: a W/k-lane chunk
+becomes W/k + 2 lanes, never a multiple of the 128-lane vreg — so on
+TPU this layout trades alignment for ILP and only wins where the study
+said it does. On CPU it is bit-exact and mesh-free, which is what the
+partition tests lean on.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from gol_tpu.models.rules import Rule
+
+
+def lane_split_turn(chunks, turn_fn):
+    """One bit-exact turn on a width-split board: each lane chunk is
+    ghost-extended by ONE column from its ring-neighbour chunks, the
+    plain toroidal `turn_fn` runs on the extended chunk, and the
+    interior is sliced back out."""
+    k = len(chunks)
+    out = []
+    for j in range(k):
+        ext = jnp.concatenate(
+            [chunks[(j - 1) % k][:, -1:], chunks[j],
+             chunks[(j + 1) % k][:, :1]], axis=1,
+        )
+        out.append(turn_fn(ext)[:, 1:-1])
+    return tuple(out)
+
+
+def make_lane_coupled(rule: Rule, k: int = 2):
+    """``(packed, n) -> packed`` multi-turn kernel stepping the board as
+    k lane-coupled width chunks — the XLA (CPU-testable) member of the
+    lane-coupled layout family; the registered entry the partition
+    table's ``layout=lane-coupled`` override selects."""
+    from gol_tpu.ops import bitlife
+
+    def step_n_raw(p, n):
+        if p.shape[1] % k:
+            raise ValueError(
+                f"lane-coupled layout needs width words divisible by "
+                f"k={k}, got {p.shape[1]}"
+            )
+        c = p.shape[1] // k
+
+        def turn(chunks):
+            return lane_split_turn(
+                chunks, lambda e: bitlife.step_packed(e, rule)
+            )
+
+        chunks = tuple(p[:, j * c:(j + 1) * c] for j in range(k))
+        chunks = lax.fori_loop(0, n, lambda _, ch: turn(ch), chunks)
+        return jnp.concatenate(chunks, axis=1)
+
+    return step_n_raw

@@ -35,12 +35,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gol_tpu.models.rules import GenRule
 from gol_tpu.ops import bitgens, bitlife, generations as gens
 from gol_tpu.ops.bitlife import WORD
 from gol_tpu.ops.life import count_in
+from gol_tpu.parallel import partition
 from gol_tpu.parallel.halo import (
     AXIS,
     cpu_serializing_sync,
@@ -217,9 +217,10 @@ def gens_sharded_stepper(rule: GenRule, devices: list, height: int):
     n = len(devices)
     if height % n != 0:
         return _gens_sharded_stepper_uneven(rule, devices, height)
-    mesh = Mesh(np.asarray(devices), (AXIS,))
-    sharding = NamedSharding(mesh, P(AXIS, None))
-    spec = P(AXIS, None)
+    table = partition.table_for("gens_ring")
+    mesh = partition.ring_mesh(devices)
+    spec = table.resolve("world", ndim=2)
+    sharding = partition.named_sharding(mesh, spec)
     from gol_tpu.parallel.halo import DEEP_ROWS
 
     deep = min(DEEP_ROWS, height // n)
@@ -243,7 +244,8 @@ def gens_sharded_stepper(rule: GenRule, devices: list, height: int):
         blocks, rem_t = divmod(max(k, 0), deep) if deep >= 2 else (0, k)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P())
+            jax.shard_map, mesh=mesh, in_specs=spec,
+            out_specs=(spec, partition.REPLICATED),
         )
         def _many(block):
             block = lax.fori_loop(
@@ -287,9 +289,10 @@ def _gens_sharded_stepper_uneven(rule: GenRule, devices: list, height: int):
     rem = height % n
     real = [strip if i < rem else strip - 1 for i in range(n)]
     offsets = np.concatenate([[0], np.cumsum(real)])
-    mesh = Mesh(np.asarray(devices), (AXIS,))
-    sharding = NamedSharding(mesh, P(AXIS, None))
-    spec = P(AXIS, None)
+    table = partition.table_for("gens_ring")
+    mesh = partition.ring_mesh(devices)
+    spec = table.resolve("world", ndim=2)
+    sharding = partition.named_sharding(mesh, spec)
 
     from gol_tpu.parallel.halo import DEEP_ROWS, balanced_deep_step_n
 
@@ -428,9 +431,10 @@ def packed_gens_sharded_stepper(rule: GenRule, devices: list, height: int,
         raise ValueError(
             f"height {height} not packable into {n} whole-word strips"
         )
-    mesh = Mesh(np.asarray(devices), (AXIS,))
-    sharding = NamedSharding(mesh, P(None, AXIS, None))
-    spec = P(None, AXIS, None)
+    table = partition.table_for("gens_packed_ring")
+    mesh = partition.ring_mesh(devices)
+    spec = table.resolve("planes", ndim=3)
+    sharding = partition.named_sharding(mesh, spec)
     on_tpu = devices[0].platform == "tpu"
     strip_words = (height // n) // WORD
 
@@ -475,7 +479,8 @@ def packed_gens_sharded_stepper(rule: GenRule, devices: list, height: int,
             mid, rem = 0, 0
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P()),
+            jax.shard_map, mesh=mesh, in_specs=spec,
+            out_specs=(spec, partition.REPLICATED),
             # pltpu.roll does not propagate the varying-axis tag (see
             # packed_halo.step_n): vma checking is off when a pallas
             # local path is in the program.
@@ -598,9 +603,10 @@ def packed_gens_sharded_stepper_uneven(rule: GenRule, devices: list,
     rem_words = total_words % n
     floor_words = total_words // n
     offsets = np.concatenate([[0], np.cumsum(real_list)])
-    mesh = Mesh(np.asarray(devices), (AXIS,))
-    sharding = NamedSharding(mesh, P(None, AXIS, None))
-    spec = P(None, AXIS, None)
+    table = partition.table_for("gens_packed_ring")
+    mesh = partition.ring_mesh(devices)
+    spec = table.resolve("planes", ndim=3)
+    sharding = partition.named_sharding(mesh, spec)
     on_tpu = devices[0].platform == "tpu"
 
     def _real():
@@ -664,7 +670,8 @@ def packed_gens_sharded_stepper_uneven(rule: GenRule, devices: list,
             mid, rem_t = 0, 0
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P()),
+            jax.shard_map, mesh=mesh, in_specs=spec,
+            out_specs=(spec, partition.REPLICATED),
             check_vma=mode == "xla",
         )
         def _many(planes):

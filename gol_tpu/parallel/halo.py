@@ -29,12 +29,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gol_tpu.models.rules import Rule
 from gol_tpu.ops.life import apply_rule, from_bits, step_bits, to_bits
+from gol_tpu.parallel import partition
 
-AXIS = "rows"
+AXIS = partition.AXIS_ROWS
 
 #: Deep-halo depth cap for the dense ring: exchange K edge rows once,
 #: step K exact turns locally (validity shrinks one row per turn into
@@ -215,9 +215,10 @@ def sharded_stepper(rule: Rule, devices: list, height: int):
     n = len(devices)
     if height % n != 0:
         return _sharded_stepper_uneven(rule, devices, height)
-    mesh = Mesh(np.asarray(devices), (AXIS,))
-    sharding = NamedSharding(mesh, P(AXIS, None))
-    spec = P(AXIS, None)
+    table = partition.table_for("dense_ring")
+    mesh = partition.ring_mesh(devices)
+    spec = table.resolve("world", ndim=2)
+    sharding = partition.named_sharding(mesh, spec)
 
     deep = min(DEEP_ROWS, height // n)
 
@@ -235,7 +236,8 @@ def sharded_stepper(rule: Rule, devices: list, height: int):
         blocks, rem = divmod(max(k, 0), deep)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P())
+            jax.shard_map, mesh=mesh, in_specs=spec,
+            out_specs=(spec, partition.REPLICATED),
         )
         def _many(block):
             bits = to_bits(block)
@@ -307,7 +309,8 @@ def balanced_deep_step_n(mesh, spec, n: int, strip: int, rem: int,
         blocks, rem_t = divmod(max(k, 0), deep) if deep >= 2 else (0, k)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P())
+            jax.shard_map, mesh=mesh, in_specs=spec,
+            out_specs=(spec, partition.REPLICATED),
         )
         def _many(block):
             idx = lax.axis_index(AXIS)
@@ -346,9 +349,10 @@ def _sharded_stepper_uneven(rule: Rule, devices: list, height: int):
     rem = height % n
     real = [strip if i < rem else strip - 1 for i in range(n)]
     offsets = np.concatenate([[0], np.cumsum(real)])
-    mesh = Mesh(np.asarray(devices), (AXIS,))
-    sharding = NamedSharding(mesh, P(AXIS, None))
-    spec = P(AXIS, None)
+    table = partition.table_for("dense_ring")
+    mesh = partition.ring_mesh(devices)
+    spec = table.resolve("world", ndim=2)
+    sharding = partition.named_sharding(mesh, spec)
     deep = min(DEEP_ROWS, strip - 1)  # every ghost from ONE neighbour
 
     step_n = balanced_deep_step_n(

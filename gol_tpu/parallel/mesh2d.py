@@ -1,0 +1,332 @@
+"""2-D mesh packed stepping — word-row x word-column sharding with
+mesh-axis-generic halo exchange.
+
+The ring backends (packed_halo.py, gens_halo.py) shard the board along
+ONE axis, which caps the shard count at the word-row count and leaves
+the column dimension to a single device's lanes. This module steps the
+same packed SWAR state over an arbitrary ``Mesh(rows, cols)``
+(parallel/partition.py): each device owns an (Hw/rows, W/cols) block of
+the (H/32, W) uint32 board, and one turn exchanges
+
+- COLUMN ghosts first: each block ppermutes its edge word-COLUMN along
+  the ``cols`` axis and concatenates the neighbours' columns on, giving
+  the (HwL, WL+2) extended block;
+- then ROW ghosts: the extended block's edge word-ROWS ppermute along
+  the ``rows`` axis. Because the extension already carries the column
+  ghosts, the exchanged word-rows include the CORNER words — the
+  diagonal neighbours arrive in two hops with no corner collective.
+
+The row ghosts feed the cross-word vertical carries exactly as in the
+1-D ring; the extended block then steps with the PLAIN toroidal
+combine (``bitlife.combine_packed``) and the interior is sliced back
+out — the block's own lane wrap only corrupts the ghost columns, which
+are discarded (the ``ops/lanes.py`` lane-split argument, applied per
+shard). When a mesh axis has size 1 its ppermute is the identity ring
+and the ghost IS the toroidal wrap, so ``1xN`` and ``Nx1`` meshes
+collapse to today's column/row rings bit-exactly.
+
+Per-turn exchange only — no deep blocks: a 2-D deep halo needs a
+(h, WL+2h) frame whose corner validity shrinks diagonally, and the
+mesh's reason to exist is boards past one device's HBM, where the
+watched (per-turn diff) path dominates anyway. Deep 2-D blocks are the
+obvious follow-up once a real pod profile shows the exchange bound.
+
+Per-host diff aggregation: the sparse/compact diff outputs are pinned
+fully replicated (packed_halo.replicate_rows / replicate_compact), so
+one host materializes ONE buffer per chunk no matter how many devices
+the mesh has — link bytes scale with board activity, not mesh size.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gol_tpu.models.rules import GenRule, Rule
+from gol_tpu.ops import bitgens, bitlife, generations as gens, rulecomp
+from gol_tpu.ops.bitlife import WORD
+from gol_tpu.parallel import partition
+from gol_tpu.parallel.halo import cpu_serializing_sync, ring_perms
+from gol_tpu.parallel.packed_halo import replicate_compact, replicate_rows
+from gol_tpu.parallel.partition import AXIS_COLS, AXIS_ROWS
+
+
+def packable_mesh2d(height: int, width: int, rows: int, cols: int) -> bool:
+    """True when the (H/32, W) word grid splits into whole
+    (Hw/rows, W/cols) blocks — every shard owns at least one whole
+    word-row and one word-column."""
+    if height % WORD:
+        return False
+    hw = height // WORD
+    return (hw % rows == 0 and hw >= rows
+            and width % cols == 0 and width >= cols)
+
+
+def _extend(p, rows_n: int, cols_n: int):
+    """Ghost-extend one local block inside shard_map: returns the
+    column-extended (HwL, WL+2) block plus the corner-complete
+    above/below ghost word-rows from the ``rows`` ring."""
+    down_c, up_c = ring_perms(cols_n)
+    left = lax.ppermute(p[:, -1:], AXIS_COLS, down_c)
+    right = lax.ppermute(p[:, :1], AXIS_COLS, up_c)
+    ext = jnp.concatenate([left, p, right], axis=1)
+    down_r, up_r = ring_perms(rows_n)
+    above = lax.ppermute(ext[-1:], AXIS_ROWS, down_r)
+    below = lax.ppermute(ext[:1], AXIS_ROWS, up_r)
+    return ext, above, below
+
+
+def _carries(ext, above, below):
+    """The two vertically-shifted bitboards of the extended block, with
+    cross-word carries sourced from the row ghosts (halo_step_packed's
+    carry construction on the column-extended block)."""
+    carry_up = jnp.concatenate([above, ext[:-1]], axis=0)
+    up = (ext << jnp.uint32(1)) | (carry_up >> jnp.uint32(WORD - 1))
+    carry_down = jnp.concatenate([ext[1:], below], axis=0)
+    down = (ext >> jnp.uint32(1)) | (carry_down << jnp.uint32(WORD - 1))
+    return up, down
+
+
+def mesh_halo_step_packed(p, rule: Rule, rows_n: int, cols_n: int):
+    """One packed Life turn on a local (HwL, WL) block of a 2-D mesh."""
+    ext, above, below = _extend(p, rows_n, cols_n)
+    up, down = _carries(ext, above, below)
+    return bitlife.combine_packed(ext, up, down, rule)[:, 1:-1]
+
+
+def mesh_halo_step_packed_gens(planes, rule: GenRule, rows_n: int,
+                               cols_n: int):
+    """One packed Generations turn on local (C-1, HwL, WL) plane
+    blocks. Only the ALIVE plane rides the mesh (neighbour counts need
+    alive cells only); the survive/birth masks come from the extended
+    plane and are sliced to the interior before the plane algebra."""
+    alive = planes[0]
+    ext, above, below = _extend(alive, rows_n, cols_n)
+    up, down = _carries(ext, above, below)
+    plan = rulecomp.compile_rule(bitgens._life_view(rule))
+    survive, birth = (
+        bitlife.resolve_mask(m, ext)[:, 1:-1]
+        for m in bitlife.rule_masks(ext, up, down, plan)
+    )
+    dead = ~alive
+    for i in range(1, planes.shape[0]):
+        dead = dead & ~planes[i]
+    new_alive = (alive & survive) | (dead & birth)
+    if rule.states == 2:
+        return new_alive[None]
+    return jnp.concatenate(
+        [new_alive[None], (alive & ~survive)[None], planes[1:-1]], axis=0
+    )
+
+
+def mesh2d_halo_cost(rows: int, cols: int, hw: int, width: int):
+    """Host-side traffic accounting for a rows x cols mesh stepping a
+    (hw, width) word board per-turn — the `Stepper.halo_cost` hook.
+
+    Every turn each device sends 2 ghost word-columns (HwL words each,
+    ``cols`` axis) and 2 ghost word-rows (WL+2 words each, ``rows``
+    axis). `bytes_per_host` prices the ``rows``-axis traffic ONE mesh
+    row emits — the inter-host link budget when each mesh row maps to
+    a host, which is 2·(W + 2·cols)·4 bytes/turn: the board PERIMETER,
+    flat in the device count (the bench lane's ±10% gate rides this)."""
+    col_words = 2 * (hw // rows)          # per device, cols axis
+    row_words = 2 * (width // cols + 2)   # per device, rows axis
+
+    def halo_cost(world, k, per_turn: bool = False) -> dict:
+        del world, per_turn  # always per-turn (module docstring)
+        k = max(int(k), 0)
+        return {
+            "exchanges": 4 * rows * cols * k,
+            "bytes": (col_words + row_words) * 4 * rows * cols * k,
+            "bytes_per_host": row_words * 4 * cols * k,
+        }
+
+    return halo_cost
+
+
+def mesh2d_packed_stepper(rule: Rule, devices: list, height: int,
+                          width: int, rows: int, cols: int,
+                          rules: str | None = None):
+    """Packed Life over a rows x cols device mesh: (H/32, W) uint32
+    board, blocks resolved by the partition table, per-turn two-axis
+    ghost exchange (module docstring). The full diff surface (dense /
+    sparse / compact scans) rides the same per-turn step with
+    replicated outputs."""
+    from gol_tpu.parallel.stepper import (
+        Stepper,
+        compact_scan_diffs,
+        scan_diffs,
+        sparse_scan_diffs,
+    )
+
+    n = len(devices)
+    if not packable_mesh2d(height, width, rows, cols):
+        raise ValueError(
+            f"grid {height}x{width} not packable over a {rows}x{cols} "
+            f"mesh (needs whole word-rows per mesh row and whole "
+            f"columns per mesh column)"
+        )
+    table = partition.table_for("packed_mesh2d", rules)
+    mesh = partition.mesh2d(devices, rows, cols)
+    wspec = table.resolve("world", ndim=2)
+    sharding = table.sharding(mesh, "world", ndim=2)
+
+    def _turn(block):
+        return mesh_halo_step_packed(block, rule, rows, cols)
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def step_n(p, k):
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=wspec,
+            out_specs=(wspec, partition.REPLICATED),
+        )
+        def _many(block):
+            block = lax.fori_loop(
+                0, max(k, 0), lambda _, q: _turn(q), block
+            )
+            count = lax.psum(
+                bitlife.count_packed(block), (AXIS_ROWS, AXIS_COLS)
+            )
+            return block, count
+
+        return _many(p)
+
+    @jax.jit
+    def step(p):
+        return step_n(p, 1)[0]
+
+    @jax.jit
+    def step_with_diff(p):
+        new, count = step_n(p, 1)
+        mask = bitlife.unpack(p ^ new, height) != 0
+        return new, mask, count
+
+    @jax.jit
+    def count(p):
+        return bitlife.count_packed(p)
+
+    from gol_tpu.parallel.multihost import spmd_fetch, spmd_put
+
+    def put(w):
+        return spmd_put(sharding, bitlife.pack_np(w))
+
+    def fetch(arr):
+        if getattr(arr, "dtype", None) == jnp.uint32:
+            return bitlife.unpack_np(spmd_fetch(arr), height)
+        return spmd_fetch(arr)
+
+    # The per-turn step as a global-array fn for the diff scans — the
+    # scan runs under plain jit, XLA keeping the stack sharded.
+    _one_turn = jax.shard_map(
+        _turn, mesh=mesh, in_specs=wspec, out_specs=wspec
+    )
+
+    _snd = scan_diffs(_one_turn, lambda old, new: old ^ new, count)
+    _snd_sparse = sparse_scan_diffs(
+        _one_turn, lambda old, new: old ^ new, count,
+        post=replicate_rows(mesh),
+    )
+    _snd_compact = compact_scan_diffs(
+        _one_turn, lambda old, new: old ^ new, count,
+        post=replicate_compact(mesh),
+    )
+    _sync = cpu_serializing_sync(devices)
+
+    return Stepper(
+        name=f"packed-mesh2d-{rows}x{cols}",
+        shards=n,
+        put=put,
+        fetch=fetch,
+        step=lambda p: _sync(step(p)),
+        step_n=lambda p, k: _sync(step_n(p, int(k))),
+        step_with_diff=lambda p: _sync(step_with_diff(p)),
+        alive_count_async=lambda p: _sync(count(p)),
+        step_n_with_diffs=lambda p, k: _sync(_snd(p, int(k))),
+        fetch_diffs=spmd_fetch,
+        packed_diffs=True,
+        step_n_with_diffs_sparse=lambda p, k, cap: _sync(
+            _snd_sparse(p, int(k), int(cap))
+        ),
+        step_n_with_diffs_compact=lambda p, k, cap: _sync(
+            _snd_compact(p, int(k), int(cap))
+        ),
+        halo_cost=mesh2d_halo_cost(rows, cols, height // WORD, width),
+    )
+
+
+def mesh2d_packed_gens_stepper(rule: GenRule, devices: list, height: int,
+                               width: int, rows: int, cols: int,
+                               rules: str | None = None):
+    """Packed Generations over a rows x cols mesh: (C-1, H/32, W)
+    one-hot planes, plane axis unsharded, word blocks as the Life
+    variant. Assembly (diff surface, alive-only count, alive_mask)
+    rides gens_halo's shared builder."""
+    import dataclasses
+
+    from gol_tpu.parallel.gens_halo import _gens_ring_stepper
+
+    n = len(devices)
+    if not packable_mesh2d(height, width, rows, cols):
+        raise ValueError(
+            f"grid {height}x{width} not packable over a {rows}x{cols} "
+            f"mesh (needs whole word-rows per mesh row and whole "
+            f"columns per mesh column)"
+        )
+    table = partition.table_for("gens_mesh2d", rules)
+    mesh = partition.mesh2d(devices, rows, cols)
+    pspec = table.resolve("planes", ndim=3)
+    sharding = table.sharding(mesh, "planes", ndim=3)
+
+    def _turn(planes):
+        return mesh_halo_step_packed_gens(planes, rule, rows, cols)
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def step_n(p, k):
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=pspec,
+            out_specs=(pspec, partition.REPLICATED),
+        )
+        def _many(planes):
+            planes = lax.fori_loop(
+                0, max(k, 0), lambda _, q: _turn(q), planes
+            )
+            count = lax.psum(
+                bitlife.count_packed(planes[0]), (AXIS_ROWS, AXIS_COLS)
+            )
+            return planes, count
+
+        return _many(p)
+
+    from gol_tpu.parallel.multihost import spmd_fetch, spmd_put
+
+    def put(levels_world):
+        return spmd_put(
+            sharding,
+            bitgens.pack_states(
+                gens.states_from_levels(levels_world, rule), rule
+            ),
+        )
+
+    def fetch(arr):
+        if getattr(arr, "dtype", None) == jnp.uint32:
+            return gens.levels_from_states(
+                bitgens.unpack_states(spmd_fetch(arr), height, rule), rule
+            )
+        return spmd_fetch(arr)
+
+    _one_turn = jax.shard_map(
+        _turn, mesh=mesh, in_specs=pspec, out_specs=pspec
+    )
+
+    s = _gens_ring_stepper(
+        f"gens-packed-mesh2d-{rows}x{cols}", devices, step_n, put, fetch,
+        fetch_diffs=spmd_fetch, one_turn=_one_turn, packed_diffs=True,
+        sparse_post=replicate_rows(mesh),
+        compact_post=replicate_compact(mesh),
+    )
+    return dataclasses.replace(
+        s, halo_cost=mesh2d_halo_cost(rows, cols, height // WORD, width)
+    )

@@ -27,9 +27,9 @@ from typing import Optional
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
 
-from gol_tpu.parallel.halo import AXIS
+from gol_tpu.parallel import partition
+from gol_tpu.parallel.stepper import ENTRY_TABLE
 
 
 def initialize(
@@ -103,11 +103,11 @@ def spmd_fetch(arr) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
-def global_ring_mesh() -> Mesh:
+def global_ring_mesh():
     """1-D mesh over every device in the job, ordered so ring neighbours
     are physically adjacent where possible (jax.devices() enumerates
     devices grouped by process, which keeps intra-host hops on ICI)."""
-    return Mesh(np.asarray(jax.devices()), (AXIS,))
+    return partition.ring_mesh(jax.devices())
 
 
 def device_count() -> int:
@@ -127,12 +127,17 @@ def device_count() -> int:
 # "broker ⇄ workers" topology implies (ref: README.md:157-233), done
 # the JAX way: the data plane is the jitted program itself; the command
 # channel only carries opcodes.
+#
+# Opcode numbers come straight off the Stepper capability table
+# (stepper.ENTRY_TABLE — EntryInfo.opcode is declared STABLE there):
+# the table IS the wire protocol, and the mirror below is derived from
+# it instead of hand-maintaining per-opcode shims. The only opcodes no
+# Stepper entry owns are the world/mask fetch pair (`fetch`
+# disambiguates by dtype, so it needs two) and STOP.
 
-_OP_PUT, _OP_STEP, _OP_STEP_N, _OP_DIFF, _OP_COUNT = 0, 1, 2, 3, 4
+_OPS = {e.name: e.opcode for e in ENTRY_TABLE if e.opcode is not None}
 _OP_FETCH_WORLD, _OP_FETCH_MASK, _OP_STOP = 5, 6, 7
-_OP_STEP_N_DIFFS, _OP_FETCH_DIFFS = 8, 9
-_OP_STEP_N_DIFFS_SPARSE, _OP_STEP_N_DIFFS_REDO = 10, 11
-_OP_STEP_N_DIFFS_COMPACT = 12
+assert not {_OP_FETCH_WORLD, _OP_FETCH_MASK, _OP_STOP} & set(_OPS.values())
 
 
 def _bcast(value: np.ndarray) -> np.ndarray:
@@ -200,8 +205,11 @@ def verify_job_config(*fields) -> None:
 
 def spmd_stepper(inner):
     """Coordinator-side wrapper: a Stepper whose every dispatch first
-    broadcasts (opcode, arg) so workers running `spmd_worker_loop` on
-    the same inner stepper co-execute it in lockstep.
+    broadcasts (opcode, args) so workers running `spmd_worker_loop` on
+    the same inner stepper co-execute it in lockstep. The mirror is
+    DERIVED from ENTRY_TABLE — each entry's opcode/args/token
+    declaration builds its wrapper, so a new Stepper entry mirrors by
+    declaring itself in the table instead of growing another shim here.
 
     Contract (which the engine satisfies): dispatches are linear in the
     current world — each step consumes the array the previous one
@@ -210,48 +218,13 @@ def spmd_stepper(inner):
     bool)."""
     from gol_tpu.parallel.stepper import Stepper
 
-    def put(world):
-        _bcast_cmd(_OP_PUT)
-        host = _bcast(np.asarray(world, np.uint8))
-        _sparse_consumed()  # a fresh world abandons any outstanding redo
-        return inner.put(host)
-
-    def step(world):
-        _bcast_cmd(_OP_STEP)
-        # A fused dispatch consumes the current world, sparse-produced
-        # or not: the outstanding record is spent (a detach switches
-        # the engine to this path mid-run; keeping the token would
-        # false-flag the first diffs dispatch after reattach).
-        _sparse_consumed()
-        return inner.step(world)
-
-    def step_n(world, k):
-        _bcast_cmd(_OP_STEP_N, int(k))
-        _sparse_consumed()
-        return inner.step_n(world, int(k))
-
-    def step_with_diff(world):
-        _bcast_cmd(_OP_DIFF)
-        return inner.step_with_diff(world)
-
-    def alive_count_async(world):
-        _bcast_cmd(_OP_COUNT)
-        return inner.alive_count_async(world)
-
-    def fetch(arr):
-        if getattr(arr, "dtype", None) == np.bool_:
-            _bcast_cmd(_OP_FETCH_MASK)
-        else:
-            _bcast_cmd(_OP_FETCH_WORLD)
-        return inner.fetch(arr)
-
     # The one legal NON-linear dispatch: after a sparse-overflow, the
     # engine redoes the chunk densely FROM THE SPARSE CALL'S INPUT —
     # through the EXPLICIT `step_n_with_diffs_redo` entry (the engine
     # prefers it whenever a stepper offers one). Workers replay against
     # their own state refs, so the redo is its own opcode telling them
     # to step from the state they saved before the sparse dispatch —
-    # replaying it as a plain _OP_STEP_N_DIFFS would mix coordinator
+    # replaying it as a plain dense opcode would mix coordinator
     # pre-chunk state with worker post-chunk state and silently diverge
     # the ring. `_sparse_in` tracks the outstanding sparse dispatch's
     # (input, output) pair: the redo asserts it re-steps the exact
@@ -260,37 +233,34 @@ def spmd_stepper(inner):
     # (ADVICE r5 #2 — identity inference replaced by a checked token).
     # Entries are cleared as soon as the sparse dispatch is consumed,
     # which also stops the dict pinning the pre-sparse device buffer.
+    # The roles below are keyed by EntryInfo.token ("reset" / "dense" /
+    # "sparse" / "redo" — see stepper.EntryInfo).
     _sparse_in = {"in": None, "out": None}
 
     def _sparse_consumed():
         _sparse_in["in"] = _sparse_in["out"] = None
 
-    step_n_with_diffs = None
-    if inner.step_n_with_diffs is not None:
-        def step_n_with_diffs(world, k):
-            if _sparse_in["in"] is not None:
-                if world is _sparse_in["in"]:
-                    raise RuntimeError(
-                        "sparse-overflow redo routed through the plain "
-                        "dense entry — the engine must call "
-                        "step_n_with_diffs_redo so workers replay from "
-                        "their saved pre-sparse state"
-                    )
-                if world is not _sparse_in["out"]:
-                    raise RuntimeError(
-                        "dense diffs dispatch on an unrecognized world "
-                        "while a sparse dispatch is outstanding — "
-                        "broadcasting it would silently diverge the "
-                        "ring (workers would step from post-sparse "
-                        "state, the coordinator from something else)"
-                    )
-                _sparse_consumed()
-            _bcast_cmd(_OP_STEP_N_DIFFS, int(k))
-            return inner.step_n_with_diffs(world, int(k))
-
-    step_n_with_diffs_redo = None
-    if inner.step_n_with_diffs is not None:
-        def step_n_with_diffs_redo(world, k):
+    def _guard(entry, world):
+        """Token-discipline check for `entry`, run BEFORE its opcode
+        broadcast so a bad dispatch raises without diverging the ring."""
+        if entry.token == "dense" and _sparse_in["in"] is not None:
+            if world is _sparse_in["in"]:
+                raise RuntimeError(
+                    "sparse-overflow redo routed through the plain "
+                    "dense entry — the engine must call "
+                    "step_n_with_diffs_redo so workers replay from "
+                    "their saved pre-sparse state"
+                )
+            if world is not _sparse_in["out"]:
+                raise RuntimeError(
+                    "dense diffs dispatch on an unrecognized world "
+                    "while a sparse dispatch is outstanding — "
+                    "broadcasting it would silently diverge the "
+                    "ring (workers would step from post-sparse "
+                    "state, the coordinator from something else)"
+                )
+            _sparse_consumed()
+        elif entry.token == "redo":
             if _sparse_in["in"] is None:
                 raise RuntimeError(
                     "sparse-overflow redo with no sparse dispatch "
@@ -302,161 +272,195 @@ def spmd_stepper(inner):
                     "dispatch's exact input world"
                 )
             _sparse_consumed()
-            _bcast_cmd(_OP_STEP_N_DIFFS_REDO, int(k))
-            inner_redo = inner.step_n_with_diffs_redo or inner.step_n_with_diffs
-            return inner_redo(world, int(k))
-
-    step_n_with_diffs_sparse = None
-    if inner.step_n_with_diffs_sparse is not None:
-        def step_n_with_diffs_sparse(world, k, cap):
-            if _sparse_in["in"] is not None \
-                    and world is not _sparse_in["out"]:
-                raise RuntimeError(
-                    "sparse diffs dispatch on an unrecognized world "
-                    "while another sparse dispatch is outstanding"
-                )
-            # Both static arguments ride the opcode so every process
-            # compiles the identical sparse scan (a cap mismatch would
-            # be a divergent program and a silent deadlock).
-            _bcast_cmd(_OP_STEP_N_DIFFS_SPARSE, int(k), int(cap))
-            out = inner.step_n_with_diffs_sparse(world, int(k), int(cap))
-            _sparse_in["in"], _sparse_in["out"] = world, out[0]
-            return out
-
-    step_n_with_diffs_compact = None
-    if inner.step_n_with_diffs_compact is not None:
-        def step_n_with_diffs_compact(world, k, total_cap):
-            # Same outstanding-token discipline as the sparse entry:
-            # an overflowing compact chunk is redone through the SAME
-            # dedicated redo opcode, so the records share one slot.
-            if _sparse_in["in"] is not None \
-                    and world is not _sparse_in["out"]:
+        elif entry.token == "sparse" and _sparse_in["in"] is not None \
+                and world is not _sparse_in["out"]:
+            if entry.name == "step_n_with_diffs_compact":
                 raise RuntimeError(
                     "compact diffs dispatch on an unrecognized world "
                     "while a sparse/compact dispatch is outstanding"
                 )
-            _bcast_cmd(_OP_STEP_N_DIFFS_COMPACT, int(k), int(total_cap))
-            out = inner.step_n_with_diffs_compact(
-                world, int(k), int(total_cap)
+            raise RuntimeError(
+                "sparse diffs dispatch on an unrecognized world "
+                "while another sparse dispatch is outstanding"
             )
-            _sparse_in["in"], _sparse_in["out"] = world, out[0]
+
+    def _mirror(entry, fn):
+        """The generic mirrored entry: guard, broadcast the opcode with
+        the entry's int arguments (ALL static arguments ride the
+        opcode so every process compiles the identical program — a
+        chunk/cap mismatch would be a divergent program and a silent
+        deadlock), dispatch, and keep the token record current."""
+        def call(world, *args):
+            args = tuple(int(a) for a in args)
+            _guard(entry, world)
+            _bcast_cmd(entry.opcode, *args)
+            if entry.token == "reset":
+                # A fused dispatch consumes the current world, sparse-
+                # produced or not: the outstanding record is spent (a
+                # detach switches the engine to this path mid-run;
+                # keeping the token would false-flag the first diffs
+                # dispatch after reattach).
+                _sparse_consumed()
+            out = fn(world, *args)
+            if entry.token == "sparse":
+                _sparse_in["in"], _sparse_in["out"] = world, out[0]
             return out
 
-    fetch_diffs = None
-    if inner.step_n_with_diffs is not None:
-        def fetch_diffs(diffs):
-            # The diff stack is told apart from worlds/masks by its own
-            # opcode: workers keep the latest stack and gather theirs.
-            _bcast_cmd(_OP_FETCH_DIFFS)
-            inner_fd = inner.fetch_diffs or np.asarray
-            return inner_fd(diffs)
+        return call
 
-    return Stepper(
-        name=f"spmd-{inner.name}",
-        shards=inner.shards,
-        put=put,
-        fetch=fetch,
-        step=step,
-        step_n=step_n,
-        step_with_diff=step_with_diff,
-        alive_count_async=alive_count_async,
-        # Host-side level translation, no dispatch — passes through
-        # unmirrored (the generations family's alive-vs-dying split).
-        alive_mask=inner.alive_mask,
-        step_n_with_diffs=step_n_with_diffs,
-        step_n_with_diffs_redo=step_n_with_diffs_redo,
-        fetch_diffs=fetch_diffs,
-        packed_diffs=inner.packed_diffs,
-        step_n_with_diffs_sparse=step_n_with_diffs_sparse,
-        step_n_with_diffs_compact=step_n_with_diffs_compact,
-        # The compact value buffer is replicated over a mesh that spans
-        # processes: a coordinator-only device slice of it would not be
-        # addressable, so the mirror materializes the whole buffer with
-        # a plain np.asarray (no opcode, no collective — replicated
-        # arrays are locally readable on every process) and lets the
-        # host take the prefix.
-        fetch_compact_values=(
-            None if inner.step_n_with_diffs_compact is None
-            else lambda values, total: np.ascontiguousarray(
-                np.asarray(values)
-            ).view(np.uint32)
-        ),
-        # Host-side traffic arithmetic, no dispatch — the mirrored ring
-        # runs the same block plan, so the inner accounting holds.
-        halo_cost=inner.halo_cost,
-    )
+    def put(world):
+        _bcast_cmd(_OPS["put"])
+        host = _bcast(np.asarray(world, np.uint8))
+        _sparse_consumed()  # a fresh world abandons any outstanding redo
+        return inner.put(host)
+
+    def fetch(arr):
+        if getattr(arr, "dtype", None) == np.bool_:
+            _bcast_cmd(_OP_FETCH_MASK)
+        else:
+            _bcast_cmd(_OP_FETCH_WORLD)
+        return inner.fetch(arr)
+
+    def fetch_diffs(diffs):
+        # The diff stack is told apart from worlds/masks by its own
+        # opcode: workers keep the latest stack and gather theirs.
+        _bcast_cmd(_OPS["fetch_diffs"])
+        return (inner.fetch_diffs or np.asarray)(diffs)
+
+    fields: dict = {}
+    for e in ENTRY_TABLE:
+        val = getattr(inner, e.name)
+        if e.name == "put":
+            fields[e.name] = put
+        elif e.name == "fetch":
+            fields[e.name] = fetch
+        elif e.name == "fetch_diffs":
+            if inner.step_n_with_diffs is not None:
+                fields[e.name] = fetch_diffs
+        elif e.name == "step_n_with_diffs_redo":
+            # Mirrored whenever the dense entry is: workers replay the
+            # redo from their saved pre-sparse state either way, so the
+            # coordinator falls back to the dense inner entry when no
+            # dedicated redo exists.
+            if inner.step_n_with_diffs is not None:
+                fields[e.name] = _mirror(e, val or inner.step_n_with_diffs)
+        elif e.name == "fetch_compact_values":
+            # The compact value buffer is replicated over a mesh that
+            # spans processes: a coordinator-only device slice of it
+            # would not be addressable, so the mirror materializes the
+            # whole buffer with a plain np.asarray (no opcode, no
+            # collective — replicated arrays are locally readable on
+            # every process) and lets the host take the prefix.
+            if inner.step_n_with_diffs_compact is not None:
+                fields[e.name] = lambda values, total: np.ascontiguousarray(
+                    np.asarray(values)
+                ).view(np.uint32)
+        elif e.kind == "meta":
+            # Host-side metadata (alive_mask level translation, the
+            # halo-cost arithmetic — the mirrored ring runs the same
+            # block plan, so the inner accounting holds) passes through
+            # unmirrored.
+            fields[e.name] = val
+        elif val is not None:
+            fields[e.name] = _mirror(e, val)
+
+    return Stepper(name=f"spmd-{inner.name}", shards=inner.shards, **fields)
 
 
 def spmd_worker_loop(inner, height: int, width: int) -> None:
     """Run on every non-coordinator process: replay the coordinator's
     dispatch sequence against the same global arrays until _OP_STOP (or
-    the coordinator exits, which tears down the distributed client)."""
-    state = None
-    mask = None
-    diffs = None
-    pre_sparse = None
+    the coordinator exits, which tears down the distributed client).
+    The opcode -> handler map is derived from ENTRY_TABLE's `replay`
+    declarations; only the world/mask fetch pair and STOP are wired by
+    hand (they are the mirror's own opcodes, not Stepper entries)."""
+    st = {"state": None, "mask": None, "diffs": None, "pre": None}
+
+    def _put(arg, arg2):
+        host = _bcast(np.zeros((height, width), np.uint8))
+        st["state"] = inner.put(host)
+        st["pre"] = None
+
+    def _step(arg, arg2):
+        st["state"] = inner.step(st["state"])
+        st["pre"] = None  # mirror the coordinator: token spent
+
+    def _step_n(arg, arg2):
+        st["state"], _ = inner.step_n(st["state"], arg)
+        st["pre"] = None
+
+    def _diff(arg, arg2):
+        st["state"], st["mask"], _ = inner.step_with_diff(st["state"])
+
+    def _dense(arg, arg2):
+        st["state"], st["diffs"], _ = inner.step_n_with_diffs(
+            st["state"], arg
+        )
+        # A dense dispatch means the outstanding sparse chunk (if any)
+        # was consumed fine — drop the saved pre-sparse state so it
+        # stops pinning a whole board on device.
+        st["pre"] = None
+
+    def _sparse(arg, arg2):
+        # The sparse rows are replicated; the coordinator reads its
+        # local copy, workers just co-execute the scan. The rows go to
+        # a throwaway — NOT `diffs` — so a later fetch_diffs opcode
+        # still gathers the dense stack the coordinator holds. The
+        # pre-sparse state is kept for a possible overflow redo.
+        st["pre"] = st["state"]
+        st["state"], _rows, _ = inner.step_n_with_diffs_sparse(
+            st["state"], arg, arg2
+        )
+
+    def _compact(arg, arg2):
+        # Compact chunks mirror exactly like sparse rows: headers and
+        # the value buffer are replicated (the coordinator reads its
+        # local copies, no further opcode), and the pre-dispatch state
+        # is kept for a possible overflow redo.
+        st["pre"] = st["state"]
+        st["state"], _hdr, _vals, _ = inner.step_n_with_diffs_compact(
+            st["state"], arg, arg2
+        )
+
+    def _redo(arg, arg2):
+        # Sparse-overflow redo: the coordinator broadcast the DEDICATED
+        # redo opcode (never inferred from identity), so step from the
+        # state saved before the sparse dispatch — then drop the save
+        # (one redo per sparse, by contract).
+        if st["pre"] is None:
+            raise RuntimeError(
+                "sparse-overflow redo opcode with no sparse "
+                "dispatch outstanding — coordinator/worker "
+                "dispatch streams have diverged"
+            )
+        st["state"], st["diffs"], _ = inner.step_n_with_diffs(
+            st["pre"], arg
+        )
+        st["pre"] = None
+
+    def _count(arg, arg2):
+        inner.alive_count_async(st["state"])
+
+    def _fetch_diffs(arg, arg2):
+        (inner.fetch_diffs or np.asarray)(st["diffs"])
+
+    replays = {
+        "put": _put, "step": _step, "step_n": _step_n, "diff": _diff,
+        "count": _count, "dense": _dense, "sparse": _sparse,
+        "compact": _compact, "redo": _redo, "fetch_diffs": _fetch_diffs,
+    }
+    handlers = {
+        e.opcode: replays[e.replay]
+        for e in ENTRY_TABLE
+        if e.opcode is not None and e.replay in replays
+    }
+    handlers[_OP_FETCH_WORLD] = lambda arg, arg2: inner.fetch(st["state"])
+    handlers[_OP_FETCH_MASK] = lambda arg, arg2: inner.fetch(st["mask"])
     while True:
         op, arg, arg2 = _bcast_cmd(_OP_STOP)
-        if op == _OP_PUT:
-            host = _bcast(np.zeros((height, width), np.uint8))
-            state = inner.put(host)
-            pre_sparse = None
-        elif op == _OP_STEP:
-            state = inner.step(state)
-            pre_sparse = None  # mirror the coordinator: token spent
-        elif op == _OP_STEP_N:
-            state, _ = inner.step_n(state, arg)
-            pre_sparse = None
-        elif op == _OP_DIFF:
-            state, mask, _ = inner.step_with_diff(state)
-        elif op == _OP_STEP_N_DIFFS:
-            state, diffs, _ = inner.step_n_with_diffs(state, arg)
-            # A dense dispatch means the outstanding sparse chunk (if
-            # any) was consumed fine — drop the saved pre-sparse state
-            # so it stops pinning a whole board on device.
-            pre_sparse = None
-        elif op == _OP_STEP_N_DIFFS_SPARSE:
-            # The sparse rows are replicated; the coordinator reads its
-            # local copy, workers just co-execute the scan. The rows go
-            # to a throwaway — NOT `diffs` — so a later _OP_FETCH_DIFFS
-            # still gathers the dense stack the coordinator holds. The
-            # pre-sparse state is kept for a possible overflow redo.
-            pre_sparse = state
-            state, _rows, _ = inner.step_n_with_diffs_sparse(
-                state, arg, arg2
-            )
-        elif op == _OP_STEP_N_DIFFS_COMPACT:
-            # Compact chunks mirror exactly like sparse rows: headers
-            # and the value buffer are replicated (the coordinator
-            # reads its local copies, no further opcode), and the
-            # pre-dispatch state is kept for a possible overflow redo.
-            pre_sparse = state
-            state, _hdr, _vals, _ = inner.step_n_with_diffs_compact(
-                state, arg, arg2
-            )
-        elif op == _OP_STEP_N_DIFFS_REDO:
-            # Sparse-overflow redo: the coordinator broadcast the
-            # DEDICATED redo opcode (never inferred from identity), so
-            # step from the state saved before the sparse dispatch —
-            # then drop the save (one redo per sparse, by contract).
-            if pre_sparse is None:
-                raise RuntimeError(
-                    "sparse-overflow redo opcode with no sparse "
-                    "dispatch outstanding — coordinator/worker "
-                    "dispatch streams have diverged"
-                )
-            state, diffs, _ = inner.step_n_with_diffs(pre_sparse, arg)
-            pre_sparse = None
-        elif op == _OP_COUNT:
-            inner.alive_count_async(state)
-        elif op == _OP_FETCH_WORLD:
-            inner.fetch(state)
-        elif op == _OP_FETCH_MASK:
-            inner.fetch(mask)
-        elif op == _OP_FETCH_DIFFS:
-            (inner.fetch_diffs or np.asarray)(diffs)
-        elif op == _OP_STOP:
+        if op == _OP_STOP:
             return
+        handlers[op](arg, arg2)
 
 
 def notify_stop() -> None:

@@ -45,11 +45,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gol_tpu.models.rules import Rule
 from gol_tpu.ops import bitlife
 from gol_tpu.ops.bitlife import WORD
+from gol_tpu.parallel import partition
 from gol_tpu.parallel.halo import (
     AXIS,
     cpu_serializing_sync,
@@ -271,9 +271,10 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
         raise ValueError(
             f"height {height} not packable into {n} whole-word strips"
         )
-    mesh = Mesh(np.asarray(devices), (AXIS,))
-    sharding = NamedSharding(mesh, P(AXIS, None))
-    spec = P(AXIS, None)
+    table = partition.table_for("packed_ring")
+    mesh = partition.ring_mesh(devices)
+    spec = table.resolve("world", ndim=2)
+    sharding = partition.named_sharding(mesh, spec)
     on_tpu = devices[0].platform == "tpu"
     strip_words = (height // n) // WORD
 
@@ -328,7 +329,8 @@ def packed_sharded_stepper(rule: Rule, devices: list, height: int,
             mid, rem = 0, 0
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P()),
+            jax.shard_map, mesh=mesh, in_specs=spec,
+            out_specs=(spec, partition.REPLICATED),
             # vma checking must be off when a pallas local path is in
             # the program (see deep_block); every other variant keeps it.
             check_vma=mode == "xla",
@@ -489,7 +491,7 @@ def replicate_rows(mesh):
     materializes them on any process without a host collective."""
     def post(new, rows, count):
         rows = jax.lax.with_sharding_constraint(
-            rows, NamedSharding(mesh, P())
+            rows, partition.named_sharding(mesh, partition.REPLICATED)
         )
         return new, rows, count
 
@@ -501,7 +503,7 @@ def replicate_compact(mesh):
     headers AND the shared value buffer fully replicated over `mesh`
     (same rationale as replicate_rows — multiprocess coordinators
     materialize both with plain np.asarray)."""
-    rep = NamedSharding(mesh, P())
+    rep = partition.named_sharding(mesh, partition.REPLICATED)
 
     def post(new, headers, values, count):
         headers = jax.lax.with_sharding_constraint(headers, rep)
@@ -555,9 +557,10 @@ def packed_sharded_stepper_uneven(rule: Rule, devices: list, height: int,
     rem_words = total_words % n
     floor_words = total_words // n
     offsets = np.concatenate([[0], np.cumsum(real_list)])
-    mesh = Mesh(np.asarray(devices), (AXIS,))
-    sharding = NamedSharding(mesh, P(AXIS, None))
-    spec = P(AXIS, None)
+    table = partition.table_for("packed_ring")
+    mesh = partition.ring_mesh(devices)
+    spec = table.resolve("world", ndim=2)
+    sharding = partition.named_sharding(mesh, spec)
     on_tpu = devices[0].platform == "tpu"
 
     def _real():
@@ -617,7 +620,8 @@ def packed_sharded_stepper_uneven(rule: Rule, devices: list, height: int,
             mid, rem_t = 0, 0
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, P()),
+            jax.shard_map, mesh=mesh, in_specs=spec,
+            out_specs=(spec, partition.REPLICATED),
             # vma checking off when a pallas local path is in the
             # program (pltpu.roll drops the varying-axis tag — see
             # packed_sharded_stepper).

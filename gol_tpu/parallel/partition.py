@@ -1,0 +1,321 @@
+"""Partition-rule tables — the ONE place device meshes and shardings
+are built.
+
+Every ring/mesh backend used to hand-roll its own ``Mesh(np.asarray(
+devices), (AXIS,))`` + ``P(AXIS, None)`` pair, which hard-coded the 1-D
+row ring into four modules and made a 2-D scale-out a cross-cutting
+edit. This module replaces that plumbing with the declarative pattern
+from the pjit lineage (SNIPPETS.md [1]): an ORDERED table of
+``regex -> PartitionSpec`` rules, resolved by first match against the
+logical NAME of each device array a stepper owns (``world``, ``planes``,
+``diffs``, ``sparse_rows``, ``compact_headers``, ``compact_values``,
+``stack``, ...). Backends ask the table for their specs; operators
+override individual rules from the CLI (``--partition-rule``) without
+touching backend code.
+
+Axis vocabulary: a mesh here is always ``Mesh((rows, cols))`` —
+``rows`` shards packed word-rows (the inter-host axis on real pods),
+``cols`` shards word columns. A 1-D ring is the degenerate ``cols=1``
+case; ``ring_mesh`` builds it directly for the legacy backends.
+
+The analysis linter's ``partition-spec`` check enforces the monopoly:
+no ``Mesh``/``NamedSharding``/``PartitionSpec`` construction anywhere
+else in ``gol_tpu/parallel``.
+
+Layouts: some partition decisions select a KERNEL layout rather than a
+sharding (the board is re-chunked inside one device's program). Those
+register in ``LAYOUTS`` and are picked by a ``layout=NAME`` entry in
+the same override string — ``lane-coupled`` (the PR 4 ``ilp_study``
+lane-axis probe, now a library op in ``gol_tpu/ops/lanes.py``) is the
+first.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+#: Mesh axis names — the only two the steppers ever use.
+AXIS_ROWS = "rows"
+AXIS_COLS = "cols"
+
+#: The replicated spec, importable so backends never spell ``P()``.
+REPLICATED = P()
+
+
+class PartitionError(ValueError):
+    """A partition request the table cannot satisfy — an unresolvable
+    array name, a rank mismatch, or a malformed mesh/override string."""
+
+
+def spec(*axes) -> P:
+    """Build a PartitionSpec — the constructor backends call instead of
+    importing ``P`` themselves (the partition-spec lint pins this)."""
+    return P(*axes)
+
+
+def named_sharding(mesh: Mesh, partition_spec: P) -> NamedSharding:
+    """``NamedSharding`` constructor, monopolized here (see lint)."""
+    return NamedSharding(mesh, partition_spec)
+
+
+def parse_mesh(text: str) -> Tuple[int, int]:
+    """``"ROWSxCOLS"`` -> ``(rows, cols)``; both positive ints."""
+    m = re.fullmatch(r"(\d+)[xX](\d+)", text.strip())
+    if not m:
+        raise PartitionError(
+            f"mesh spec {text!r} is not ROWSxCOLS (e.g. 2x4)"
+        )
+    rows, cols = int(m.group(1)), int(m.group(2))
+    if rows < 1 or cols < 1:
+        raise PartitionError(f"mesh {rows}x{cols} has an empty axis")
+    return rows, cols
+
+
+def ring_mesh(devices: Sequence) -> Mesh:
+    """The legacy 1-D row ring: ``Mesh((n,), ("rows",))`` over `devices`
+    in order (ring neighbours adjacent where the caller's order is)."""
+    return Mesh(np.asarray(devices), (AXIS_ROWS,))
+
+
+def mesh2d(devices: Sequence, rows: int, cols: int) -> Mesh:
+    """A ``rows x cols`` device mesh. Row-major assignment keeps each
+    mesh row on as few hosts as possible (jax.devices() enumerates
+    process-grouped), so the ``cols`` halos ride the fast intra-host
+    links and ``rows`` is the inter-host axis."""
+    if rows * cols != len(devices):
+        raise PartitionError(
+            f"mesh {rows}x{cols} needs {rows * cols} devices, "
+            f"got {len(devices)}"
+        )
+    return Mesh(
+        np.asarray(devices).reshape(rows, cols), (AXIS_ROWS, AXIS_COLS)
+    )
+
+
+# --- rule tables ---------------------------------------------------------
+
+_AXIS_TOKENS = {
+    "rows": AXIS_ROWS,
+    "cols": AXIS_COLS,
+    "*": None,
+    ".": None,
+    "none": None,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One ordered table entry: arrays whose name matches `pattern`
+    (``re.search``) shard as ``P(*axes)``. ``axes=()`` is replicated."""
+
+    pattern: str
+    axes: Tuple[Optional[str], ...]
+
+    def __post_init__(self):
+        re.compile(self.pattern)  # fail fast on a bad regex
+        for a in self.axes:
+            if a not in (None, AXIS_ROWS, AXIS_COLS):
+                raise PartitionError(
+                    f"rule {self.pattern!r}: unknown mesh axis {a!r}"
+                )
+
+
+class RuleTable:
+    """Ordered first-match resolver from array names to PartitionSpecs.
+
+    ``resolve(name, ndim=...)`` walks the rules in order and returns the
+    FIRST match's spec — order is the override mechanism (operator rules
+    are prepended), exactly the semantics of the pjit partition tables
+    this mirrors. No match raises PartitionError (an unresolvable array
+    is a programming error, never silently replicated); a spec longer
+    than the array's rank raises too (a shorter one is fine — trailing
+    dims replicate, standard PartitionSpec semantics)."""
+
+    def __init__(self, rules: Iterable[Rule], name: str = "custom",
+                 layout: Optional[str] = None):
+        self.rules = tuple(rules)
+        self.name = name
+        #: Kernel layout selected by a ``layout=NAME`` override, if any.
+        self.layout = layout
+
+    def resolve(self, array: str, ndim: Optional[int] = None) -> P:
+        for rule in self.rules:
+            if re.search(rule.pattern, array):
+                if ndim is not None and len(rule.axes) > ndim:
+                    raise PartitionError(
+                        f"table {self.name!r}: rule {rule.pattern!r} "
+                        f"spec {rule.axes} has rank {len(rule.axes)} "
+                        f"but array {array!r} has rank {ndim}"
+                    )
+                return P(*rule.axes)
+        raise PartitionError(
+            f"table {self.name!r} resolves no rule for array "
+            f"{array!r} — add a rule or an override"
+        )
+
+    def sharding(self, mesh: Mesh, array: str,
+                 ndim: Optional[int] = None) -> NamedSharding:
+        return NamedSharding(mesh, self.resolve(array, ndim))
+
+    def with_overrides(self, overrides) -> "RuleTable":
+        """A new table with operator `overrides` PREPENDED (first match
+        wins, so overrides shadow the defaults). `overrides` is either
+        an override string (see `parse_overrides`) or parsed rules."""
+        if overrides is None:
+            return self
+        layout = self.layout
+        if isinstance(overrides, str):
+            rules, layout_over = parse_overrides(overrides)
+            layout = layout_over or layout
+        else:
+            rules = tuple(overrides)
+        return RuleTable(rules + self.rules, name=self.name,
+                         layout=layout)
+
+
+def parse_overrides(text: str) -> Tuple[Tuple[Rule, ...], Optional[str]]:
+    """Parse a CLI override string into ``(rules, layout)``.
+
+    Grammar: ``entry(;entry)*`` where an entry is ``PATTERN=AXES`` —
+    AXES a comma list of ``rows``/``cols``/``*`` (``*`` = replicate
+    that dim), or ``-`` for fully replicated — or the special
+    ``layout=NAME`` selecting a registered kernel layout:
+
+        --partition-rule 'world=rows,cols;sparse_rows=-'
+        --partition-rule 'layout=lane-coupled'
+    """
+    rules = []
+    layout = None
+    for entry in text.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            raise PartitionError(
+                f"override {entry!r} is not PATTERN=AXES (or "
+                f"layout=NAME)"
+            )
+        pattern, _, axes_text = entry.partition("=")
+        pattern, axes_text = pattern.strip(), axes_text.strip()
+        if pattern == "layout":
+            get_layout(axes_text)  # unknown layout fails at parse time
+            layout = axes_text
+            continue
+        if axes_text in ("-", ""):
+            axes: Tuple[Optional[str], ...] = ()
+        else:
+            axes_list = []
+            for tok in axes_text.split(","):
+                tok = tok.strip().lower()
+                if tok not in _AXIS_TOKENS:
+                    raise PartitionError(
+                        f"override {entry!r}: unknown axis {tok!r} "
+                        f"(want rows, cols or *)"
+                    )
+                axes_list.append(_AXIS_TOKENS[tok])
+            axes = tuple(axes_list)
+        try:
+            rules.append(Rule(pattern, axes))
+        except re.error as e:
+            raise PartitionError(
+                f"override {entry!r}: bad pattern ({e})"
+            ) from None
+    return tuple(rules), layout
+
+
+#: Shared tail every family ends with: scalar/housekeeping arrays are
+#: replicated unless a family (or operator) says otherwise.
+_COMMON_TAIL = (
+    Rule(r"^(count|mask|sparse_rows|compact_headers|compact_values)$", ()),
+    Rule(r"^stack$", ()),
+)
+
+#: Default rule tables by backend family. Keys are what the builders
+#: pass to `table_for`; the tables cover every device array the family
+#: owns, so `resolve` never falls through on in-tree code.
+_DEFAULTS: Dict[str, Tuple[Rule, ...]] = {
+    # 1-D rings: board rows sharded, everything else as the tail says.
+    "dense_ring": (
+        Rule(r"^world$", (AXIS_ROWS,)),
+        Rule(r"^diffs$", (None, AXIS_ROWS)),
+    ) + _COMMON_TAIL,
+    "packed_ring": (
+        Rule(r"^world$", (AXIS_ROWS, None)),
+        Rule(r"^diffs$", (None, AXIS_ROWS, None)),
+    ) + _COMMON_TAIL,
+    # Dense Generations: uint8 (H, W) state strips — geometrically the
+    # dense ring, kept as its own family so operator overrides can
+    # target gens without touching Life.
+    "gens_ring": (
+        Rule(r"^world$", (AXIS_ROWS,)),
+        Rule(r"^diffs$", (None, AXIS_ROWS)),
+    ) + _COMMON_TAIL,
+    # Generations planes: (C-1, H/32, W) — the leading plane axis never
+    # shards (aging is a plane rename; splitting it would turn a rename
+    # into a collective). The diff stack is a single collapsed bitplane
+    # per turn — (k, H/32, W) — so its rule has ring rank, not plane
+    # rank.
+    "gens_packed_ring": (
+        Rule(r"^(world|planes)$", (None, AXIS_ROWS, None)),
+        Rule(r"^diffs$", (None, AXIS_ROWS, None)),
+    ) + _COMMON_TAIL,
+    # 2-D meshes (parallel/mesh2d.py): word-rows x word-columns.
+    "packed_mesh2d": (
+        Rule(r"^world$", (AXIS_ROWS, AXIS_COLS)),
+        Rule(r"^diffs$", (None, AXIS_ROWS, AXIS_COLS)),
+    ) + _COMMON_TAIL,
+    "gens_mesh2d": (
+        Rule(r"^(world|planes)$", (None, AXIS_ROWS, AXIS_COLS)),
+        Rule(r"^diffs$", (None, AXIS_ROWS, AXIS_COLS)),
+    ) + _COMMON_TAIL,
+    # Batch/session stacks and single-device backends: one device, all
+    # arrays replicated over the trivial mesh.
+    "single": _COMMON_TAIL + (Rule(r"", ()),),
+}
+
+
+def table_for(family: str, overrides: Optional[str] = None) -> RuleTable:
+    """The default rule table of a backend `family`, with operator
+    `overrides` (CLI string) prepended when given."""
+    if family not in _DEFAULTS:
+        raise PartitionError(
+            f"unknown backend family {family!r} "
+            f"(have {sorted(_DEFAULTS)})"
+        )
+    table = RuleTable(_DEFAULTS[family], name=family)
+    return table.with_overrides(overrides)
+
+
+# --- kernel layouts ------------------------------------------------------
+
+#: name -> factory(rule, **kw) -> ``(packed, n) -> packed`` multi-turn
+#: kernel. Selected by a ``layout=NAME`` partition override; consumed
+#: by the single-device packed builder (stepper._single_device_packed).
+LAYOUTS: Dict[str, Callable] = {}
+
+
+def register_layout(name: str, factory: Callable) -> None:
+    LAYOUTS[name] = factory
+
+
+def get_layout(name: str) -> Callable:
+    try:
+        return LAYOUTS[name]
+    except KeyError:
+        raise PartitionError(
+            f"unknown layout {name!r} (have {sorted(LAYOUTS)})"
+        ) from None
+
+
+# The lane-coupled layout (PR 4's ilp_study lane-axis probe, relocated
+# to a library op) registers on import — partition is the registry, the
+# op module owns the kernel.
+from gol_tpu.ops import lanes as _lanes  # noqa: E402
+
+register_layout("lane-coupled", _lanes.make_lane_coupled)

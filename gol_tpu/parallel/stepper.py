@@ -24,6 +24,92 @@ from gol_tpu.ops import life
 from gol_tpu.params import BACKENDS
 
 
+@dataclasses.dataclass(frozen=True)
+class EntryInfo:
+    """One row of the Stepper capability table (`ENTRY_TABLE`): what a
+    Stepper field IS, so the consumers that used to hand-maintain
+    parallel lists (the obs instrumentation wrapper, the SPMD dispatch
+    mirror, the engine's capability probes) derive their behaviour from
+    ONE declaration. multihost.py drifted once exactly because its
+    per-opcode shims were written by hand (PR 4's redo-token bug) —
+    the table is the fix.
+
+    Fields:
+    - `kind`: "core" (every backend offers it), "diff" (optional diff
+      scans), "fetch" (host-materialization hooks), "meta" (host-side
+      metadata, never dispatched).
+    - `wrap`: instrument_stepper's wrapper shape — "put" (timed +
+      cost probe), "timed", "one_turn" / "step_n" / "diffy" (halo
+      charging variants), None = not instrumented.
+    - `opcode`: the SPMD mirror's broadcast opcode. STABLE numbers —
+      they are the coordinator/worker wire protocol (multihost.py
+      reads them from here; fetch disambiguates world/mask with its
+      own pair of opcodes, and STOP is the control channel's own).
+    - `args`: how many int64 arguments ride the opcode broadcast
+      (chunk size k, sparse/compact cap).
+    - `token`: the sparse-redo token discipline role — "reset" (a
+      fused dispatch consumes any outstanding sparse record), "dense"
+      (must continue from the sparse output), "sparse" (records its
+      (input, output) pair), "redo" (must re-step the sparse input).
+    - `replay`: how a worker process co-executes the opcode
+      (spmd_worker_loop); None = never broadcast or fetch-family.
+    """
+
+    name: str
+    kind: str
+    wrap: Optional[str] = None
+    opcode: Optional[int] = None
+    args: int = 0
+    token: Optional[str] = None
+    replay: Optional[str] = None
+
+
+#: The capability table — one row per Stepper field, in field order.
+#: Every consumer that enumerates entries (instrument_stepper, the
+#: SPMD mirror and worker loop, engine/session capability probes via
+#: `Stepper.offers`) reads THIS, never a hand-copied list.
+ENTRY_TABLE: tuple = (
+    EntryInfo("put", "core", wrap="put", opcode=0, token="reset",
+              replay="put"),
+    EntryInfo("fetch", "core", wrap="timed", replay="fetch"),
+    EntryInfo("step", "core", wrap="one_turn", opcode=1, token="reset",
+              replay="step"),
+    EntryInfo("step_n", "core", wrap="step_n", opcode=2, args=1,
+              token="reset", replay="step_n"),
+    EntryInfo("step_with_diff", "core", wrap="one_turn", opcode=3,
+              replay="diff"),
+    EntryInfo("alive_count_async", "core", opcode=4, replay="count"),
+    EntryInfo("alive_mask", "meta"),
+    EntryInfo("step_n_with_diffs", "diff", wrap="diffy", opcode=8,
+              args=1, token="dense", replay="dense"),
+    EntryInfo("fetch_diffs", "fetch", opcode=9, replay="fetch_diffs"),
+    EntryInfo("packed_diffs", "meta"),
+    EntryInfo("step_n_with_diffs_sparse", "diff", wrap="diffy",
+              opcode=10, args=2, token="sparse", replay="sparse"),
+    EntryInfo("step_n_with_diffs_redo", "diff", wrap="diffy",
+              opcode=11, args=1, token="redo", replay="redo"),
+    EntryInfo("step_n_with_diffs_compact", "diff", wrap="diffy",
+              opcode=12, args=2, token="sparse", replay="compact"),
+    EntryInfo("fetch_compact_values", "fetch"),
+    EntryInfo("halo_cost", "meta"),
+    EntryInfo("tiled", "meta"),
+)
+
+
+def entries(kind: Optional[str] = None) -> tuple:
+    """Capability-table rows, optionally filtered by `kind`."""
+    if kind is None:
+        return ENTRY_TABLE
+    return tuple(e for e in ENTRY_TABLE if e.kind == kind)
+
+
+def entry_info(name: str) -> EntryInfo:
+    for e in ENTRY_TABLE:
+        if e.name == name:
+            return e
+    raise KeyError(f"no Stepper entry named {name!r}")
+
+
 @dataclasses.dataclass
 class Stepper:
     """Uniform interface over execution strategies.
@@ -153,6 +239,22 @@ class Stepper:
 
     def alive_count(self, world) -> int:
         return int(self.alive_count_async(world))
+
+    def offers(self, entry: str) -> bool:
+        """True when this backend provides capability-table entry
+        `entry` — the ONE probe the engine, sessions, tiling and the
+        SPMD mirror use (never `hasattr` or `is not None` on fields
+        directly: the table validates the name, so a typo'd probe
+        raises instead of silently reading False forever)."""
+        entry_info(entry)  # KeyError on names the table doesn't know
+        value = getattr(self, entry)
+        return value is not None and value is not False
+
+    def capabilities(self) -> tuple:
+        """Names of every table entry this backend offers (for the
+        bool-valued `packed_diffs` flag, offered means True)."""
+        return tuple(e.name for e in ENTRY_TABLE
+                     if getattr(self, e.name) not in (None, False))
 
 
 def _diff_scan(step_fn, diff_fn, count_fn, state, k):
@@ -485,6 +587,15 @@ class BatchStepper:
     #: a warm bucket must not move any of these).
     cache_sizes: Optional[Callable] = None
 
+    def offers(self, entry: str) -> bool:
+        """Capability probe for the batch plane, sharing ENTRY_TABLE's
+        entry names where a bucket field mirrors a Stepper entry (the
+        compact diff scan, the diff scan itself) — same contract as
+        `Stepper.offers`, so session code probes declaratively too."""
+        entry_info(entry)  # unknown entry names are programming errors
+        value = getattr(self, entry, None)
+        return value is not None and value is not False
+
 
 def make_batch_stepper(capacity: int, height: int, width: int,
                        rule: Rule | str = LIFE, device=None) -> BatchStepper:
@@ -713,16 +824,26 @@ def _packed_state_stepper(name: str, rule: Rule, height: int,
     )
 
 
-def _single_device_packed(rule: Rule, height: int, device=None) -> Stepper:
+def _single_device_packed(rule: Rule, height: int, device=None,
+                          layout: Optional[str] = None) -> Stepper:
     """Bit-packed single-device backend (ops/bitlife.py): XLA fori_loop
     over the SWAR step. ~16x the dense path on TPU (VPU-bound SWAR
-    instead of one lane per cell)."""
+    instead of one lane per cell). `layout` selects a registered
+    kernel layout from the partition table (partition.LAYOUTS, e.g.
+    ``lane-coupled``) for the multi-turn kernel; single turns and the
+    diff scans keep the plain SWAR step — bit-exact either way."""
     from gol_tpu.ops import bitlife
 
+    if layout is not None:
+        from gol_tpu.parallel import partition
+
+        raw = partition.get_layout(layout)(rule)
+        name = f"single-packed-{layout}"
+    else:
+        raw = lambda p, n: bitlife.step_n_packed_raw(p, n, rule)  # noqa: E731
+        name = "single-packed"
     return _packed_state_stepper(
-        "single-packed", rule, height,
-        lambda p, n: bitlife.step_n_packed_raw(p, n, rule),
-        device or jax.devices()[0],
+        name, rule, height, raw, device or jax.devices()[0],
     )
 
 
@@ -1024,9 +1145,10 @@ def instrument_stepper(s: Stepper) -> Stepper:
     backend = {"backend": s.name}
     dispatches = {}
     seconds = {}
-    for entry in ("put", "fetch", "step", "step_n", "step_with_diff",
-                  "step_n_with_diffs", "step_n_with_diffs_sparse",
-                  "step_n_with_diffs_compact", "step_n_with_diffs_redo"):
+    # The wrap set comes from the capability table, not a hand-kept
+    # tuple — an entry gains instrumentation by declaring a `wrap`
+    # shape in ENTRY_TABLE, nowhere else.
+    for entry in (e.name for e in ENTRY_TABLE if e.wrap is not None):
         dispatches[entry] = obs.counter(
             "gol_tpu_stepper_dispatches_total",
             "Stepper entry invocations", {**backend, "entry": entry},
@@ -1155,32 +1277,18 @@ def instrument_stepper(s: Stepper) -> Stepper:
 
         return wrapper
 
-    return dataclasses.replace(
-        s,
-        put=put,
-        fetch=timed("fetch", s.fetch),
-        step=_one_turn("step", s.step),
-        step_n=step_n,
-        step_with_diff=_one_turn("step_with_diff", s.step_with_diff),
-        step_n_with_diffs=(
-            None if s.step_n_with_diffs is None
-            else _diffy("step_n_with_diffs", s.step_n_with_diffs)
-        ),
-        step_n_with_diffs_sparse=(
-            None if s.step_n_with_diffs_sparse is None
-            else _diffy("step_n_with_diffs_sparse",
-                        s.step_n_with_diffs_sparse)
-        ),
-        step_n_with_diffs_compact=(
-            None if s.step_n_with_diffs_compact is None
-            else _diffy("step_n_with_diffs_compact",
-                        s.step_n_with_diffs_compact)
-        ),
-        step_n_with_diffs_redo=(
-            None if s.step_n_with_diffs_redo is None
-            else _diffy("step_n_with_diffs_redo", s.step_n_with_diffs_redo)
-        ),
-    )
+    # The replace set is DERIVED from the capability table: every entry
+    # declaring a `wrap` shape gets that wrapper, absent entries stay
+    # None — no hand-kept field list to drift from the dataclass.
+    wrappers = {"timed": timed, "one_turn": _one_turn, "diffy": _diffy}
+    repl: dict = {"put": put, "step_n": step_n}
+    for e in ENTRY_TABLE:
+        if e.wrap is None or e.name in repl:
+            continue
+        fn = getattr(s, e.name)
+        if fn is not None:
+            repl[e.name] = wrappers[e.wrap](e.name, fn)
+    return dataclasses.replace(s, **repl)
 
 
 def make_stepper(
@@ -1191,6 +1299,8 @@ def make_stepper(
     devices: Optional[list] = None,
     backend: str = "auto",
     tile: int = 0,
+    mesh: Optional[tuple | str] = None,
+    partition_rules: Optional[str] = None,
 ) -> Stepper:
     """Build the best stepper for the request, wrapped with per-dispatch
     obs instrumentation (unless GOL_TPU_METRICS=0 — the disabled path
@@ -1199,11 +1309,14 @@ def make_stepper(
     GOL_TPU_CHECK_INVARIANTS=1 (cli --check-invariants;
     gol_tpu.analysis.invariants) — host-side identity checks only, so
     the opt-in costs nothing on device. `tile` > 0 selects the
-    activity-driven tiled backend (parallel/tiled.py, --tile)."""
+    activity-driven tiled backend (parallel/tiled.py, --tile).
+    `mesh` ("RxC" or (rows, cols)) selects the 2-D mesh backends
+    (parallel/mesh2d.py, --mesh); `partition_rules` is the operator
+    override string for the partition table (--partition-rule)."""
     from gol_tpu import obs
 
     s = _make_stepper(threads, height, width, rule, devices, backend,
-                      tile)
+                      tile, mesh, partition_rules)
     if obs.enabled():
         s = instrument_stepper(s)
     from gol_tpu.analysis.invariants import checked_stepper, invariants_enabled
@@ -1221,6 +1334,8 @@ def _make_stepper(
     devices: Optional[list] = None,
     backend: str = "auto",
     tile: int = 0,
+    mesh: Optional[tuple | str] = None,
+    partition_rules: Optional[str] = None,
 ) -> Stepper:
     """Build the best stepper for the request (the dispatch analog of
     ref: gol/distributor.go:93,116 picking serial vs row-farm).
@@ -1239,6 +1354,69 @@ def _make_stepper(
         raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
     rule = get_rule(rule) if isinstance(rule, str) else rule
     multiprocess = devices is None and jax.process_count() > 1
+    layout = None
+    if partition_rules:
+        from gol_tpu.parallel import partition
+
+        # Parse once up front: a bad override string fails the build,
+        # not the first dispatch; `layout=NAME` rides to the
+        # single-device packed path below.
+        _, layout = partition.parse_overrides(partition_rules)
+    if mesh is not None:
+        from gol_tpu.parallel import partition
+
+        rows, cols = (
+            partition.parse_mesh(mesh) if isinstance(mesh, str)
+            else (int(mesh[0]), int(mesh[1]))
+        )
+        if rows * cols > 1:
+            # An explicit mesh selects the 2-D family (parallel/
+            # mesh2d.py) — including the degenerate 1xN / Nx1 shapes,
+            # which collapse to rings bit-exactly; `threads`-driven
+            # requests keep the tuned deep-halo 1-D rings.
+            if tile:
+                raise ValueError(
+                    "--mesh and --tile are exclusive (the tiled "
+                    "backend's dispatch set is its parallelism axis)"
+                )
+            if backend not in ("auto", "packed"):
+                raise ValueError(
+                    f"mesh backends are packed-only (backend auto/"
+                    f"packed, not {backend!r})"
+                )
+            from gol_tpu.parallel.mesh2d import (
+                mesh2d_packed_gens_stepper,
+                mesh2d_packed_stepper,
+            )
+
+            if multiprocess:
+                from gol_tpu.parallel.multihost import round_robin_devices
+
+                devs = round_robin_devices()
+            else:
+                devs = devices if devices is not None else jax.devices()
+            need = rows * cols
+            if len(devs) < need:
+                raise ValueError(
+                    f"mesh {rows}x{cols} needs {need} devices, "
+                    f"have {len(devs)}"
+                )
+            if isinstance(rule, GenRule):
+                s = mesh2d_packed_gens_stepper(
+                    rule, devs[:need], height, width, rows, cols,
+                    partition_rules,
+                )
+            else:
+                s = mesh2d_packed_stepper(
+                    rule, devs[:need], height, width, rows, cols,
+                    partition_rules,
+                )
+            from gol_tpu.parallel import multihost
+
+            if multihost.is_multiprocess_mesh(devs[:need]):
+                if multihost.is_coordinator():
+                    return multihost.spmd_stepper(s)
+            return s
     if tile:
         if multiprocess:
             raise ValueError(
@@ -1398,7 +1576,7 @@ def _make_stepper(
     if backend == "packed" or (backend == "auto" and packable(height, width)):
         if not packable(height, width):
             raise ValueError(f"grid {height}x{width} is not packable")
-        return _single_device_packed(rule, height, devs[0])
+        return _single_device_packed(rule, height, devs[0], layout=layout)
     if backend == "pallas":
         if not fits_pallas(height, width):
             raise ValueError(f"grid {height}x{width} does not fit the "

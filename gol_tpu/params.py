@@ -79,6 +79,16 @@ class Params:
     # board size stops being an HBM bound (docs/PERF.md
     # "Activity-driven stepping").
     tile: int = 0
+    # 2-D device mesh (parallel/mesh2d.py, --mesh "ROWSxCOLS"): shard
+    # the packed board over word-rows AND word-columns with mesh-
+    # generic halo exchange. None = the 1-D rings / single device
+    # (threads-driven). Exclusive with tile; packed backends only.
+    mesh: str | None = None
+    # Partition-table overrides (parallel/partition.py,
+    # --partition-rule): "PATTERN=AXES;..." entries prepended to the
+    # backend family's default rule table, plus "layout=NAME" kernel
+    # layout selection. None = family defaults.
+    partition_rules: str | None = None
 
     def __post_init__(self):
         if self.image_width <= 0 or self.image_height <= 0:
@@ -101,6 +111,23 @@ class Params:
             raise ValueError(
                 "tile must be 0 (off) or a positive multiple of 32"
             )
+        if self.mesh is not None:
+            # Fail fast on malformed geometry (make_stepper re-parses;
+            # this keeps the error at Params construction, where the
+            # CLI can attribute it to the flag).
+            from gol_tpu.parallel import partition
+
+            try:
+                partition.parse_mesh(self.mesh)
+            except partition.PartitionError as e:
+                raise ValueError(str(e)) from None
+        if self.partition_rules is not None:
+            from gol_tpu.parallel import partition
+
+            try:
+                partition.parse_overrides(self.partition_rules)
+            except partition.PartitionError as e:
+                raise ValueError(str(e)) from None
 
     @property
     def input_name(self) -> str:

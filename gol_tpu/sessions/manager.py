@@ -342,7 +342,7 @@ class _Bucket:
 
     def adapt_cap(self, peak_words: int) -> None:
         ceiling = self.bs.total_words // 2
-        if (self.bs.step_n_with_diffs_compact is None
+        if (not self.bs.offers("step_n_with_diffs_compact")
                 or ceiling < COMPACT_MIN_CAP or 2 * peak_words > ceiling):
             new = None
         else:

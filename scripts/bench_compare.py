@@ -113,7 +113,15 @@ LOWER_BETTER = re.compile(
     # loop started fighting the fleet it reconciles, an infinite
     # regression. The lane's invariant_violations ride the off-zero
     # `violations` rule above.
-    r"|heal_wall|heal_action|action_errors|stale_refusals)", re.I
+    r"|heal_wall|heal_action|action_errors|stale_refusals"
+    # Mesh plane (ISSUE 19): the mesh_2d_512x512 lane's per-turn
+    # per-host halo link bytes regress UP — the per-host aggregation
+    # exists precisely so this number stays flat as the mesh grows
+    # (already matched by the generic `bytes` token above — spelled
+    # here so the lane's gate survives a rename of that token). The
+    # lane's flatness ratio key deliberately avoids the `bytes` token
+    # and stays informational.
+    r"|halo_bytes_per_host)", re.I
 )
 INFORMATIONAL = re.compile(
     # Accounting lane (ISSUE 17): the per-leg throughputs and whatever

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Merge the 2-D mesh lane into BENCH_DETAIL.json — the bounded form
+of the full bench for containers without the TPU attached (the
+`wire_batch_capture.py` pattern applied to ISSUE 19's acceptance
+lane), plus the per-geometry probe the lane spawns.
+
+Two modes:
+
+    python scripts/mesh_capture.py
+        Run `bench.measure_mesh2d` — the packed mesh2d backend swept
+        over 1x4 / 2x2 / 4x1 / 2x4 forced-host-device meshes, each in
+        a fresh subprocess (this very script's --probe mode) so
+        `XLA_FLAGS=--xla_force_host_platform_device_count=8` can take
+        effect before jax initializes — and write the result under
+        BENCH_DETAIL.json["mesh_2d_512x512"]. No other lane is
+        touched, so `bench_compare` against an older capture sees one
+        new key, never a fake regression. Exits 0 iff per-host halo
+        bytes stay flat (±10%) from 1x4 to 2x4 — the ISSUE 19
+        acceptance gate.
+
+    python scripts/mesh_capture.py --probe ROWSxCOLS SIDE TURNS
+        (internal) Build the mesh2d stepper for one geometry on the
+        already-forced devices, measure sustained turns/s, price one
+        turn's halo with `Stepper.halo_cost`, print one JSON line.
+
+Usage: python scripts/mesh_capture.py   (CPU-safe; ~2 min)
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def probe(mesh: str, side: int, turns: int) -> dict:
+    """One geometry on the current (forced) device set: sustained
+    turns/s of the packed mesh2d stepper plus halo_cost's per-turn
+    pricing. Runs under the parent-set XLA_FLAGS/JAX_PLATFORMS env."""
+    import numpy as np
+
+    from gol_tpu.parallel.stepper import make_stepper
+
+    st = make_stepper(threads=1, height=side, width=side,
+                      backend="packed", mesh=mesh)
+    rng = np.random.default_rng(2)
+    world = (rng.random((side, side)) < 0.5).astype(np.uint8)
+    p = st.put(world)
+    int(st.step_n(p, 64)[1])  # warm the compiled chain
+    t0 = time.perf_counter()
+    q, count = st.step_n(p, turns)
+    int(count)
+    dt = time.perf_counter() - t0
+    cost = st.halo_cost(q, 1)
+    return {
+        "backend": st.name,
+        "turns_per_sec": round(turns / dt, 1),
+        "halo_exchanges_per_turn": cost["exchanges"],
+        # Total link bytes one turn moves across the whole mesh, and
+        # the `rows`-axis bytes ONE mesh row (= one host in the
+        # row-per-host mapping) emits — the flat-as-the-mesh-grows
+        # series bench_compare gates LOWER_BETTER.
+        "halo_bytes_total": cost["bytes"],
+        "halo_bytes_per_host": cost["bytes_per_host"],
+    }
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--probe":
+        mesh, side, turns = (sys.argv[2], int(sys.argv[3]),
+                             int(sys.argv[4]))
+        print(json.dumps(probe(mesh, side, turns)))
+        return 0
+
+    import bench
+
+    # NOT bench._lane: the geometries run in fresh subprocesses, so
+    # this process's device plane would bracket nothing but zeros.
+    entry = bench.measure_mesh2d()
+
+    detail_path = REPO / "BENCH_DETAIL.json"
+    detail = json.loads(detail_path.read_text())
+    detail["mesh_2d_512x512"] = entry
+    detail_path.write_text(json.dumps(detail, indent=1))
+    print(json.dumps(entry, indent=1))
+    ratio = entry.get("halo_flat_ratio_2x4_vs_1x4")
+    ok = ratio is not None and abs(ratio - 1.0) <= 0.10
+    print(f"mesh_2d_512x512: halo bytes/host 1x4 -> 2x4 ratio "
+          f"{ratio} ({'PASS' if ok else 'FAIL'} the ±10% flatness "
+          f"acceptance gate)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -198,6 +198,55 @@ def test_detects_missing_donation_on_ring_stepper(tmp_path):
     """, name="ring2.py", subdir="parallel") == []
 
 
+def test_detects_partition_spec_construction_outside_table(tmp_path):
+    """ISSUE 19: Mesh/NamedSharding/PartitionSpec construction (or a
+    jax.sharding import) in a parallel-layer module that is not
+    partition.py is a hard finding — the rule table's monopoly."""
+    findings = _lint_snippet(tmp_path, """
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def build(devices):
+            mesh = Mesh(np.asarray(devices), ("rows",))
+            return mesh, P("rows", None)
+    """, name="rogue.py", subdir="parallel")
+    # Two findings: the jax.sharding import and the Mesh(...) call.
+    # The aliased P(...) call hides from the constructor scan, but the
+    # import that created the alias is itself a finding — the alias
+    # cannot exist without one.
+    assert [f.check for f in findings] == ["partition-spec"] * 2
+
+    # partition.py itself is the one legal constructor site.
+    assert _lint_snippet(tmp_path, """
+        from jax.sharding import Mesh, NamedSharding
+
+        def ring_mesh(devices):
+            return Mesh(devices, ("rows",))
+    """, name="partition.py", subdir="parallel") == []
+
+    # Outside the parallel layer the check does not apply (the engine
+    # never builds shardings, but that is a review concern, not this
+    # lint's).
+    assert _lint_snippet(tmp_path, """
+        from jax.sharding import PartitionSpec
+
+        spec = PartitionSpec("x")
+    """, name="other.py", subdir="engine") == []
+
+
+def test_partition_spec_flags_dotted_construction(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax.sharding
+
+        def build(devices):
+            return jax.sharding.NamedSharding(
+                jax.sharding.Mesh(devices, ("rows",)),
+                jax.sharding.PartitionSpec("rows"),
+            )
+    """, name="dotted.py", subdir="parallel")
+    assert [f.check for f in findings] == ["partition-spec"] * 4
+
+
 def test_lint_reports_unparseable_file(tmp_path):
     findings = _lint_snippet(tmp_path, "def broken(:\n", name="bad.py")
     assert [f.check for f in findings] == ["parse-error"]
@@ -537,7 +586,7 @@ def test_spmd_stepper_redo_token(monkeypatch):
         s.step_n_with_diffs_redo(w1, 4)
 
     out, _, _ = s.step_n_with_diffs_redo(w0, 4)  # the legal redo
-    assert sent[-1] == multihost._OP_STEP_N_DIFFS_REDO
+    assert sent[-1] == multihost._OPS["step_n_with_diffs_redo"]
     with pytest.raises(RuntimeError, match="no sparse"):
         s.step_n_with_diffs_redo(w0, 4)  # cleared after consume
 
@@ -545,7 +594,7 @@ def test_spmd_stepper_redo_token(monkeypatch):
     # the outstanding record too.
     w2, _, _ = s.step_n_with_diffs_sparse(out, 4, 16)
     s.step_n_with_diffs(w2, 4)
-    assert sent[-1] == multihost._OP_STEP_N_DIFFS
+    assert sent[-1] == multihost._OPS["step_n_with_diffs"]
 
     # A fused interlude (controller detach -> step_n path -> reattach)
     # spends the token: the first diffs dispatch on the fused result

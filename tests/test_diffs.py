@@ -381,9 +381,15 @@ def test_diff_chunk_cap_sized_from_actual_row_bytes(images_dir, tmp_path):
         p = Params(turns=10**6, threads=1, image_width=side,
                    image_height=side, image_dir=str(images_dir),
                    out_dir=str(tmp_path))
+        # Minimal stand-in honouring the Stepper capability contract:
+        # the engine probes entries via offers(), never hasattr.
+        fake = types.SimpleNamespace(packed_diffs=packed)
+        fake.offers = (
+            lambda e: getattr(fake, e, None) not in (None, False)
+        )
         eng = Engine(
             p,
-            stepper=types.SimpleNamespace(packed_diffs=packed),
+            stepper=fake,
             io_service=types.SimpleNamespace(stop=lambda: None),
         )
         return eng._diff_chunk_cap(pipelined)

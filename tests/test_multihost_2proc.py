@@ -14,6 +14,7 @@ import pathlib
 import socket
 import subprocess
 import sys
+import tempfile
 
 import numpy as np
 import pytest
@@ -51,11 +52,43 @@ print("COLLECTIVES_OK", flush=True)
 """
 
 
+def _probe_cache_path() -> pathlib.Path:
+    """Where the probe verdict persists ACROSS interpreter runs. The
+    capability being probed is a property of the installed jaxlib, not
+    of any one pytest invocation — re-spawning two subprocesses (and,
+    on images without the Gloo transport, waiting out their failure)
+    every run was pure tax. Keyed by python+jax version so an upgrade
+    re-probes; delete the file to force one by hand."""
+    import jax
+
+    key = (f"py{sys.version_info[0]}.{sys.version_info[1]}"
+           f"-jax{jax.__version__}")
+    return (pathlib.Path(tempfile.gettempdir())
+            / f"gol_tpu_collectives_probe_{key}")
+
+
 @functools.lru_cache(maxsize=1)
 def _collectives_unavailable() -> "str | None":
-    """One cached two-process allgather probe per test run: None when
-    cross-process CPU collectives work, else a one-line skip reason
-    (the probe's last stderr line, or 'timeout')."""
+    """ONE two-process allgather probe per interpreter — memoized here
+    for this run and persisted via `_probe_cache_path` for the next:
+    None when cross-process CPU collectives work, else a one-line skip
+    reason (the probe's last stderr line, or 'timeout')."""
+    cache = _probe_cache_path()
+    try:
+        cached = cache.read_text().strip()
+    except OSError:
+        cached = None
+    if cached is not None:
+        return None if cached == "OK" else cached
+    verdict = _probe_collectives()
+    try:
+        cache.write_text("OK" if verdict is None else verdict)
+    except OSError:
+        pass  # unwritable tmp: just re-probe next run
+    return verdict
+
+
+def _probe_collectives() -> "str | None":
     port = _free_port()
     env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin",
            "HOME": "/tmp"}
@@ -95,7 +128,12 @@ def _require_multiprocess_collectives():
     reasoned skip instead of 8 known failures."""
     reason = _collectives_unavailable()
     if reason is not None:
-        pytest.skip(f"no multiprocess CPU collectives: {reason}")
+        pytest.skip(
+            f"no multiprocess CPU collectives: {reason} — the same "
+            "SPMD programs run single-process on the forced-device "
+            "mesh instead (tests/test_partition.py's 2xN mesh dryruns "
+            "and the 8-device virtual-ring suites)"
+        )
 
 SCRIPT = r"""
 import sys
