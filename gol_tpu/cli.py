@@ -130,6 +130,27 @@ def build_parser() -> argparse.ArgumentParser:
                          "at /alerts, transitions counted and noted in "
                          "the flight recorder; a parse error is a "
                          "STARTUP error, never a runtime crash")
+    ap.add_argument("--remote-write", default=None, dest="remote_write",
+                    metavar="HOST:PORT",
+                    help="with --metrics-port: push this sidecar's "
+                         "registry (plus alert transitions and span "
+                         "digests) to the history-plane collector at "
+                         "HOST:PORT — delta-encoded sample frames on "
+                         "the framed wire, client deadlines + jittered "
+                         "backoff per link; a slow or dead collector "
+                         "SHEDS samples, never wedges this process "
+                         "(docs/OBSERVABILITY.md 'History plane')")
+    ap.add_argument("--collector", default=None, metavar="[HOST:]PORT",
+                    help="run as the HISTORY-PLANE COLLECTOR "
+                         "(gol_tpu.obs.collector): ingest --remote-"
+                         "write telemetry into crash-atomic segment "
+                         "logs under <out>/tsdb and serve range "
+                         "queries (/query, /history) from its own "
+                         "--metrics-port sidecar; --resume latest "
+                         "replays the store to the last good sample; "
+                         "--alert-rules evaluate FLEET-WIDE over "
+                         "collected series with for: durations judged "
+                         "against history")
     ap.add_argument("--session-budget-flops", type=float, default=None,
                     dest="session_budget_flops", metavar="FLOPS",
                     help="with --serve --sessions: soft per-tenant "
@@ -347,19 +368,28 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
-def _start_metrics(args, health=None):
+def _start_metrics(args, health=None, tsdb=None, series_source=None):
     """Opt-in observability sidecar (gol_tpu.obs.http): serve the
     process registry + a health probe whenever --metrics-port is given.
     With --alert-rules, the freshness plane's SLO evaluator runs
     inside the sidecar (served at /alerts) — rule-file parse errors
     abort AT STARTUP with the offending line, so a typo can never take
-    a serving process down at runtime. Returns the MetricsServer
-    (caller closes it — the evaluator rides its lifecycle) or None."""
+    a serving process down at runtime. With --remote-write, a
+    history-plane RemoteWriter rides the sidecar too, pushing this
+    registry to the collector. Returns the MetricsServer (caller
+    closes it — evaluator and writer ride its lifecycle) or None."""
     if getattr(args, "alert_rules", None) is not None \
             and args.metrics_port is None:
         raise SystemExit(
             "error: --alert-rules requires --metrics-port (the "
             "evaluator runs inside the metrics sidecar)"
+        )
+    if getattr(args, "remote_write", None) is not None \
+            and args.metrics_port is None:
+        raise SystemExit(
+            "error: --remote-write requires --metrics-port (the "
+            "writer rides the metrics sidecar, and the sidecar "
+            "address is its source label)"
         )
     if args.metrics_port is None:
         return None
@@ -376,11 +406,25 @@ def _start_metrics(args, health=None):
                 from None
         except ValueError as e:
             raise SystemExit(f"error: {e}") from None
-        alerts = AlertEvaluator(rules)
+        alerts = AlertEvaluator(rules, series_source=series_source)
         print(f"alert evaluator armed: {len(rules)} rule(s) from "
               f"{args.alert_rules}")
     srv = MetricsServer(args.metrics_host, args.metrics_port,
-                        health=health, alerts=alerts).start()
+                        health=health, alerts=alerts, tsdb=tsdb)
+    if getattr(args, "remote_write", None) is not None:
+        from gol_tpu.obs.collector import RemoteWriter
+
+        # The sidecar's own bound address is the source label: it is
+        # unique per process on a host and is exactly how the console
+        # and the controller already name this endpoint.
+        srv.remote = RemoteWriter(
+            args.remote_write,
+            source=f"{srv.address[0]}:{srv.address[1]}",
+            alerts=alerts, secret=args.secret,
+        )
+        print(f"remote-write to {args.remote_write} "
+              f"(source {srv.remote.source})")
+    srv.start()
     print(f"metrics serving on http://{srv.address[0]}:{srv.address[1]}"
           "/metrics")
     return srv
@@ -452,6 +496,7 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     tracing.set_process_label(
         "control" if args.control is not None
+        else "collector" if args.collector is not None
         else "replay" if args.replay is not None
         else "serve" if args.serve is not None
         else "connect" if args.connect is not None else "local"
@@ -550,6 +595,33 @@ def main(argv: Optional[list[str]] = None) -> int:
             "error: --ws-port requires --relay (a root engine serves "
             "browsers through a co-located relay: start one with "
             "--relay HOST:PORT --serve PORT --ws-port N)"
+        )
+    if args.collector is not None:
+        # The history-plane collector is its own process mode: it
+        # stores telemetry ABOUT serving processes rather than being
+        # one, and --resume latest replays its own segment logs.
+        if (args.serve is not None or args.sessions
+                or args.relay is not None or args.connect is not None
+                or args.replay is not None or args.control is not None):
+            raise SystemExit(
+                "error: --collector is its own mode — it cannot "
+                "combine with --serve/--sessions/--relay/--connect/"
+                "--replay/--control"
+            )
+        if resume_path not in (None, "latest"):
+            raise SystemExit(
+                "error: a collector resumes its own segment logs "
+                "under <out>/tsdb; use --resume latest (or none)"
+            )
+        return _collector(args, resume_path == "latest")
+    if args.remote_write is not None and args.metrics_port is None:
+        # Before ANY mode dispatch: a silently ignored remote-write
+        # target would leave an operator believing telemetry is
+        # being collected.
+        raise SystemExit(
+            "error: --remote-write requires --metrics-port (the "
+            "writer rides the metrics sidecar, and the sidecar "
+            "address is its source label)"
         )
     if args.control is not None:
         # The fleet controller is its own process mode: it OWNS serving
@@ -1008,6 +1080,99 @@ def _control_plane(args) -> int:
     finally:
         if metrics is not None:
             metrics.close()
+    return 0
+
+
+def _collector(args, resume: bool) -> int:
+    """History-plane collector (gol_tpu.obs.collector + .tsdb;
+    docs/OBSERVABILITY.md "History plane"): ingest remote-write
+    telemetry from every sidecar into crash-atomic segment logs under
+    <out>/tsdb and serve range queries (/query, /history) from its own
+    metrics sidecar. Same exposure rules as --serve: loopback unless
+    an explicit HOST, --secret gates every remote-write attach.
+
+    --alert-rules here evaluate FLEET-WIDE: the evaluator reads the
+    collected series (each key tagged src="SOURCE") instead of the
+    collector's own registry, and after --resume latest the `for:`
+    clocks are seeded from stored history — a restart cannot reset a
+    breach that was already pending."""
+    import time as _time
+
+    from gol_tpu.obs import freshness as _freshness
+    from gol_tpu.obs.collector import CollectorServer
+    from gol_tpu.obs.tsdb import TSDB, eval_expr
+
+    host, port = _addr(args.collector, default_host="127.0.0.1")
+    root = os.path.join(args.out, "tsdb")
+    db = TSDB(root, resume=resume)
+    if resume:
+        print(f"resumed {len(db.sources())} source(s) from {root}/")
+    server = CollectorServer(host, port, db, secret=args.secret)
+    print(f"collector serving on "
+          f"{server.address[0]}:{server.address[1]} (store {root}/)")
+
+    def health():
+        last = db.last_sample_time()
+        return {
+            "status": "ok", "mode": "collector",
+            "sources": len(db.sources()),
+            "last_sample_age_s": (None if last is None
+                                  else round(_time.time() - last, 3)),
+        }
+
+    def fleet_series():
+        # Merged latest values across every source, each key tagged
+        # src="..." — `max(family)` in a rule means "worst source".
+        merged = {}
+        now = _time.time()
+        for src in db.sources():
+            for key, value in db.latest(src, max_age=60.0,
+                                        now=now).items():
+                name, brace, rest = key.partition("{")
+                if brace:
+                    merged[f'{name}{{src="{src}",{rest}'] = value
+                else:
+                    merged[f'{name}{{src="{src}"}}'] = value
+        return merged
+
+    # One try from here down: a SIGINT landing anywhere after the
+    # banner (even mid-seeding) must still reach the graceful close
+    # (final segment flushed), not escape as an uncaught interrupt.
+    metrics = None
+    try:
+        metrics = _start_metrics(args, health=health, tsdb=db,
+                                 series_source=fleet_series)
+        if metrics is not None and metrics.alerts is not None \
+                and resume:
+            ev = metrics.alerts
+            now_wall = _time.time()
+
+            def stored_values(rule):
+                # Ages relative to now, one point per evaluator
+                # interval over the trailing 2x `for:` window.
+                window = max(10.0, 2.0 * rule.for_secs)
+                step = max(1.0, ev.interval)
+                pts = eval_expr(db, rule.agg, rule.family,
+                                now_wall - window, now_wall, step)
+                return [(now_wall - t, v) for t, v in pts
+                        if v is not None]
+
+            seeded = ev.seed_history(stored_values)
+            if seeded:
+                print(f"seeded {seeded} for: rule(s) pending from "
+                      "stored history")
+        from gol_tpu.obs import flight as _flight
+
+        _flight.set_state_provider(health)
+        server.start()
+        while True:
+            _time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if metrics is not None:
+            metrics.close()
+        server.close()  # closes the TSDB (final segment flushed)
     return 0
 
 

@@ -32,6 +32,7 @@ Safety rules every verb obeys:
 from __future__ import annotations
 
 import contextlib
+import json
 import logging
 import os
 import random
@@ -42,6 +43,7 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from gol_tpu import obs
@@ -54,7 +56,7 @@ from gol_tpu.obs.scrape import Endpoint, fleet_snapshot
 
 log = logging.getLogger(__name__)
 
-__all__ = ["Controller", "repoint_relay"]
+__all__ = ["Controller", "engine_cost", "repoint_relay"]
 
 _RELAY_BANNER = re.compile(
     r"relay serving on ([\w.-]+:\d+) \(upstream [\w.-]+:\d+\)"
@@ -112,6 +114,28 @@ def repoint_relay(addr: str, new_upstream: str,
             sock.close()
 
 
+def engine_cost(out_dir: str) -> float:
+    """One engine's attributable load, read from its crash-safe usage
+    ledgers (accounting plane, <out>/usage): the seconds-denominated
+    resources summed across every principal — time an engine spent
+    working for tenants is the comparable currency across engines
+    (FLOPs and wire bytes scale with board geometry, not load). An
+    absent or torn ledger reads as 0: a fresh engine is the cheapest
+    by definition, which is exactly where a new session belongs."""
+    from gol_tpu.obs import accounting
+
+    totals = accounting.read_ledger(os.path.join(out_dir, "usage"))
+    cost = 0.0
+    for res in totals.values():
+        for key in ("dispatch_seconds", "host_seconds",
+                    "queue_frame_seconds"):
+            try:
+                cost += float(res.get(key, 0.0) or 0.0)
+            except (TypeError, ValueError):
+                continue
+    return cost
+
+
 class _CtlMetrics:
     def __init__(self, spec_name: str):
         obs.gauge(
@@ -143,6 +167,16 @@ class _CtlMetrics:
             "Destructive actions refused because the evidence scrape "
             "was older than stale_secs",
         )
+        self.scale_source = {
+            src: obs.counter(
+                "gol_tpu_controller_scale_decisions_total",
+                "Scale-rule evaluations by evidence source: 'history' "
+                "(canary turn-age queried from the collector, "
+                "sustained over canary_for_secs) or 'peers' (live "
+                "peer-count fallback)",
+                {"source": src},
+            ) for src in ("history", "peers")
+        }
         self.last_heal = obs.gauge(
             "gol_tpu_controller_last_heal_seconds",
             "Wall seconds the most recent heal took: dead-relay "
@@ -504,14 +538,64 @@ class Controller:
         return max(self.spec.relay_min,
                    min(self.spec.relay_max, want))
 
+    def _canary_age_points(self) -> Optional[List[Tuple[float, float]]]:
+        """The canary's MEASURED turn-age history over the trailing
+        `canary_for_secs` window, queried from the collector's /query
+        API: [(ts, age)], newest last — or None when no collector is
+        configured or the query fails (the caller falls back to the
+        live peer-count rule)."""
+        if self.spec.collector is None \
+                or self.spec.canary_max_age_s is None:
+            return None
+        window = max(2.0, self.spec.canary_for_secs)
+        step = max(0.5, window / 8.0)
+        url = (f"http://{self.spec.collector}/query"
+               f"?expr=max(gol_tpu_client_turn_age_seconds)"
+               f"&start=-{window}&end=-0&step={step}")
+        try:
+            with urllib.request.urlopen(url, timeout=2.0) as r:
+                payload = json.loads(r.read())
+            return [(float(p[0]), float(p[1]))
+                    for p in payload["series"][0]["points"]
+                    if p[1] is not None]
+        except Exception as e:
+            log.warning("collector query failed (%s): falling back "
+                        "to the peer-count scale rule", e)
+            return None
+
+    def _want_relays_from_history(self, have: int) -> Optional[int]:
+        """The SLO-history scale rule: grow when the canary's queried
+        turn age breached `canary_max_age_s` for the WHOLE window
+        (every recorded point — one noisy scrape holds, it never
+        pages a spawn), shrink when the whole window sat in deep
+        comfort (< 1/4 of the SLO). Anything in between — including a
+        window with too few points to judge — holds the current count.
+        None = no usable history; use the peer-count rule."""
+        points = self._canary_age_points()
+        if points is None or len(points) < 2:
+            return None
+        max_age = self.spec.canary_max_age_s
+        values = [v for _, v in points]
+        lo, hi = self.spec.relay_min, self.spec.relay_max
+        if all(v > max_age for v in values):
+            return max(lo, min(hi, have + 1))
+        if all(v < 0.25 * max_age for v in values):
+            return max(lo, min(hi, have - 1))
+        return max(lo, min(hi, have))
+
     def _plan_scale(self, rows: List[dict], tree: List[dict],
                     now: float) -> List[dict]:
         actions = []
         live_relays = [r for r in rows
                        if r.get("upstream") is not None
                        and r["listen"] not in self._retiring]
-        want = self._want_relays(rows)
         have = len(live_relays)
+        want = self._want_relays_from_history(have)
+        if want is not None:
+            self._metrics.scale_source["history"].inc()
+        else:
+            want = self._want_relays(rows)
+            self._metrics.scale_source["peers"].inc()
         # A node mid-debounce (missed a scrape but not yet confirmed
         # dead by down_rounds) makes `have` ambiguous: growing against
         # that dip double-provisions — the node either comes back (the
@@ -623,7 +707,9 @@ class Controller:
             if f"migrate:{sid}" in planned:
                 continue
             src = locations.get(sid)
-            if src is None or src == dst:
+            if dst == "auto":
+                dst = self._pick_auto_destination(src)
+            if src is None or src == dst or dst is None:
                 continue
             actions.append({
                 "verb": "migrate", "key": f"migrate:{sid}",
@@ -632,6 +718,22 @@ class Controller:
                     self._begin_migration(s, a, b),
             })
         return actions
+
+    def _pick_auto_destination(self, src: Optional[str]
+                               ) -> Optional[str]:
+        """Ledger-driven placement for `sessions[sid] == "auto"`: the
+        cheapest-loaded declared engine wins (accounting plane,
+        `engine_cost`). Ties break to the CURRENT location first — a
+        session never churns between equally-loaded engines — then
+        lexicographic addr, so the pick is deterministic for any
+        ledger state."""
+        if not self.spec.engines:
+            return None
+        ranked = sorted(
+            (engine_cost(e.out), e.addr != src, e.addr)
+            for e in self.spec.engines
+        )
+        return ranked[0][2]
 
     def _engine_evidence(self, addr: Optional[str]) -> Optional[str]:
         if addr is None:

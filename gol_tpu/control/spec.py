@@ -31,7 +31,12 @@ Full shape:
          "metrics": "HOST:PORT" | null,
          "spawn": false, "args": ["--platform", "cpu", ...]}
       ],
-      "sessions": {"SID": "ENGINE-ADDR", ...},  # desired placement
+      "sessions": {"SID": "ENGINE-ADDR" | "auto", ...},  # placement
+                                      # ("auto": cheapest engine by
+                                      #  the accounting-plane ledger)
+      "collector": "HOST:PORT" | null,  # history-plane collector:
+      "canary_max_age_s": 2.0,        #  scale on the canary's
+      "canary_for_secs": 10.0,        #  SUSTAINED measured turn age
       "roll_generation": 0,           # bump to roll managed engines
       "interval_secs": 2.0,           # reconcile cadence
       "stale_secs": 15.0,             # refuse to act on older scrapes
@@ -146,6 +151,16 @@ class FleetSpec:
         for sid, addr in sessions.items():
             if not isinstance(sid, str) or not sid:
                 raise SpecError(f"sessions: bad session id {sid!r}")
+            if addr == "auto":
+                # Ledger-driven placement: the controller picks the
+                # cheapest-loaded declared engine (accounting plane,
+                # deterministic tie-break) at reconcile time.
+                if not self.engines:
+                    raise SpecError(
+                        f"sessions[{sid!r}]: \"auto\" placement needs "
+                        "at least one declared engine"
+                    )
+                continue
             _addr(addr, f"sessions[{sid!r}]")
             if addr not in by_addr:
                 raise SpecError(
@@ -164,6 +179,26 @@ class FleetSpec:
             raw.get("down_rounds"), "down_rounds", 1, 2))
         self.actions_per_round = int(_num(
             raw.get("actions_per_round"), "actions_per_round", 1, 2))
+        # History plane (docs/OBSERVABILITY.md): with a collector
+        # declared, the scale rule reads the canary's MEASURED turn-age
+        # history from it — sustained breach over canary_for_secs
+        # grows the tree, sustained deep comfort shrinks it; no
+        # collector (or a failed query) falls back to raw peer counts.
+        collector = raw.get("collector")
+        if collector is not None:
+            collector = _addr(collector, "collector")
+        self.collector: Optional[str] = collector
+        max_age = raw.get("canary_max_age_s")
+        self.canary_max_age_s: Optional[float] = None \
+            if max_age is None \
+            else _num(max_age, "canary_max_age_s", 0.001, 0.0)
+        self.canary_for_secs = _num(
+            raw.get("canary_for_secs"), "canary_for_secs", 0.5, 10.0)
+        if self.canary_max_age_s is not None and collector is None:
+            raise SpecError(
+                "canary_max_age_s: needs a collector (the history "
+                "scale rule reads canary age from it)"
+            )
         alerts = raw.get("heal_alerts", [])
         if not (isinstance(alerts, list)
                 and all(isinstance(a, str) for a in alerts)):

@@ -107,6 +107,7 @@ from __future__ import annotations
 
 import base64
 import json
+import math
 import socket
 import struct
 import zlib
@@ -280,6 +281,7 @@ def _recv_exact(sock: socket.socket, n: int, allow_eof: bool) -> Optional[bytes]
 _TAG_FLIPS, _TAG_BOARD, _TAG_FINAL, _TAG_LFLIPS, _TAG_HB = 1, 2, 3, 4, 5
 _TAG_DFLIPS = 6
 _TAG_FBATCH = 7
+_TAG_MSAMPLES = 8
 _FLIPS_HDR = struct.Struct("<BQ")       # tag, turn
 _BOARD_HDR = struct.Struct("<BQIIQ")    # tag, turn, width, height, token
 _FINAL_HDR = struct.Struct("<BQ")       # tag, turn
@@ -407,6 +409,85 @@ def heartbeat_to_frame(turn: int) -> bytes:
     the wire) — carries the committed turn so an idle-attached client
     can still show progress. JSON peers get `{"t":"hb","turn":N}`."""
     return _HB_HDR.pack(_TAG_HB, turn)
+
+
+# --- remote-write metric samples (the history plane) ---
+
+#: tag, emit wall ts (epoch seconds), sample count, flags — then one
+#: zlib blob: JSON `{"s": [[key, value], ...], "m": {...}}`. Samples
+#: carry ABSOLUTE values of series that CHANGED since the sender's
+#: previous push ("delta-encoded" means delta in the series *set*,
+#: never in the values, so a lost frame can only delay a point — it
+#: can never corrupt later ones); a frame with MSAMPLES_FULL set
+#: carries the sender's whole registry (sent on (re)connect, and on a
+#: keyframe cadence, so the collector can seed segment keyframes).
+_MSAMPLES_HDR = struct.Struct("<BdII")
+MSAMPLES_FULL = 1
+#: Samples one frame may claim — a sidecar registry tops out in the
+#: hundreds of series; a header claiming more is an attack, not a peer.
+MSAMPLES_MAX = 1 << 16
+#: Longest series key (`name{labels}`) a sample may carry. Bounds the
+#: decompression allowance computed from the header's sample count, so
+#: a lying header cannot buy itself a big inflation budget.
+MSAMPLE_KEY_MAX = 512
+#: Allowance for the optional meta dict (alert state transitions and
+#: span digests ride along with the samples).
+MSAMPLES_META_MAX = 64 << 10
+
+
+def samples_to_frame(ts: float, samples, *, full: bool = False,
+                     meta: Optional[dict] = None) -> bytes:
+    """Assemble one _TAG_MSAMPLES frame from (key, value) pairs."""
+    obj = {"s": [[k, float(v)] for k, v in samples]}
+    if meta:
+        obj["m"] = meta
+    raw = json.dumps(obj, separators=(",", ":")).encode()
+    return (_MSAMPLES_HDR.pack(_TAG_MSAMPLES, ts, len(obj["s"]),
+                               MSAMPLES_FULL if full else 0)
+            + zlib.compress(raw, 1))
+
+
+def _parse_msamples(payload: bytes) -> dict:
+    _, ts, n, flags = _MSAMPLES_HDR.unpack_from(payload)
+    if n > MSAMPLES_MAX:
+        raise WireError(f"implausible sample count {n}")
+    if not math.isfinite(ts):
+        raise WireError("non-finite samples timestamp")
+    limit = 1024 + n * (MSAMPLE_KEY_MAX + 64) + MSAMPLES_META_MAX
+    raw = _decompress(payload[_MSAMPLES_HDR.size:], limit=limit)
+    try:
+        obj = json.loads(raw.decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireError(f"malformed samples payload: {e}") from None
+    entries = obj.get("s") if isinstance(obj, dict) else None
+    if not isinstance(entries, list):
+        raise WireError("samples payload carries no sample list")
+    if len(entries) != n:
+        raise WireError(
+            f"header says {n} samples, payload carries {len(entries)}"
+        )
+    samples = []
+    for item in entries:
+        if (not isinstance(item, list) or len(item) != 2
+                or not isinstance(item[0], str)
+                or not isinstance(item[1], (int, float))
+                or isinstance(item[1], bool)):
+            raise WireError("malformed sample entry")
+        key, value = item[0], float(item[1])
+        if len(key) > MSAMPLE_KEY_MAX:
+            raise WireError(
+                f"sample key of {len(key)} chars exceeds "
+                f"{MSAMPLE_KEY_MAX}"
+            )
+        if not math.isfinite(value):
+            raise WireError(f"non-finite sample value for {key!r}")
+        samples.append((key, value))
+    meta = obj.get("m", {})
+    if not isinstance(meta, dict):
+        raise WireError("samples meta is not an object")
+    return {"t": "msamples", "ts": ts,
+            "full": bool(flags & MSAMPLES_FULL),
+            "samples": samples, "meta": meta}
 
 
 # --- k-turn flip batches (negotiated via hello "batch") ---
@@ -666,6 +747,8 @@ def _parse_frame_inner(payload: bytes) -> dict:
                 "dwords": np.frombuffer(wraw, np.uint32)}
     if tag == _TAG_FBATCH:
         return _parse_fbatch(payload)
+    if tag == _TAG_MSAMPLES:
+        return _parse_msamples(payload)
     if tag == _TAG_HB:
         _, turn = _HB_HDR.unpack_from(payload)
         return {"t": "hb", "turn": turn}

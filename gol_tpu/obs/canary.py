@@ -394,7 +394,19 @@ def main(argv: Optional[list] = None) -> int:
                          "console scrapes the measured freshness")
     ap.add_argument("--metrics-host", default="127.0.0.1",
                     metavar="HOST")
+    ap.add_argument("--remote-write", default=None, dest="remote_write",
+                    metavar="HOST:PORT",
+                    help="with --metrics-port: push the measured "
+                         "freshness series to the history-plane "
+                         "collector at HOST:PORT — what the fleet "
+                         "controller's scale rule reads back as "
+                         "canary turn-age HISTORY "
+                         "(docs/OBSERVABILITY.md 'History plane')")
     args = ap.parse_args(argv)
+
+    if args.remote_write is not None and args.metrics_port is None:
+        ap.error("--remote-write requires --metrics-port (the writer "
+                 "rides the metrics sidecar)")
 
     from gol_tpu.obs import tracing
 
@@ -403,8 +415,19 @@ def main(argv: Optional[list] = None) -> int:
     if args.metrics_port is not None:
         from gol_tpu.obs.http import MetricsServer
 
-        metrics = MetricsServer(args.metrics_host,
-                                args.metrics_port).start()
+        metrics = MetricsServer(args.metrics_host, args.metrics_port)
+        if args.remote_write is not None:
+            from gol_tpu.obs.collector import RemoteWriter
+
+            metrics.remote = RemoteWriter(
+                args.remote_write,
+                source=f"canary@{metrics.address[0]}:"
+                       f"{metrics.address[1]}",
+                secret=args.secret,
+            )
+            print(f"remote-write to {args.remote_write} "
+                  f"(source {metrics.remote.source})")
+        metrics.start()
         print(f"metrics serving on http://{metrics.address[0]}:"
               f"{metrics.address[1]}/metrics")
     try:

@@ -50,8 +50,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
+from collections import deque
 from typing import List, Optional
 
 # The scrape + join layer moved to gol_tpu.obs.scrape (PR 18) so the
@@ -65,6 +67,7 @@ from gol_tpu.obs.scrape import (  # noqa: F401  (re-exports)
     build_tree,
     fleet_snapshot,
     histogram_buckets,
+    history_snapshot,
     label_value,
     max_series,
     merge_usage,
@@ -77,6 +80,7 @@ __all__ = [
     "build_tree",
     "fleet_snapshot",
     "histogram_buckets",
+    "history_snapshot",
     "label_value",
     "main",
     "merge_usage",
@@ -84,6 +88,7 @@ __all__ = [
     "render",
     "render_tree",
     "render_usage",
+    "spark",
     "sum_series",
 ]
 
@@ -111,10 +116,34 @@ def _num(v, unit: str = "") -> str:
     return f"{v:.1f}"
 
 
+#: Sparkline glyphs, lowest to highest.
+_SPARK_BARS = "▁▂▃▄▅▆▇█"
+
+
+def spark(points, width: int = 8) -> str:
+    """Unicode sparkline of a [[ts, value], ...] (or bare value) list
+    — the per-row turns/s history column. Min-max normalized; a flat
+    non-empty series renders mid-height so 'steady' and 'no data'
+    ('-') look different."""
+    vals = [(p[1] if isinstance(p, (list, tuple)) else p)
+            for p in (points or [])]
+    vals = [v for v in vals if v is not None][-width:]
+    if not vals:
+        return "-"
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK_BARS[3] * len(vals)
+    n = len(_SPARK_BARS) - 1
+    return "".join(
+        _SPARK_BARS[round((v - lo) / (hi - lo) * n)] for v in vals
+    )
+
+
 _COLUMNS = (
     ("endpoint", "ENDPOINT", 21, None),
     ("turn", "TURN", 9, ""),
     ("turns_per_sec", "TURNS/S", 9, ""),
+    ("spark", "HIST", 8, None),
     ("sessions", "SESS", 5, ""),
     ("peers", "PEERS", 5, ""),
     ("peer_lag", "LAG", 5, ""),
@@ -146,6 +175,8 @@ def _cells(row: dict) -> list:
             cells.append(name[:width])
         elif key == "sessions" and row.get("mode") == "replay":
             cells.append(_num(row.get("recordings"), unit))
+        elif key == "spark":
+            cells.append(spark(row.get("spark"))[:width])
         elif key in ("p50", "p95", "p99"):
             cells.append(_num(lat.get(key), "s"))
         else:
@@ -312,6 +343,16 @@ def render(snap: dict, out=None, clear: bool = False,
 # --- entry ---------------------------------------------------------------
 
 
+def _duration_secs(spec: str) -> float:
+    """'60s' / '5m' / '1h' / bare '90' -> seconds."""
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)\s*([smh]?)", spec.strip())
+    if not m:
+        raise ValueError(f"cannot parse duration {spec!r} "
+                         "(expected e.g. 60s, 5m, 1h)")
+    return float(m.group(1)) * {"": 1.0, "s": 1.0,
+                                "m": 60.0, "h": 3600.0}[m.group(2)]
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m gol_tpu.obs.console",
@@ -340,11 +381,46 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--principal", default=None, metavar="ID",
                     help="drill into one tenant: its usage share at "
                          "every scraped endpoint")
+    ap.add_argument("--since", default=None, metavar="DUR",
+                    help="render from the history plane instead of "
+                         "live scrapes: the single endpoint is a "
+                         "--collector sidecar, rows come from its "
+                         "/history window of DUR (e.g. 60s, 5m)")
     args = ap.parse_args(argv)
 
-    eps = [Endpoint(spec) for spec in args.endpoints]
+    if args.since is not None:
+        try:
+            since = _duration_secs(args.since)
+        except ValueError as e:
+            ap.error(str(e))
+        if len(args.endpoints) != 1:
+            ap.error("--since takes exactly one endpoint "
+                     "(the collector's metrics sidecar)")
+
+        def take_snapshot():
+            return history_snapshot(args.endpoints[0], since,
+                                    usage_sort=args.sort_usage)
+    else:
+        eps = [Endpoint(spec) for spec in args.endpoints]
+        #: Live-mode per-endpoint turns/s history feeding the HIST
+        #: sparkline column (the --since path gets its points from
+        #: the collector instead).
+        spark_hist: dict = {}
+
+        def take_snapshot():
+            snap = fleet_snapshot(eps, usage_sort=args.sort_usage)
+            for row in snap["rows"]:
+                if not row.get("up"):
+                    continue
+                ring = spark_hist.setdefault(
+                    row["endpoint"], deque(maxlen=16))
+                if row.get("turns_per_sec") is not None:
+                    ring.append(row["turns_per_sec"])
+                row["spark"] = list(ring)
+            return snap
+
     if args.once:
-        snap = fleet_snapshot(eps, usage_sort=args.sort_usage)
+        snap = take_snapshot()
         if args.as_json:
             snap = {**snap, "rows": [
                 {k: v for k, v in r.items() if k != "latency_buckets"}
@@ -362,7 +438,7 @@ def main(argv: Optional[list] = None) -> int:
         return 2 if snap["total"].get("alerts") else 0
     try:
         while True:
-            snap = fleet_snapshot(eps, usage_sort=args.sort_usage)
+            snap = take_snapshot()
             if args.as_json:
                 print(json.dumps(snap["total"]))
             else:

@@ -58,6 +58,7 @@ import logging
 import re
 import threading
 import time
+from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from gol_tpu import obs
@@ -384,7 +385,7 @@ class AlertRule:
 
     __slots__ = ("name", "agg", "family", "op", "threshold",
                  "for_secs", "raw", "state", "since", "firing_since",
-                 "last_value")
+                 "last_value", "history")
 
     def __init__(self, name: str, agg: str, family: str, op: str,
                  threshold: float, for_secs: float, raw: str):
@@ -399,6 +400,10 @@ class AlertRule:
         self.since: Optional[float] = None
         self.firing_since: Optional[float] = None
         self.last_value: Optional[float] = None
+        #: (ts, condition) samples — the recorded history `for:` is
+        #: judged against (see AlertEvaluator.eval_once /
+        #: seed_history). Bounded; pruned to ~2x the for window.
+        self.history: deque = deque(maxlen=512)
 
     def expr(self) -> str:
         base = (self.family if self.agg == "sum"
@@ -485,10 +490,16 @@ class AlertEvaluator:
 
     def __init__(self, rules: List[AlertRule], *,
                  registry: Optional[object] = None,
-                 interval: float = 1.0):
+                 interval: float = 1.0,
+                 series_source=None):
         self.rules = list(rules)
         self._registry = registry if registry is not None \
             else obs.registry()
+        #: Optional zero-arg callable returning a Series dict — the
+        #: collector points this at its TSDB's merged latest values,
+        #: so fleet-wide rules evaluate over COLLECTED series instead
+        #: of the collector's own registry.
+        self._series_source = series_source
         self.interval = max(0.05, interval)
         self._rate_prev: Dict[str, Tuple[float, float]] = {}
         #: Per-rule previous cumulative buckets: quantile rules are
@@ -606,9 +617,12 @@ class AlertEvaluator:
         from gol_tpu.obs.console import parse_prometheus
 
         now = time.monotonic() if now is None else now
-        if text is None:
-            text = self._registry.prometheus_text()
-        series = parse_prometheus(text)
+        if text is not None:
+            series = parse_prometheus(text)
+        elif self._series_source is not None:
+            series = self._series_source()
+        else:
+            series = parse_prometheus(self._registry.prometheus_text())
         with self._lock:
             firing = 0
             for rule in self.rules:
@@ -619,12 +633,22 @@ class AlertEvaluator:
                     v = None
                 rule.last_value = v
                 cond = v is not None and _OPS[rule.op](v, rule.threshold)
+                # `for:` is judged against recorded HISTORY, not just
+                # the consecutive-eval clock: the sample log below is
+                # what _sustained() reads, and what seed_history()
+                # pre-populates from the collector's store after a
+                # restart.
+                rule.history.append((now, cond))
+                horizon = now - max(60.0, 2.0 * rule.for_secs)
+                while rule.history and rule.history[0][0] < horizon:
+                    rule.history.popleft()
                 if cond:
                     if rule.state == "ok":
                         rule.state = "pending"
                         rule.since = now
                     if (rule.state == "pending"
-                            and now - rule.since >= rule.for_secs):
+                            and now - rule.since >= rule.for_secs
+                            and _sustained(rule, now)):
                         rule.state = "firing"
                         rule.firing_since = now
                         self._transitions["firing"].inc()
@@ -662,3 +686,56 @@ class AlertEvaluator:
         with self._lock:
             firing = sum(1 for r in self.rules if r.state == "firing")
             return self.payload_locked(firing)
+
+    def seed_history(self, values_fn, now: Optional[float] = None
+                     ) -> int:
+        """Seed each `for:` rule's condition history from STORED
+        samples (the collector calls this with its TSDB after
+        `--resume`): `values_fn(rule)` returns [(age_seconds, value),
+        ...] — ages relative to now, oldest first or not (sorted
+        here). A breach that was already N seconds old when this
+        evaluator (re)started keeps its pending credit, so a collector
+        restart cannot reset every `for:` clock; a recorded good
+        sample inside the window keeps blocking the page exactly as a
+        live one would. Returns how many rules were seeded pending."""
+        now = time.monotonic() if now is None else now
+        seeded = 0
+        with self._lock:
+            for rule in self.rules:
+                if not rule.for_secs:
+                    continue
+                try:
+                    samples = values_fn(rule)
+                except Exception:
+                    log.exception("history seed failed for rule %r",
+                                  rule.name)
+                    continue
+                if not samples:
+                    continue
+                run_start = None
+                for age, v in sorted(samples, key=lambda p: -p[0]):
+                    cond = v is not None \
+                        and _OPS[rule.op](v, rule.threshold)
+                    rule.history.append((now - age, cond))
+                    if cond:
+                        if run_start is None:
+                            run_start = now - age
+                    else:
+                        run_start = None
+                if run_start is not None and rule.state == "ok":
+                    rule.state = "pending"
+                    rule.since = run_start
+                    seeded += 1
+        return seeded
+
+
+def _sustained(rule: AlertRule, now: float) -> bool:
+    """True when every recorded condition sample inside the trailing
+    `for:` window held — the history-plane firing gate. With live-only
+    evaluation this agrees with the pending clock (a false sample
+    resets the state machine anyway); with seeded history it is the
+    stronger judge: one noisy recorded scrape inside the window blocks
+    the page until a clean window accrues."""
+    if not rule.for_secs:
+        return True
+    return all(c for t, c in rule.history if t >= now - rule.for_secs)

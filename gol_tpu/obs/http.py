@@ -27,7 +27,15 @@ library code): a ThreadingHTTPServer on its own daemon thread serving
               FLOPs, host encode seconds, wire bytes and queue
               occupancy per tenant, process totals, budget state —
               `{"enabled": false}` under GOL_TPU_ACCOUNTING=0, so a
-              biller can tell "disabled" from "idle".
+              biller can tell "disabled" from "idle";
+- `/query`    (collector sidecars only — `tsdb=` was passed) the
+              history plane's range-query API:
+              `?expr=rate(family)&start=&end=&step=[&source=]`,
+              epoch-second bounds (a value starting with "-" is
+              relative to now), grammar = the alert rules' aggs plus
+              `delta`; 404 with an explicit body elsewhere;
+- `/history`  (collector sidecars only) per-source window snapshots
+              the console's `--since` mode renders: `?since=SECS`.
 
 With the plane disabled (`GOL_TPU_METRICS=0`) the last two return an
 explicit `{"enabled": false}` payload so a scraper can tell "disabled"
@@ -64,14 +72,22 @@ class MetricsServer:
     OWNS it — `start()` starts its evaluation thread, `close()` stops
     it — and `/alerts` serves its JSON state. Without one, `/alerts`
     answers the explicit empty shape (a scraper must be able to tell
-    "no rules configured" from 404-means-old-build)."""
+    "no rules configured" from 404-means-old-build).
+
+    `tsdb` is an optional `tsdb.TSDB` (collector processes): `/query`
+    and `/history` serve its range queries; without one they 404 with
+    an explicit "no history store" body. `remote` is an optional
+    `collector.RemoteWriter`, owned like `alerts` (started/stopped
+    with the sidecar) — the `--remote-write` flag's plumbing."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  registry: Optional[Registry] = None,
                  health: Optional[Callable[[], dict]] = None,
-                 alerts=None):
+                 alerts=None, tsdb=None, remote=None):
         reg = registry if registry is not None else REGISTRY
         self.alerts = alerts
+        self.tsdb = tsdb
+        self.remote = remote
         srv = self  # the handler closes over the sidecar instance
 
         class _Handler(BaseHTTPRequestHandler):
@@ -127,6 +143,49 @@ class MetricsServer:
                                    indent=1).encode(),
                         "application/json",
                     )
+                elif path in ("/query", "/history"):
+                    db = srv.tsdb
+                    if db is None:
+                        self._reply(
+                            404,
+                            json.dumps({"error": "no history store "
+                                        "(not a --collector sidecar)"}
+                                       ).encode(),
+                            "application/json")
+                        return
+                    import time as _time
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+
+                    def _t(name, default):
+                        raw = q.get(name, [None])[0]
+                        if raw is None:
+                            return default
+                        v = float(raw)
+                        # "-60" means "60 s before now" — relative
+                        # bounds save every caller a clock read.
+                        return _time.time() + v if raw.startswith("-") \
+                            else v
+                    try:
+                        if path == "/history":
+                            body = db.history_payload(
+                                float(q.get("since", ["60"])[0]))
+                        else:
+                            body = db.query(
+                                q.get("expr", [""])[0],
+                                _t("start", _time.time() - 300.0),
+                                _t("end", _time.time()),
+                                float(q.get("step", ["5"])[0]),
+                                source=q.get("source", [None])[0],
+                            )
+                    except (ValueError, TypeError) as e:
+                        self._reply(
+                            400, json.dumps({"error": str(e)}).encode(),
+                            "application/json")
+                        return
+                    self._reply(200, json.dumps(body).encode(),
+                                "application/json")
                 elif path == "/healthz":
                     try:
                         info = dict(health()) if health is not None \
@@ -152,9 +211,13 @@ class MetricsServer:
         self._thread.start()
         if self.alerts is not None:
             self.alerts.start()
+        if self.remote is not None:
+            self.remote.start()
         return self
 
     def close(self) -> None:
+        if self.remote is not None:
+            self.remote.close()
         if self.alerts is not None:
             self.alerts.close()
         self._httpd.shutdown()

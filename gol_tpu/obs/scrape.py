@@ -460,6 +460,14 @@ def fleet_snapshot(endpoints: List[Endpoint],
                          "error": ep.last_error})
         else:
             rows.append(row)
+    return snapshot_from_rows(rows, down, len(endpoints), usage_sort)
+
+
+def snapshot_from_rows(rows: List[dict], down: List[str],
+                       n_endpoints: int,
+                       usage_sort: str = "flops") -> dict:
+    """Join already-built rows into the snapshot shape (`fleet_snapshot`
+    after its scrapes; `history_snapshot` from collector queries)."""
     live = [r for r in rows if r.get("up")]
 
     def total_of(key):
@@ -474,7 +482,7 @@ def fleet_snapshot(endpoints: List[Endpoint],
     alerts = [{"endpoint": r["endpoint"], "rule": rule}
               for r in live for rule in (r.get("alerts") or [])]
     total = {
-        "endpoints": len(endpoints),
+        "endpoints": n_endpoints,
         "up": len(live),
         "turns_per_sec": total_of("turns_per_sec"),
         "sessions": total_of("sessions"),
@@ -493,3 +501,44 @@ def fleet_snapshot(endpoints: List[Endpoint],
     return {"rows": rows, "total": total, "down": down,
             "tree": build_tree(rows),
             "usage": merge_usage(live, usage_sort)}
+
+
+def history_snapshot(collector: str, since: float,
+                     usage_sort: str = "flops") -> dict:
+    """The console's `--since` snapshot: rows rendered from a
+    collector's `/history` window payload instead of live scrapes.
+    One row per remote-writing source; the row builder is the SAME
+    `Endpoint._row` the live path uses (series dict in, row out), fed
+    the window-edge series the store returns — rates therefore come
+    from history, not from successive scrapes. The collector being
+    down is the one DOWN row (there is nothing else to ask)."""
+    spec = collector if "://" in collector else f"http://{collector}"
+    if re.fullmatch(r"\d+", collector):
+        spec = f"http://127.0.0.1:{collector}"
+    url = (f"{spec.rstrip('/')}/history?"
+           f"since={float(since):g}")
+    try:
+        with urllib.request.urlopen(url, timeout=_SCRAPE_TIMEOUT) as r:
+            payload = json.loads(r.read().decode("utf-8", "replace"))
+    except Exception as e:
+        return snapshot_from_rows(
+            [{"endpoint": collector, "up": False, "error": repr(e)}],
+            [collector], 1, usage_sort,
+        )
+    rows = []
+    for src in sorted(payload.get("sources") or {}):
+        h = payload["sources"][src]
+        ep = Endpoint(src)
+        prev = h.get("prev")
+        if prev:
+            ep.prev = (float(h.get("prev_ts") or 0.0), prev)
+        row = ep._row(h.get("series") or {}, float(h.get("ts") or 0.0))
+        row["endpoint"] = src
+        row["spark"] = h.get("spark") or []
+        row["events"] = h.get("events") or []
+        row["usage"] = None
+        rows.append(row)
+    snap = snapshot_from_rows(rows, [], len(rows), usage_sort)
+    snap["since"] = payload.get("since", since)
+    snap["collector"] = collector
+    return snap

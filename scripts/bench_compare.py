@@ -121,7 +121,20 @@ LOWER_BETTER = re.compile(
     # here so the lane's gate survives a rename of that token). The
     # lane's flatness ratio key deliberately avoids the `bytes` token
     # and stays informational.
-    r"|halo_bytes_per_host)", re.I
+    r"|halo_bytes_per_host"
+    # History plane (ISSUE 20): telemetry loss counters sit at 0 on a
+    # healthy bench box — a remote-writing sidecar shedding samples
+    # (`remote_write_shed_samples` / `remote_write_errors`) or a
+    # collector discarding frames (`collector_dropped_frames` rides
+    # the generic `dropped` token above; spelled here so the gate
+    # survives a rename) means the bench ran with a lossy telemetry
+    # link, an infinite regression off the zero baseline. Reconnects
+    # stay informational: a writer riding out a deliberate collector
+    # restart is the design working, not a regression. (The lookbehind
+    # keeps pu[shed]_samples — the volume counter — out of the gate.)
+    r"|(?<!pu)shed_samples|remote_write_errors"
+    r"|collector_dropped_frames)",
+    re.I,
 )
 INFORMATIONAL = re.compile(
     # Accounting lane (ISSUE 17): the per-leg throughputs and whatever

@@ -1,0 +1,383 @@
+#!/usr/bin/env bash
+# History-plane smoke (ISSUE 20 acceptance): boot a real engine and a
+# freshness canary, both remote-writing to a live --collector, then
+#   - rate(gol_tpu_engine_turns_total) queried from the collector's
+#     STORE over a 30s window matches the delta between two live
+#     scrapes of the engine bracketing the same window (<=10%);
+#   - a `for: 10s` rule evaluated fleet-wide on the collector goes
+#     pending BEFORE it fires and holds >=5s in between — one noisy
+#     scrape cannot page;
+#   - SIGKILL the collector MID-WRITE, restart it on the same ingest
+#     port with `--resume latest`: every pre-crash series answers
+#     /query (at most the torn tail lost) and the writers reconnect;
+#   - `console --since 30s --once --json` renders fleet rows from the
+#     restarted collector's history, not from live scrapes;
+#   - a fleet controller configured with the collector makes its scale
+#     decision from QUERIED canary turn-age history
+#     (scale_decisions_total{source="history"}), with zero action
+#     errors and zero invariant violations fleet-wide;
+#   - zero shed/dropped frames before the deliberate kill.
+#
+# Usage: scripts/collector_smoke.sh   (CPU-safe; ~2 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+LOG_COL=$(mktemp) LOG_ROOT=$(mktemp) LOG_CANARY=$(mktemp)
+LOG_COL2=$(mktemp) LOG_CTL=$(mktemp)
+OUT=$(mktemp -d)
+cleanup() {
+    for p in "${PID_CTL:-}" "${PID_CANARY:-}" "${PID_ROOT:-}" \
+             "${PID_COL2:-}" "${PID_COL:-}"; do
+        [ -n "$p" ] && kill -9 "$p" 2>/dev/null || true
+    done
+    for p in "${PID_CTL:-}" "${PID_CANARY:-}" "${PID_ROOT:-}" \
+             "${PID_COL2:-}" "${PID_COL:-}"; do
+        [ -n "$p" ] && wait "$p" 2>/dev/null || true
+    done
+    rm -rf "$LOG_COL" "$LOG_ROOT" "$LOG_CANARY" "$LOG_COL2" \
+        "$LOG_CTL" "$OUT"
+}
+trap cleanup EXIT
+
+wait_addr() {  # $1 log, $2 sed pattern -> prints host:port
+    local addr=""
+    for _ in $(seq 1 240); do
+        addr=$(sed -n "$2" "$1" | head -1)
+        [ -n "$addr" ] && break
+        sleep 0.5
+    done
+    if [ -z "$addr" ]; then
+        echo "collector smoke: FAILED — no address in $1:" >&2
+        cat "$1" >&2
+        exit 1
+    fi
+    echo "$addr"
+}
+
+# Fleet-wide rule on the collector: breaches the moment the engine's
+# collected turn counter passes 10, so the for: hold is observable
+# from the outside (pending first, firing >=10s later).
+cat > "$OUT/rules.txt" <<'EOF'
+sustained: max(gol_tpu_engine_turns_total) > 10 for 10s
+EOF
+
+python -m gol_tpu --collector 0 --metrics-port 0 --out "$OUT/col" \
+    --alert-rules "$OUT/rules.txt" >"$LOG_COL" 2>&1 &
+PID_COL=$!
+COL=$(wait_addr "$LOG_COL" \
+    's#^collector serving on \([^ ]*\) .*$#\1#p')
+COL_MX=$(wait_addr "$LOG_COL" \
+    's#^metrics serving on http://\([^/]*\)/metrics$#\1#p')
+echo "collector at $COL (metrics $COL_MX)"
+
+python -m gol_tpu --serve 127.0.0.1:0 -noVis -t 2 -w 256 -h 256 \
+    -turns 1000000000 --images fixtures/images --out "$OUT/root" \
+    --platform cpu --metrics-port 0 --remote-write "$COL" \
+    >"$LOG_ROOT" 2>&1 &
+PID_ROOT=$!
+ROOT=$(wait_addr "$LOG_ROOT" 's#^engine serving on \(.*\)$#\1#p')
+ROOT_MX=$(wait_addr "$LOG_ROOT" \
+    's#^metrics serving on http://\([^/]*\)/metrics$#\1#p')
+echo "engine at $ROOT (metrics $ROOT_MX), remote-writing to $COL"
+
+python -m gol_tpu.obs.canary "$ROOT" --interval 0.5 \
+    --metrics-port 0 --remote-write "$COL" >"$LOG_CANARY" 2>&1 &
+PID_CANARY=$!
+CANARY_MX=$(wait_addr "$LOG_CANARY" \
+    's#^metrics serving on http://\([^/]*\)/metrics$#\1#p')
+echo "canary up (metrics $CANARY_MX), remote-writing to $COL"
+
+# --- phase 1: live collection, rate() fidelity, the for: hold -------
+JAX_PLATFORMS=cpu python - "$ROOT_MX" "$COL_MX" "$OUT/phase1.json" \
+    <<'PYEOF'
+import json
+import sys
+import time
+import urllib.request
+
+ROOT_MX, COL_MX, STATE = sys.argv[1], sys.argv[2], sys.argv[3]
+
+
+def metric(base, name, *labels):
+    text = urllib.request.urlopen(f"http://{base}/metrics",
+                                  timeout=15).read().decode()
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            head = line.split(" ")[0]
+            if head == name or head.startswith(name + "{"):
+                if all(lb in head for lb in labels):
+                    total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def get_json(base, path):
+    with urllib.request.urlopen(f"http://{base}{path}",
+                                timeout=15) as r:
+        return json.loads(r.read())
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.25)
+    raise SystemExit(f"collector smoke: FAILED — timed out waiting "
+                     f"for {what}")
+
+
+# Both writers visible in the store (engine + canary sources).
+wait_for(lambda: len(get_json(COL_MX, "/history?since=30")
+                     .get("sources") or {}) >= 2,
+         90, "2 remote-writing sources in /history")
+print("collector sees %d sources"
+      % len(get_json(COL_MX, "/history?since=30")["sources"]))
+
+# The for: hold, watched from outside: pending strictly before
+# firing, with the hold in between. Poll alongside the rate window.
+first_pending = first_firing = None
+t1 = time.time()
+v1 = metric(ROOT_MX, "gol_tpu_engine_turns_total")
+deadline = time.monotonic() + 45
+while time.monotonic() < deadline:
+    rules = get_json(COL_MX, "/alerts").get("rules", [])
+    state = rules[0]["state"] if rules else "?"
+    now = time.monotonic()
+    if state in ("pending", "firing") and first_pending is None:
+        first_pending = now
+    if state == "firing" and first_firing is None:
+        first_firing = now
+        break
+    time.sleep(0.5)
+assert first_pending is not None, "rule never left ok"
+assert first_firing is not None, "rule never fired"
+hold = first_firing - first_pending
+assert hold >= 5.0, (
+    f"for: 10s fired after only {hold:.1f}s of observed hold"
+)
+print(f"for: hold OK — pending {hold:.1f}s before firing")
+
+# rate() fidelity: two live scrapes bracket the stored window.
+while time.time() - t1 < 30.0:
+    time.sleep(0.5)
+t2 = time.time()
+v2 = metric(ROOT_MX, "gol_tpu_engine_turns_total")
+rate_live = (v2 - v1) / (t2 - t1)
+q = get_json(
+    COL_MX,
+    f"/query?expr=rate(gol_tpu_engine_turns_total)"
+    f"&start={t1:.3f}&end={t2:.3f}&step={t2 - t1:.3f}",
+)
+pts = [v for _, v in q["series"][0]["points"] if v is not None]
+assert pts, f"no stored rate over [{t1}, {t2}]: {q}"
+rate_hist = pts[-1]
+drift = abs(rate_hist - rate_live) / max(rate_live, 1e-9)
+assert drift <= 0.10, (
+    f"stored rate {rate_hist:.2f}/s vs live {rate_live:.2f}/s "
+    f"({drift:.1%} apart)"
+)
+print(f"rate OK — stored {rate_hist:.2f}/s vs live {rate_live:.2f}/s "
+      f"({drift:.1%})")
+
+# Nothing shed, nothing dropped before the deliberate kill.
+shed = metric(ROOT_MX, "gol_tpu_remote_write_shed_samples_total")
+assert shed == 0, f"engine shed {shed} samples with a live collector"
+dropped = metric(COL_MX, "gol_tpu_collector_dropped_frames_total")
+assert dropped == 0, f"collector dropped {dropped} frames"
+refused = metric(COL_MX, "gol_tpu_tsdb_dropped_samples_total")
+assert refused == 0, f"store refused {refused} samples"
+
+with open(STATE, "w") as f:
+    json.dump({"t1": t1, "t2": t2, "rate_live": rate_live,
+               "hold": hold}, f)
+print("phase 1 PASS")
+PYEOF
+
+# --- phase 2: SIGKILL mid-write, resume, history survives -----------
+echo "SIGKILLing the collector mid-write (pid $PID_COL)"
+kill -9 "$PID_COL"
+wait "$PID_COL" 2>/dev/null || true
+PID_COL=""
+sleep 2   # writers notice, shed, back off
+
+python -m gol_tpu --collector "$COL" --metrics-port 0 \
+    --out "$OUT/col" --resume latest \
+    --alert-rules "$OUT/rules.txt" >"$LOG_COL2" 2>&1 &
+PID_COL2=$!
+COL2_MX=$(wait_addr "$LOG_COL2" \
+    's#^metrics serving on http://\([^/]*\)/metrics$#\1#p')
+grep -q "^resumed " "$LOG_COL2" \
+    || { echo "collector smoke: FAILED — no resume banner" >&2;
+         cat "$LOG_COL2" >&2; exit 1; }
+echo "collector restarted on $COL (metrics $COL2_MX): $(grep '^resumed ' "$LOG_COL2")"
+
+JAX_PLATFORMS=cpu python - "$COL2_MX" "$OUT/phase1.json" <<'PYEOF'
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+COL2_MX, STATE = sys.argv[1], sys.argv[2]
+with open(STATE) as f:
+    p1 = json.load(f)
+
+
+def get_json(base, path):
+    with urllib.request.urlopen(f"http://{base}{path}",
+                                timeout=15) as r:
+        return json.loads(r.read())
+
+
+# Every pre-crash sample window still answers: the SAME bracketed
+# window phase 1 measured live must replay to the same rate.
+q = get_json(
+    COL2_MX,
+    f"/query?expr=rate(gol_tpu_engine_turns_total)"
+    f"&start={p1['t1']:.3f}&end={p1['t2']:.3f}"
+    f"&step={p1['t2'] - p1['t1']:.3f}",
+)
+pts = [v for _, v in q["series"][0]["points"] if v is not None]
+assert pts, f"pre-crash window lost across SIGKILL+resume: {q}"
+drift = abs(pts[-1] - p1["rate_live"]) / max(p1["rate_live"], 1e-9)
+assert drift <= 0.10, (
+    f"pre-crash rate drifted across resume: stored {pts[-1]:.2f}/s "
+    f"vs live {p1['rate_live']:.2f}/s"
+)
+print(f"pre-crash window OK after SIGKILL+resume "
+      f"({pts[-1]:.2f}/s, {drift:.1%} drift)")
+
+# Writers reconnect on their own jittered backoff (which kept
+# DOUBLING while the restarted process was still importing, so this
+# can legitimately take ~45s) and FRESH samples land — gate on a
+# stored point inside the trailing 5s, not on stale pre-crash ones.
+def fresh(family):
+    q = get_json(COL2_MX, f"/query?expr=max({family})"
+                          "&start=-5&end=-0&step=5")
+    return any(v is not None
+               for _, v in q["series"][0]["points"])
+
+
+t0 = time.monotonic()
+deadline = t0 + 120
+families = ["gol_tpu_engine_turns_total",
+            "gol_tpu_client_turn_age_seconds"]  # engine + canary
+while time.monotonic() < deadline:
+    families = [f for f in families if not fresh(f)]
+    if not families:
+        break
+    time.sleep(1.0)
+else:
+    raise SystemExit("collector smoke: FAILED — writers never "
+                     f"reconnected after restart ({families} "
+                     "still stale)")
+print(f"writers reconnected with fresh samples "
+      f"{time.monotonic() - t0:.1f}s after the resume probe")
+
+# The console renders the fleet from HISTORY (no live scrapes).
+# 30s window, not 60: the window's far edge must land where the
+# engine HAS samples (it only started pushing ~45s ago and spent
+# ~10s of that in the kill/restart gap), else prev is empty and the
+# rate column legitimately renders as '-'.
+p = subprocess.run(
+    [sys.executable, "-m", "gol_tpu.obs.console", COL2_MX,
+     "--since", "30s", "--once", "--json"],
+    capture_output=True, text=True)
+assert p.returncode in (0, 2), p.stderr
+snap = json.loads(p.stdout)
+assert snap.get("since") == 30.0
+rows = {r["endpoint"]: r for r in snap["rows"]}
+eng = [r for r in rows.values()
+       if (r.get("turns_per_sec") or 0) > 0]
+assert eng, f"no engine row with a history-derived rate: {rows}"
+assert any(r.get("spark") for r in rows.values()), \
+    "no HIST sparkline points in --since rows"
+print("console --since OK: %d rows from history" % len(rows))
+PYEOF
+
+# --- phase 3: the controller scales on queried canary history -------
+cat > "$OUT/fleet.json" <<EOF
+{
+  "root": "$ROOT",
+  "scrape": ["$ROOT_MX", "$CANARY_MX"],
+  "relays": {"min": 0, "max": 2, "observers_per_relay": 64},
+  "collector": "$COL2_MX",
+  "canary_max_age_s": 5.0,
+  "canary_for_secs": 4.0,
+  "interval_secs": 0.5,
+  "stale_secs": 10.0,
+  "actions_per_round": 1,
+  "spawn_args": ["--platform", "cpu"]
+}
+EOF
+python -m gol_tpu --control "$OUT/fleet.json" --out "$OUT/ctl" \
+    --metrics-port 0 >"$LOG_CTL" 2>&1 &
+PID_CTL=$!
+CTL_MX=$(wait_addr "$LOG_CTL" \
+    's#^metrics serving on http://\([^/]*\)/metrics$#\1#p')
+echo "controller up (metrics $CTL_MX), scale rule reading $COL2_MX"
+
+JAX_PLATFORMS=cpu python - "$ROOT_MX" "$CANARY_MX" "$COL2_MX" \
+    "$CTL_MX" "$OUT/phase1.json" <<'PYEOF'
+import json
+import sys
+import time
+import urllib.request
+
+ROOT_MX, CANARY_MX, COL2_MX, CTL_MX = sys.argv[1:5]
+with open(sys.argv[5]) as f:
+    p1 = json.load(f)
+
+
+def metric(base, name, *labels):
+    text = urllib.request.urlopen(f"http://{base}/metrics",
+                                  timeout=15).read().decode()
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            head = line.split(" ")[0]
+            if head == name or head.startswith(name + "{"):
+                if all(lb in head for lb in labels):
+                    total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.25)
+    raise SystemExit(f"collector smoke: FAILED — timed out waiting "
+                     f"for {what}")
+
+
+# The scale decision must come from QUERIED canary turn-age history,
+# not the peer-count fallback.
+wait_for(lambda: metric(CTL_MX,
+                        "gol_tpu_controller_scale_decisions_total",
+                        'source="history"') >= 2,
+         60, "history-driven scale decisions")
+hist = metric(CTL_MX, "gol_tpu_controller_scale_decisions_total",
+              'source="history"')
+print(f"scale decisions from history: {hist:.0f}")
+
+errors = metric(CTL_MX, "gol_tpu_controller_actions_total",
+                'outcome="error"')
+assert errors == 0, f"controller action errors: {errors}"
+for mx in (ROOT_MX, CANARY_MX, COL2_MX, CTL_MX):
+    v = metric(mx, "gol_tpu_invariant_violations_total")
+    assert v == 0, f"invariant violations on {mx}: {v}"
+
+print(json.dumps({"collector_smoke": {
+    "rate_live_turns_per_sec": round(p1["rate_live"], 3),
+    "for_hold_seconds": round(p1["hold"], 3),
+    "history_scale_decisions": int(hist),
+    "action_errors": int(errors),
+    "invariant_violations": 0,
+}}))
+print("COLLECTOR SMOKE PASS")
+PYEOF
+
+echo "collector smoke: PASS"

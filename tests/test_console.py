@@ -266,7 +266,7 @@ def test_replay_server_renders_as_distinct_row_not_broken():
     # The table cell plane: SESS shows recordings, endpoint is marked.
     cells = console._cells(row)
     assert "⟲" in cells[0]
-    assert cells[3] == "2"  # SESS column
+    assert cells[4] == "2"  # SESS column (HIST sits at 3)
     # Tree tag: a replay node is labeled, not mistaken for an engine
     # root.
     tree = console.build_tree([row])
@@ -292,3 +292,93 @@ def test_zero_recordings_gauge_keeps_engine_row():
     assert row["mode"] is None
     assert row["turn"] == 777
     assert "⟲" not in console._cells(row)[0]
+
+
+# --- the history plane's console surfaces (ISSUE 20) --------------------
+
+
+def test_spark_renders_shape_not_noise():
+    assert console.spark([]) == "-"
+    assert console.spark([[1.0, None], [2.0, None]]) == "-"
+    flat = console.spark([[t, 5.0] for t in range(4)])
+    assert len(flat) == 4 and len(set(flat)) == 1, (
+        "steady series renders mid-height, one glyph repeated"
+    )
+    ramp = console.spark([[t, float(t)] for t in range(8)])
+    assert len(ramp) == 8
+    assert ramp[0] != ramp[-1], "min-max normalized ramp must slope"
+    # Bare values work too, and the window clips to the last `width`.
+    assert len(console.spark(list(range(100)), width=8)) == 8
+
+
+@pytest.mark.parametrize("spec,secs", [
+    ("60s", 60.0), ("5m", 300.0), ("1h", 3600.0),
+    ("90", 90.0), (" 2.5m ", 150.0),
+])
+def test_duration_secs_parses(spec, secs):
+    assert console._duration_secs(spec) == pytest.approx(secs)
+
+
+@pytest.mark.parametrize("spec", ["", "5x", "m", "-3s", "1h30m"])
+def test_duration_secs_rejects(spec):
+    with pytest.raises(ValueError):
+        console._duration_secs(spec)
+
+
+def test_since_mode_renders_rows_from_collector_history():
+    """End-to-end --since path: a TSDB with collected sources behind a
+    MetricsServer /history endpoint; history_snapshot builds the same
+    row shape the live path does (rates from stored window edges, the
+    HIST sparkline from the stored turns rate) and `main --since
+    --once --json` emits it."""
+    import time as _time
+
+    from gol_tpu.obs.scrape import history_snapshot
+    from gol_tpu.obs.tsdb import TSDB
+
+    db = TSDB()
+    now = _time.time()
+    for i in range(31):
+        db.append("eng:8001", now - 31 + i, [
+            ('gol_tpu_server_listen_addr{addr="127.0.0.1:8001"}', 1.0),
+            ("gol_tpu_engine_committed_turn", 100.0 + 8 * i),
+            ("gol_tpu_engine_turns_total", 8.0 * i),
+            ("gol_tpu_server_peers", 3.0),
+        ], walltime=now - 31 + i)
+    srv = MetricsServer("127.0.0.1", 0, tsdb=db).start()
+    try:
+        addr = f"{srv.address[0]}:{srv.address[1]}"
+        snap = history_snapshot(addr, 20.0)
+        assert snap["down"] == []
+        (row,) = snap["rows"]
+        assert row["endpoint"] == "eng:8001"
+        assert row["peers"] == 3
+        assert row["turns_per_sec"] == pytest.approx(8.0, rel=0.2), (
+            "rate must come from the stored window edges"
+        )
+        assert [v for _, v in row["spark"]], "HIST points from history"
+        # The CLI surface over the same store.
+        out = io.StringIO()
+        import contextlib
+        with contextlib.redirect_stdout(out):
+            code = console.main(
+                [addr, "--since", "20s", "--once", "--json"])
+        assert code == 0
+        payload = json.loads(out.getvalue())
+        assert payload["rows"][0]["endpoint"] == "eng:8001"
+        assert payload["since"] == pytest.approx(20.0)
+    finally:
+        srv.close()
+
+
+def test_since_mode_collector_down_is_the_down_row():
+    from gol_tpu.obs.scrape import history_snapshot
+
+    import socket as _socket
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    snap = history_snapshot(f"127.0.0.1:{port}", 30.0)
+    assert snap["rows"] == [] or not snap["rows"][0].get("up", True)
+    assert snap["down"], "a dead collector must render as DOWN"

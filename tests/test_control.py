@@ -744,3 +744,217 @@ def test_migration_controller_crash_resumes_idempotently(tmp_path):
     finally:
         sa.shutdown()
         sb.shutdown()
+
+
+# --- history plane: SLO-history-driven fleet control (ISSUE 20) ----------
+
+
+def test_spec_history_plane_fields_validate():
+    s = FleetSpec({
+        "root": "127.0.0.1:8100",
+        "collector": "127.0.0.1:9300",
+        "canary_max_age_s": 2.0,
+        "canary_for_secs": 8.0,
+    })
+    assert s.collector == "127.0.0.1:9300"
+    assert s.canary_max_age_s == 2.0 and s.canary_for_secs == 8.0
+    # Defaults: no collector, no SLO, 10 s window.
+    d = FleetSpec({"root": "127.0.0.1:8100"})
+    assert d.collector is None and d.canary_max_age_s is None
+    assert d.canary_for_secs == 10.0
+    # The SLO without a collector to read it from is a dead knob.
+    with pytest.raises(SpecError, match="canary_max_age_s"):
+        FleetSpec({"root": "127.0.0.1:8100", "canary_max_age_s": 2.0})
+    # "auto" placement needs at least one engine to place onto.
+    with pytest.raises(SpecError, match="sessions"):
+        FleetSpec({"root": "127.0.0.1:8100",
+                   "sessions": {"s1": "auto"}})
+
+
+def _history_ctl(tmp_path, seed=0, **extra):
+    raw = {
+        "root": "127.0.0.1:8100",
+        "relays": {"min": 0, "max": 4, "observers_per_relay": 64},
+        "collector": "127.0.0.1:9300",
+        "canary_max_age_s": 2.0,
+        "canary_for_secs": 6.0,
+        "actions_per_round": 4,
+    }
+    raw.update(extra)
+    return _ctl(tmp_path, raw, seed=seed)
+
+
+def test_scale_grows_on_sustained_canary_age_breach(
+        tmp_path, monkeypatch):
+    """With a collector configured, the scale rule reads the canary's
+    QUERIED turn-age history: every point in the window over the SLO
+    grows the tree even though raw peer counts ask for nothing."""
+    ctl = _history_ctl(tmp_path)
+    spawned = []
+    monkeypatch.setattr(
+        Controller, "_spawn_relay",
+        lambda self, up: (spawned.append(up)
+                          or ("127.0.0.1:7009", "127.0.0.1:9109")))
+    monkeypatch.setattr(
+        Controller, "_canary_age_points",
+        lambda self: [(1.0, 5.0), (2.0, 4.0), (3.0, 6.0)])
+    s = ctl.reconcile_once(snapshot=_snap(), now=1000.0)
+    assert [a for a in s["applied"]
+            if a["verb"] == "scale" and a["ok"]], s
+    assert spawned == ["127.0.0.1:8100"]
+    ctl.shutdown()
+
+
+def test_scale_holds_when_canary_flaps_one_round(
+        tmp_path, monkeypatch):
+    """THE pin for the history rule's point: one noisy sample inside
+    the window — a single breach among good points, or a single good
+    point among breaches — fires NO scale action. A live-scrape rule
+    would have paged on the spike."""
+    ctl = _history_ctl(tmp_path)
+    monkeypatch.setattr(
+        Controller, "_spawn_relay",
+        lambda self, up: pytest.fail("flap must not spawn"))
+    for flapped in (
+        [(1.0, 0.1), (2.0, 5.0), (3.0, 0.1)],   # one-round spike
+        [(1.0, 5.0), (2.0, 0.1), (3.0, 5.0)],   # one-round dip
+        [(1.0, 5.0)],                           # too thin to judge:
+    ):                                          # peer fallback = 0
+        monkeypatch.setattr(Controller, "_canary_age_points",
+                            lambda self, pts=flapped: pts)
+        s = ctl.reconcile_once(snapshot=_snap(), now=1000.0)
+        assert not [a for a in s["applied"] if a["verb"] == "scale"], (
+            flapped, s)
+    ctl.shutdown()
+
+
+def test_scale_falls_back_to_peer_counts_without_history(
+        tmp_path, monkeypatch):
+    """A dead collector (query returns None) must not blind the
+    controller: the peer-count rule still grows an overloaded tree."""
+    ctl = _history_ctl(tmp_path,
+                       relays={"min": 0, "max": 4,
+                               "observers_per_relay": 2})
+    spawned = []
+    monkeypatch.setattr(
+        Controller, "_spawn_relay",
+        lambda self, up: (spawned.append(up)
+                          or ("127.0.0.1:7009", "127.0.0.1:9109")))
+    monkeypatch.setattr(Controller, "_canary_age_points",
+                        lambda self: None)
+    root = {"endpoint": "127.0.0.1:9100", "up": True,
+            "listen": "127.0.0.1:8100", "upstream": None,
+            "peers": 5, "relay_peers": None, "ws_peers": None,
+            "alerts": []}
+    s = ctl.reconcile_once(snapshot=_snap([root]), now=1000.0)
+    assert [a for a in s["applied"]
+            if a["verb"] == "scale" and a["ok"]], s
+    assert len(spawned) >= 1
+    ctl.shutdown()
+
+
+def test_scale_shrinks_on_sustained_deep_comfort(
+        tmp_path, monkeypatch):
+    """The whole window under a quarter of the SLO retires one
+    controller-spawned relay (drain-then-kill, as ever)."""
+    ctl = _history_ctl(tmp_path)
+    ctl.manifest.record_spawn("relays", "127.0.0.1:7001",
+                              "127.0.0.1:9101", None)
+    ctl._last_ok["127.0.0.1:9101"] = 1000.0
+    retired = []
+    monkeypatch.setattr(
+        Controller, "_retire",
+        lambda self, listen, rows: retired.append(listen))
+    monkeypatch.setattr(
+        Controller, "_canary_age_points",
+        lambda self: [(1.0, 0.1), (2.0, 0.2), (3.0, 0.1)])
+    r1 = _relay_row("127.0.0.1:9101", "127.0.0.1:7001",
+                    "127.0.0.1:8100")
+    s = ctl.reconcile_once(snapshot=_snap([r1]), now=1000.0)
+    assert retired == ["127.0.0.1:7001"], s
+    ctl.shutdown()
+
+
+def _ledger(tmp_path, name, seconds):
+    d = tmp_path / name / "usage"
+    d.mkdir(parents=True, exist_ok=True)
+    import json as _json
+    (d / "usage-0.jsonl").write_text(
+        _json.dumps({"principal": "t1",
+                     "res": {"dispatch_seconds": seconds}}) + "\n")
+    return str(tmp_path / name)
+
+
+def test_auto_placement_picks_cheapest_ledger_engine(tmp_path):
+    """sessions[sid] == "auto": the migrate planner reads each
+    declared engine's usage ledger and the cheapest-loaded engine
+    wins; ties break to the session's CURRENT engine (no churn), then
+    lexicographic addr — deterministic for any ledger state."""
+    out_a = _ledger(tmp_path, "a", 5.0)
+    out_b = _ledger(tmp_path, "b", 1.0)
+    ctl = _ctl(tmp_path, {
+        "root": "127.0.0.1:8100",
+        "engines": [
+            {"addr": "127.0.0.1:9001", "out": out_a},
+            {"addr": "127.0.0.1:9002", "out": out_b},
+        ],
+        "sessions": {"s1": "auto"},
+    })
+    # B is cheaper: a session observed on A plans a migration to B.
+    assert ctl._pick_auto_destination("127.0.0.1:9001") \
+        == "127.0.0.1:9002"
+    # Already on the cheapest engine: stays (src == dst, no action).
+    assert ctl._pick_auto_destination("127.0.0.1:9002") \
+        == "127.0.0.1:9002"
+    # Equal ledgers: the current location wins — no churn on ties.
+    (tmp_path / "a" / "usage" / "usage-0.jsonl").write_text(
+        (tmp_path / "b" / "usage" / "usage-0.jsonl").read_text())
+    assert ctl._pick_auto_destination("127.0.0.1:9001") \
+        == "127.0.0.1:9001"
+    # No current location (fresh create): lexicographic tie-break.
+    assert ctl._pick_auto_destination(None) == "127.0.0.1:9001"
+    # Torn/absent ledgers read as 0 — never raise.
+    (tmp_path / "b" / "usage" / "usage-0.jsonl").write_bytes(
+        b'{"principal": "t1", "res": {"dispa')
+    assert ctl._pick_auto_destination(None) == "127.0.0.1:9002", (
+        "an engine with an empty (torn) ledger is the cheapest"
+    )
+    ctl.shutdown()
+
+
+def test_auto_placement_plans_migration_via_reconcile(
+        tmp_path, monkeypatch):
+    out_a = _ledger(tmp_path, "a", 5.0)
+    out_b = _ledger(tmp_path, "b", 1.0)
+    ctl = _ctl(tmp_path, {
+        "root": "127.0.0.1:8100",
+        "engines": [
+            {"addr": "127.0.0.1:9001", "out": out_a,
+             "metrics": "127.0.0.1:9101"},
+            {"addr": "127.0.0.1:9002", "out": out_b},
+        ],
+        "sessions": {"s1": "auto"},
+        "actions_per_round": 4,
+    })
+    monkeypatch.setattr(
+        Controller, "_session_locations",
+        lambda self: {"s1": "127.0.0.1:9001"})
+    begun = []
+    monkeypatch.setattr(
+        Controller, "_begin_migration",
+        lambda self, sid, src, dst: begun.append((sid, src, dst)))
+    row = {"endpoint": "127.0.0.1:9101", "up": True, "listen": None,
+           "upstream": None, "peers": 0, "relay_peers": None,
+           "ws_peers": None, "alerts": []}
+    ctl._last_ok["127.0.0.1:9101"] = 1000.0  # fresh source evidence
+    s = ctl.reconcile_once(snapshot=_snap([row]), now=1000.0)
+    assert begun == [("s1", "127.0.0.1:9001", "127.0.0.1:9002")], s
+    # On the cheapest already: level-triggered quiescence.
+    begun.clear()
+    monkeypatch.setattr(
+        Controller, "_session_locations",
+        lambda self: {"s1": "127.0.0.1:9002"})
+    s = ctl.reconcile_once(snapshot=_snap([row]), now=1002.0)
+    assert begun == [] and not [a for a in s["applied"]
+                                if a["verb"] == "migrate"], s
+    ctl.shutdown()

@@ -721,3 +721,80 @@ def test_ws_canary_measures_gateway_freshness(tmp_path):
     finally:
         relay.shutdown()
         srv.shutdown()
+
+
+# --- `for:` against recorded history (ISSUE 20) -------------------------
+
+
+def test_seeded_history_keeps_pending_credit_across_restart():
+    """A breach already 1.5s old at (re)start keeps its clock: a
+    fresh evaluator seeded from stored samples fires after only the
+    REMAINING 0.5s of live breach, not a full fresh window."""
+    ev = fr.AlertEvaluator(
+        fr.parse_rules("hot: gol_tpu_x_total > 5 for 2s"))
+    try:
+        t0 = 1000.0
+        seeded = ev.seed_history(
+            lambda rule: [(1.5, 9.0), (1.0, 9.0), (0.5, 9.0)], now=t0)
+        assert seeded == 1
+        assert ev.rules[0].state == "pending"
+        p = ev.eval_once(now=t0 + 0.6, text="gol_tpu_x_total 9\n")
+        assert p["rules"][0]["state"] == "firing", (
+            "stored breach age + live breach must cross for:"
+        )
+    finally:
+        ev.close()
+
+
+def test_seeded_noisy_sample_blocks_the_page():
+    """One recorded GOOD sample inside the window: the restart grants
+    no pending credit past it — the rule must re-serve the hold."""
+    ev = fr.AlertEvaluator(
+        fr.parse_rules("hot: gol_tpu_x_total > 5 for 2s"))
+    try:
+        t0 = 1000.0
+        seeded = ev.seed_history(
+            lambda rule: [(1.5, 9.0), (1.0, 1.0), (0.5, 9.0)], now=t0)
+        assert seeded == 1  # pending since the 0.5s-old breach
+        p = ev.eval_once(now=t0 + 0.6, text="gol_tpu_x_total 9\n")
+        assert p["rules"][0]["state"] == "pending", (
+            "the recorded dip restarted the for: clock"
+        )
+        p = ev.eval_once(now=t0 + 2.0, text="gol_tpu_x_total 9\n")
+        assert p["rules"][0]["state"] == "firing"
+    finally:
+        ev.close()
+
+
+def test_seeded_all_clear_history_grants_nothing():
+    ev = fr.AlertEvaluator(
+        fr.parse_rules("hot: gol_tpu_x_total > 5 for 2s"))
+    try:
+        assert ev.seed_history(
+            lambda rule: [(1.0, 1.0), (0.5, 2.0)], now=1000.0) == 0
+        assert ev.rules[0].state == "ok"
+    finally:
+        ev.close()
+
+
+def test_series_source_drives_fleet_wide_rules():
+    """A collector evaluator reads MERGED collected series (each key
+    src-tagged) instead of its own registry: max() judges the worst
+    source."""
+    fleet = {}
+    ev = fr.AlertEvaluator(
+        fr.parse_rules("lag: max(gol_tpu_age_seconds) > 2 for 1s"),
+        series_source=lambda: dict(fleet))
+    try:
+        fleet['gol_tpu_age_seconds{src="a"}'] = 0.5
+        fleet['gol_tpu_age_seconds{src="b"}'] = 9.0
+        p = ev.eval_once(now=1000.0)
+        assert p["rules"][0]["state"] == "pending"
+        assert p["rules"][0]["value"] == 9.0
+        p = ev.eval_once(now=1001.1)
+        assert p["rules"][0]["state"] == "firing"
+        fleet['gol_tpu_age_seconds{src="b"}'] = 0.1
+        p = ev.eval_once(now=1002.0)
+        assert p["rules"][0]["state"] == "ok"
+    finally:
+        ev.close()

@@ -1,0 +1,544 @@
+"""The history plane (ISSUE 20): TSDB store + collector ingest.
+
+Pins the contracts the rest of the plane builds on:
+
+- STORE: absolute-value samples land in bounded per-series rings;
+  non-monotone timestamps and cardinality floods are DROPPED (counted,
+  never raised); range queries implement the alert grammar's aggs plus
+  `delta`, with `rate()` exact on synthetic counters and `pNN` built
+  on the registry's shared bucket-merge quantile code.
+- SEGMENTS: crash-atomic keyframe-indexed logs, the replay-plane
+  recorder discipline — every truncation point of a segment yields a
+  clean PREFIX of its records (torn tail dropped, nothing invented),
+  `--resume` replays to the last good sample, and a real SIGKILL
+  mid-write loses at most the half-written record (satellite 4).
+- COLLECTOR: remote-write frames from a live RemoteWriter land in the
+  store; a hostile link dies ALONE (the good link and the query side
+  keep serving); a dead collector sheds samples at the writer without
+  ever blocking the serving process.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from gol_tpu.distributed import wire
+from gol_tpu.obs.collector import CollectorServer, RemoteWriter
+from gol_tpu.obs.registry import Registry
+from gol_tpu.obs.tsdb import (
+    TSDB,
+    eval_expr,
+    parse_expr,
+    read_records,
+    scan_segments,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- expr grammar --------------------------------------------------------
+
+
+@pytest.mark.parametrize("expr,agg,family", [
+    ("gol_tpu_engine_turns_total", "sum", "gol_tpu_engine_turns_total"),
+    ("rate(x_total)", "rate", "x_total"),
+    ("delta(x_total)", "delta", "x_total"),
+    ("p99(lat_seconds)", "p99", "lat_seconds"),
+    (" max(age_s) ", "max", "age_s"),
+])
+def test_parse_expr_accepts_alert_grammar_plus_delta(expr, agg, family):
+    assert parse_expr(expr) == (agg, family)
+
+
+@pytest.mark.parametrize("expr", [
+    "", "rate()", "p42(x)", "rate(x", "sum(a b)", "x{lbl=\"v\"}",
+    "frob(x)", "rate(rate(x))",
+])
+def test_parse_expr_rejects_garbage(expr):
+    with pytest.raises(ValueError):
+        parse_expr(expr)
+
+
+# --- the in-memory store -------------------------------------------------
+
+
+def test_rate_query_exact_on_synthetic_counter():
+    db = TSDB()
+    for i in range(30):
+        db.append("e1", 1000.0 + i, [("turns_total", 10.0 * i)])
+    out = db.query("rate(turns_total)", 1005.0, 1025.0, 5.0)
+    pts = [v for _, v in out["series"][0]["points"] if v is not None]
+    assert pts and all(v == pytest.approx(10.0) for v in pts), out
+
+
+def test_rate_guards_counter_resets():
+    db = TSDB()
+    # A process restart rewinds the counter; rate must clamp, not
+    # report a huge negative (or bogus positive) spike.
+    values = [0, 50, 100, 5, 55]
+    for i, v in enumerate(values):
+        db.append("e1", 1000.0 + 10 * i, [("c_total", float(v))])
+    pts = eval_expr(db, "rate", "c_total", 1000.0, 1040.0, 10.0)
+    vals = [v for _, v in pts if v is not None]
+    assert all(v >= 0 for v in vals), pts
+
+
+def test_sum_max_delta_across_sources():
+    db = TSDB()
+    for i in range(11):
+        db.append("a", 1000.0 + i, [("g", 1.0 + i)])
+        db.append("b", 1000.0 + i, [("g", 100.0)])
+    assert eval_expr(db, "sum", "g", 1009.0, 1010.0, 1.0)[-1][1] \
+        == pytest.approx(111.0)
+    assert eval_expr(db, "max", "g", 1009.0, 1010.0, 1.0)[-1][1] \
+        == pytest.approx(100.0)
+    # delta over one source: raw difference across the step.
+    d = eval_expr(db, "delta", "g", 1000.0, 1010.0, 5.0, source="a")
+    assert d[-1][1] == pytest.approx(5.0)
+    # source= restricts.
+    q = db.query("max(g)", 1009.0, 1010.0, 1.0, source="a")
+    assert q["series"][0]["source"] == "a"
+    assert q["series"][0]["points"][-1][1] == pytest.approx(11.0)
+
+
+def test_quantile_query_merges_buckets_windowed():
+    db = TSDB()
+    # Cumulative histogram counts growing over time; p95 judges the
+    # per-step WINDOW (observations since the previous step).
+    for i in range(21):
+        db.append("e1", 1000.0 + i, [
+            ('lat_seconds_bucket{le="0.1"}', 100.0 * i),
+            ('lat_seconds_bucket{le="1"}', 100.0 * i + i),
+            ('lat_seconds_bucket{le="+Inf"}', 100.0 * i + i),
+        ])
+    pts = eval_expr(db, "p95", "lat_seconds", 1010.0, 1020.0, 5.0)
+    vals = [v for _, v in pts if v is not None]
+    # ~99% of window observations land in the 0.1 bucket.
+    assert vals and all(v <= 0.1 for v in vals), pts
+
+
+def test_non_monotone_dropped_and_cardinality_bounded():
+    db = TSDB(max_series=4)
+    assert db.append("e1", 1000.0, [("a", 1.0)]) == 1
+    assert db.append("e1", 999.0, [("a", 2.0)]) == 0, "rewind dropped"
+    assert db.append("e1", 1000.0, [("a", 2.0)]) == 0, "equal-ts dropped"
+    assert db.latest("e1")["a"] == 1.0
+    for i in range(10):
+        db.append("e1", 1001.0, [(f"flood_{i}", 1.0)])
+    assert len(db.latest("e1")) <= 4, "hostile cardinality bounded"
+
+
+def test_query_rejects_bad_ranges_and_huge_grids():
+    db = TSDB()
+    with pytest.raises(ValueError):
+        db.query("x", 10.0, 5.0, 1.0)
+    with pytest.raises(ValueError):
+        db.query("x", 0.0, 10.0, 0.0)
+    with pytest.raises(ValueError):
+        db.query("x", 0.0, 1e9, 1.0)
+
+
+def test_history_payload_shape_for_console_since():
+    db = TSDB()
+    for i in range(20):
+        db.append("eng", 1000.0 + i,
+                  [("gol_tpu_engine_turns_total", 8.0 * i),
+                   ("gol_tpu_server_peers", 3.0)],
+                  walltime=1000.0 + i)
+    h = db.history_payload(10.0, now=1019.0)
+    row = h["sources"]["eng"]
+    assert row["series"]["gol_tpu_server_peers"] == 3.0
+    assert row["prev"]["gol_tpu_engine_turns_total"] \
+        < row["series"]["gol_tpu_engine_turns_total"]
+    spark = [v for _, v in row["spark"]]
+    assert spark and all(v == pytest.approx(8.0) for v in spark)
+
+
+# --- segments: recorder discipline --------------------------------------
+
+
+def _fill(root, n=12, source="e1"):
+    db = TSDB(str(root))
+    for i in range(n):
+        db.append(source, 1000.0 + i,
+                  [("turns_total", 5.0 * i), ("age_s", 0.25)])
+    db.close()
+    return db
+
+
+def test_resume_replays_to_last_good_sample(tmp_path):
+    _fill(tmp_path / "tsdb")
+    db2 = TSDB(str(tmp_path / "tsdb"), resume=True)
+    assert db2.sources() == ["e1"]
+    assert db2.latest("e1")["turns_total"] == 55.0
+    # History (not only the last value) survives: rate still answers.
+    pts = eval_expr(db2, "rate", "turns_total", 1005.0, 1011.0, 3.0)
+    assert [v for _, v in pts if v is not None], pts
+    # And the resumed store keeps appending monotonically.
+    assert db2.append("e1", 2000.0, [("turns_total", 60.0)]) == 1
+    db2.close()
+
+
+def test_boot_without_resume_starts_empty(tmp_path):
+    _fill(tmp_path / "tsdb")
+    db2 = TSDB(str(tmp_path / "tsdb"))
+    assert db2.sources() == []
+    db2.close()
+
+
+def test_every_truncation_point_yields_a_clean_prefix(tmp_path):
+    """The satellite-3 sweep at the record layer: cut the segment at
+    EVERY byte offset — the reader never raises and yields a strict
+    prefix of the intact record list (the torn tail simply drops)."""
+    _fill(tmp_path / "tsdb", n=8)
+    (_, path), = scan_segments(str(tmp_path / "tsdb"))
+    blob = open(path, "rb").read()
+    whole = list(read_records(path))
+    assert len(whole) == 9  # opening keyframe + 8 samples
+    cut_path = tmp_path / "cut.tlog"
+    prefix_lens = set()
+    for cut in range(len(blob) + 1):
+        cut_path.write_bytes(blob[:cut])
+        got = list(read_records(str(cut_path)))
+        assert got == whole[:len(got)], f"invented records at cut {cut}"
+        prefix_lens.add(len(got))
+    assert prefix_lens == set(range(10)), (
+        "every prefix length must be reachable — records are "
+        "independently framed"
+    )
+
+
+def test_resume_drops_only_the_torn_tail(tmp_path):
+    _fill(tmp_path / "tsdb", n=8)
+    (_, path), = scan_segments(str(tmp_path / "tsdb"))
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-7])  # SIGKILL mid-record
+    db2 = TSDB(str(tmp_path / "tsdb"), resume=True)
+    assert db2.latest("e1")["turns_total"] == 30.0, (
+        "all but the torn last record must survive"
+    )
+    db2.close()
+
+
+def test_keyframe_keeps_slow_series_across_rolls_and_eviction(
+        tmp_path):
+    """Each segment opens with a keyframe of every live series, so a
+    series that last moved N segments ago still answers after the
+    older segments are EVICTED."""
+    root = str(tmp_path / "tsdb")
+    db = TSDB(root, segment_bytes=2048, max_bytes=8192,
+              retention_secs=0.5)
+    db.append("e1", 1000.0, [("slow_gauge", 42.0)])
+    for i in range(400):
+        db.append("e1", 1001.0 + i, [("fast_total", float(i))])
+    assert len(scan_segments(root)) >= 2, "rolls must have happened"
+    db.close()
+    db2 = TSDB(root, resume=True)
+    assert db2.latest("e1")["slow_gauge"] == 42.0
+    db2.close()
+
+
+_SIGKILL_CHILD = """\
+import sys, time
+from gol_tpu.obs.tsdb import TSDB
+
+db = TSDB(sys.argv[1], segment_bytes=4096)
+i = 0
+while True:
+    i += 1
+    db.append("eng:1", 1000.0 + 0.25 * i,
+              [("turns_total", 4.0 * i), ("age_s", 0.5)])
+    if i == 200:
+        print("READY", flush=True)
+    time.sleep(0.0005)
+"""
+
+
+def _run_and_sigkill(root) -> None:
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SIGKILL_CHILD, str(root)],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert b"READY" in line, proc.stderr.read().decode()
+        time.sleep(0.1)  # let more records land mid-flush
+    finally:
+        proc.kill()  # SIGKILL: no close(), no final flush
+        proc.wait(timeout=30)
+
+
+def test_collector_store_survives_sigkill_mid_write(tmp_path):
+    """Satellite 4, store half: SIGKILL the writer mid-append, resume,
+    and every pre-crash series is queryable with the 4-per-0.25s
+    counter ratio intact — the torn tail dropped, never corrupted."""
+    root = tmp_path / "tsdb"
+    _run_and_sigkill(root)
+    db = TSDB(str(root), resume=True)
+    latest = db.latest("eng:1")
+    assert latest["turns_total"] >= 4.0 * 200
+    assert latest["age_s"] == 0.5
+    # Absolute values + monotone guard: the replayed history still
+    # answers an exact rate (4 per 0.25 s = 16/s).
+    end = 1000.0 + latest["turns_total"] / 4.0 * 0.25
+    pts = eval_expr(db, "rate", "turns_total", end - 20.0, end - 4.0,
+                    4.0, source="eng:1")
+    vals = [v for _, v in pts if v is not None]
+    assert vals and all(v == pytest.approx(16.0) for v in vals), pts
+    db.close()
+    # Second incarnation: a restart appends to FRESH segments; a
+    # second SIGKILL still resumes to a superset.
+    _run_and_sigkill(root)
+    db2 = TSDB(str(root), resume=True)
+    assert db2.latest("eng:1")["turns_total"] >= latest["turns_total"]
+    db2.close()
+
+
+# --- collector ingest ----------------------------------------------------
+
+
+def _drain(writer, n=3):
+    for _ in range(n):
+        writer.push_once()
+        time.sleep(0.05)
+
+
+def test_remote_writer_roundtrip_and_delta_encoding(tmp_path):
+    reg = Registry()
+    c = reg.counter("t_total", "t")
+    g = reg.gauge("steady_gauge", "t")
+    g.set(7.0)
+    db = TSDB()
+    srv = CollectorServer("127.0.0.1", 0, db).start()
+    try:
+        rw = RemoteWriter(f"127.0.0.1:{srv.address[1]}",
+                          source="eng:1", registry=reg)
+        try:
+            c.inc(5)
+            assert rw.push_once()
+            time.sleep(0.2)
+            assert db.latest("eng:1")["t_total"] == 5.0
+            assert db.latest("eng:1")["steady_gauge"] == 7.0
+            # Delta encoding is in the series SET: an unchanged gauge
+            # stays home, a moved counter crosses again (absolute).
+            c.inc(5)
+            assert rw.push_once()
+            time.sleep(0.2)
+            assert db.latest("eng:1")["t_total"] == 10.0
+        finally:
+            rw.close()
+    finally:
+        srv.close()
+    # The frame count is bounded by what changed, pinned indirectly:
+    # the second push accepted only the moved counter.
+
+
+def test_hostile_link_dies_alone_collector_keeps_serving(tmp_path):
+    db = TSDB()
+    srv = CollectorServer("127.0.0.1", 0, db).start()
+    try:
+        # A peer that sends framed garbage after a valid hello.
+        bad = socket.create_connection(srv.address, timeout=5)
+        wire.send_msg(bad, {"t": "hello", "mode": "remote-write",
+                            "source": "evil", "binary": True})
+        assert wire.recv_msg(bad).get("t") == "attach-ack"
+        bad.sendall(b"\x00\x00\x00\x05hello")
+        # A peer with a lying hello is refused with a reason.
+        liar = socket.create_connection(srv.address, timeout=5)
+        wire.send_msg(liar, {"t": "hello", "mode": "observe",
+                             "source": "x"})
+        assert wire.recv_msg(liar, allow_binary=False)["t"] == "error"
+        liar.close()
+        # The good link and the store still serve.
+        reg = Registry()
+        reg.counter("ok_total", "t").inc(3)
+        rw = RemoteWriter(f"127.0.0.1:{srv.address[1]}",
+                          source="good", registry=reg)
+        try:
+            assert rw.push_once()
+            time.sleep(0.2)
+            assert db.latest("good")["ok_total"] == 3.0
+        finally:
+            rw.close()
+        bad.close()
+    finally:
+        srv.close()
+
+
+def test_secret_gates_remote_write_attach():
+    db = TSDB()
+    srv = CollectorServer("127.0.0.1", 0, db, secret="hunter2").start()
+    try:
+        reg = Registry()
+        reg.counter("x_total", "t").inc()
+        wrong = RemoteWriter(f"127.0.0.1:{srv.address[1]}",
+                             source="eng:1", registry=reg,
+                             secret="nope")
+        assert not wrong.push_once(), "wrong secret must shed"
+        wrong.close()
+        right = RemoteWriter(f"127.0.0.1:{srv.address[1]}",
+                             source="eng:1", registry=reg,
+                             secret="hunter2")
+        try:
+            assert right.push_once()
+            time.sleep(0.2)
+            assert db.latest("eng:1")["x_total"] == 1.0
+        finally:
+            right.close()
+    finally:
+        srv.close()
+
+
+def test_dead_collector_sheds_and_backs_off_never_blocks():
+    reg = Registry()
+    c = reg.counter("x_total", "t")
+    # Nothing listens here: every push must shed fast and count it.
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    rw = RemoteWriter(f"127.0.0.1:{port}", source="eng:1",
+                      registry=reg)
+    import importlib
+
+    from gol_tpu.obs.scrape import parse_prometheus
+    _global = importlib.import_module("gol_tpu.obs.registry")
+
+    def shed_count():
+        return parse_prometheus(
+            _global.registry().prometheus_text()
+        ).get("gol_tpu_remote_write_shed_samples_total", 0.0)
+
+    try:
+        before = shed_count()
+        t0 = time.monotonic()
+        c.inc()
+        assert rw.push_once() is False
+        assert time.monotonic() - t0 < 4.0, "a dead link must not hang"
+        assert shed_count() > before, "shed samples must be counted"
+        # Backoff: an immediate retry is refused without dialing.
+        t1 = time.monotonic()
+        c.inc()
+        rw.push_once()
+        assert time.monotonic() - t1 < 0.5, "backoff window must skip "\
+            "the connect attempt entirely"
+    finally:
+        rw.close()
+
+
+def test_query_http_endpoints_serve_and_reject(tmp_path):
+    from gol_tpu.obs.http import MetricsServer
+
+    db = TSDB()
+    for i in range(10):
+        db.append("e1", time.time() - 10 + i, [("g_total", 2.0 * i)])
+    srv = MetricsServer("127.0.0.1", 0, tsdb=db).start()
+    try:
+        base = f"http://{srv.address[0]}:{srv.address[1]}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                return r.status, json.loads(r.read())
+
+        code, q = get("/query?expr=max(g_total)&start=-30&end=-0&step=5")
+        assert code == 200
+        assert [v for _, v in q["series"][0]["points"]
+                if v is not None]
+        code, h = get("/history?since=30")
+        assert code == 200 and "e1" in h["sources"]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/query?expr=frob(x)&start=-30&end=-0&step=5")
+        assert e.value.code == 400
+        assert "error" in json.loads(e.value.read())
+    finally:
+        srv.close()
+
+
+def test_query_404_without_store():
+    from gol_tpu.obs.http import MetricsServer
+
+    srv = MetricsServer("127.0.0.1", 0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://{srv.address[0]}:{srv.address[1]}/query?"
+                "expr=x&start=-1&end=-0&step=1", timeout=5).read()
+        assert e.value.code == 404
+        assert "no history store" in json.loads(e.value.read())["error"]
+    finally:
+        srv.close()
+
+
+def test_collector_sigkill_restart_serves_precrash_series(tmp_path):
+    """Satellite 4, process half: SIGKILL the collector PROCESS while
+    a live writer streams into it, restart with --resume latest, and
+    every pre-crash series answers /query (same shape as the replay
+    plane's crash tests)."""
+    out = tmp_path / "col"
+    cmd = [sys.executable, "-m", "gol_tpu", "--collector", "0",
+           "--metrics-port", "0", "--out", str(out)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+
+    def boot(resume):
+        proc = subprocess.Popen(
+            cmd + (["--resume", "latest"] if resume else []),
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        ports = {}
+        deadline = time.time() + 60
+        while time.time() < deadline and len(ports) < 2:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            import re as _re
+            m = _re.search(r"collector serving on [\w.-]+:(\d+)", line)
+            if m:
+                ports["ingest"] = int(m.group(1))
+            m = _re.search(r"metrics serving on http://[\w.-]+:(\d+)",
+                           line)
+            if m:
+                ports["http"] = int(m.group(1))
+        assert len(ports) == 2, "collector banners not seen"
+        return proc, ports
+
+    proc, ports = boot(resume=False)
+    reg = Registry()
+    c = reg.counter("crash_total", "t")
+    rw = RemoteWriter(f"127.0.0.1:{ports['ingest']}", source="eng:1",
+                      registry=reg, interval=0.05)
+    rw.start()
+    try:
+        for _ in range(40):
+            c.inc(3)
+            time.sleep(0.02)
+        time.sleep(0.3)  # a few frames land + flush
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        proc2, ports2 = boot(resume=True)
+        try:
+            url = (f"http://127.0.0.1:{ports2['http']}"
+                   "/query?expr=max(crash_total)&start=-120&end=-0"
+                   "&step=5&source=eng:1")
+            with urllib.request.urlopen(url, timeout=5) as r:
+                q = json.loads(r.read())
+            vals = [v for _, v in q["series"][0]["points"]
+                    if v is not None]
+            assert vals and max(vals) >= 3.0, (
+                "pre-crash series must be queryable after restart", q)
+        finally:
+            proc2.send_signal(signal.SIGINT)
+            code = proc2.wait(timeout=30)
+            tail = proc2.stdout.read()
+            assert code == 0, f"collector SIGINT exit {code}: {tail}"
+    finally:
+        rw.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

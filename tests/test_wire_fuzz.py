@@ -861,9 +861,10 @@ def test_fbatch_unknown_blob_codec_rejected():
 
 
 def test_fbatch_unknown_future_tag_still_ignorable():
-    """Tag 8 (one past FBATCH) keeps the forward-compat contract: a
-    peer newer than this code must not kill the reader."""
-    assert wire._parse_frame(bytes([8]) + b"beyond")["t"] == "bin8"
+    """Tag 9 (one past MSAMPLES, the lowest unassigned tag) keeps the
+    forward-compat contract: a peer newer than this code must not kill
+    the reader."""
+    assert wire._parse_frame(bytes([9]) + b"beyond")["t"] == "bin9"
 
 
 def test_fbatch_straddling_board_sync_applies_only_the_suffix():
@@ -1594,3 +1595,194 @@ def test_hostile_json_fbatch_fails_the_link_cleanly(bad):
         ctl.close()
         listener.close()
         t.join(timeout=5)
+
+
+# --- remote-write sample frames (ISSUE 20: the history plane) ---
+#
+# The collector's ingest reads these from every sidecar in the fleet;
+# a lying or corrupt frame must die as a WireError that kills ONE
+# link, never the TSDB or the query side (tests/test_tsdb.py pins the
+# server half of that contract — here we pin the decoder itself).
+
+
+def _msamples_frame(ts=100.0, n=8, full=False, meta=None):
+    samples = [(f'gol_tpu_fuzz_{i}{{le="{i}"}}', float(i)) for i in
+               range(n)]
+    return wire.samples_to_frame(ts, samples, full=full, meta=meta), \
+        samples
+
+
+def test_msamples_roundtrip_exact():
+    frame, samples = _msamples_frame(
+        ts=123.5, full=True, meta={"alerts": [{"rule": "r",
+                                               "from": "ok",
+                                               "to": "firing"}]},
+    )
+    out = wire._parse_frame(frame)
+    assert out["t"] == "msamples"
+    assert out["ts"] == 123.5 and out["full"] is True
+    assert out["samples"] == samples
+    assert out["meta"]["alerts"][0]["to"] == "firing"
+    # Delta frames: full flag off, no meta.
+    out = wire._parse_frame(_msamples_frame()[0])
+    assert out["full"] is False and out["meta"] == {}
+
+
+def test_msamples_truncation_sweep_raises_wireerror():
+    frame, _ = _msamples_frame()
+    for cut in range(1, len(frame)):
+        try:
+            wire._parse_frame(frame[:cut])
+        except wire.WireError:
+            continue
+        raise AssertionError(
+            f"truncation at byte {cut} decoded without error"
+        )
+
+
+def test_msamples_seeded_corruption_never_escapes_wireerror():
+    frame, _ = _msamples_frame()
+    rng = np.random.default_rng(20)
+    for _ in range(300):
+        buf = bytearray(frame)
+        for _ in range(int(rng.integers(1, 4))):
+            buf[int(rng.integers(1, len(buf)))] = int(rng.integers(256))
+        try:
+            wire._parse_frame(bytes(buf))
+        except wire.WireError:
+            pass  # rejection is the contract (see fbatch sweep note)
+
+
+def test_msamples_lying_sample_count_rejected():
+    frame, _ = _msamples_frame(n=8)
+    buf = bytearray(frame)
+    # header: <BdII — count lives at offset 9
+    struct.pack_into("<I", buf, 9, 7)
+    with pytest.raises(wire.WireError, match="header says"):
+        wire._parse_frame(bytes(buf))
+    struct.pack_into("<I", buf, 9, 9)
+    with pytest.raises(wire.WireError, match="header says"):
+        wire._parse_frame(bytes(buf))
+    # An implausible count is refused BEFORE it buys any
+    # decompression allowance.
+    struct.pack_into("<I", buf, 9, wire.MSAMPLES_MAX + 1)
+    with pytest.raises(wire.WireError, match="implausible"):
+        wire._parse_frame(bytes(buf))
+
+
+def test_msamples_non_finite_timestamp_rejected():
+    for ts in (float("nan"), float("inf"), float("-inf")):
+        frame = wire._MSAMPLES_HDR.pack(
+            wire._TAG_MSAMPLES, ts, 0, 0,
+        ) + zlib.compress(b'{"s":[]}', 1)
+        with pytest.raises(wire.WireError, match="timestamp"):
+            wire._parse_frame(frame)
+
+
+def test_msamples_non_finite_value_and_bad_entries_rejected():
+    payloads = [
+        {"s": [["k", float("nan")]]},
+        {"s": [["k", float("inf")]]},
+        {"s": [["k", True]]},          # bool is not a sample value
+        {"s": [["k"]]},                # arity lie
+        {"s": [[3, 1.0]]},             # non-string key
+        {"s": [["k", 1.0]], "m": []},  # meta must be an object
+        {"s": "not-a-list"},
+        {"x": []},                     # no sample list at all
+    ]
+    import json as _json
+
+    for obj in payloads:
+        raw = _json.dumps(obj).encode()
+        n = len(obj["s"]) if isinstance(obj.get("s"), list) else 0
+        frame = wire._MSAMPLES_HDR.pack(
+            wire._TAG_MSAMPLES, 100.0, n, 0,
+        ) + zlib.compress(raw, 1)
+        with pytest.raises(wire.WireError):
+            wire._parse_frame(frame)
+
+
+def test_msamples_oversized_key_rejected_both_sides():
+    long_key = "k" * (wire.MSAMPLE_KEY_MAX + 1)
+    import json as _json
+
+    raw = _json.dumps({"s": [[long_key, 1.0]]}).encode()
+    frame = wire._MSAMPLES_HDR.pack(
+        wire._TAG_MSAMPLES, 100.0, 1, 0,
+    ) + zlib.compress(raw, 1)
+    with pytest.raises(wire.WireError, match="exceeds"):
+        wire._parse_frame(frame)
+    # And the writer's collector never emits one: RemoteWriter drops
+    # over-long keys before framing (collector.py _collect).
+
+
+def test_msamples_zlib_bomb_bounded_by_claimed_count():
+    """A header claiming 1 sample buys ~67 KB of inflation allowance;
+    a blob inflating to 8 MiB must be refused at the bound, never
+    allocated in full."""
+    bomb_json = b'{"s":[["k",1.0],' \
+        + b'["pad",0.0],' * 200_000 + b'["k2",2.0]]}'
+    frame = wire._MSAMPLES_HDR.pack(
+        wire._TAG_MSAMPLES, 100.0, 1, 0,
+    ) + zlib.compress(bomb_json, 9)
+    assert len(bomb_json) > 2 << 20
+    with pytest.raises(wire.WireError):
+        wire._parse_frame(frame)
+
+
+def test_msamples_collector_reader_survives_hostile_frames(tmp_path):
+    """End-to-end: every hostile shape above thrown at a live
+    CollectorServer link — each kills at most its OWN link, the store
+    stays unpolluted, and a well-formed push afterwards lands."""
+    from gol_tpu.obs.collector import CollectorServer
+    from gol_tpu.obs.tsdb import TSDB
+
+    db = TSDB()
+    srv = CollectorServer("127.0.0.1", 0, db).start()
+
+    def attach(source):
+        sock = socket.create_connection(srv.address, timeout=5)
+        wire.send_msg(sock, {"t": "hello", "mode": "remote-write",
+                             "source": source, "binary": True})
+        assert wire.recv_msg(sock, allow_binary=False) \
+            .get("t") == "attach-ack"
+        return sock
+
+    good_frame, _ = _msamples_frame(ts=50.0, n=2)
+    hostile = []
+    f = bytearray(good_frame)
+    struct.pack_into("<I", f, 9, 3)  # lying count
+    hostile.append(bytes(f))
+    hostile.append(good_frame[:len(good_frame) // 2])  # truncated
+    hostile.append(wire._MSAMPLES_HDR.pack(
+        wire._TAG_MSAMPLES, float("nan"), 0, 0,
+    ) + zlib.compress(b'{"s":[]}', 1))
+    bomb = b'{"s":[' + b'["pad",0.0],' * 200_000 + b'["k",1.0]]}'
+    hostile.append(wire._MSAMPLES_HDR.pack(
+        wire._TAG_MSAMPLES, 100.0, 1, 0,
+    ) + zlib.compress(bomb, 9))
+    try:
+        for i, frame in enumerate(hostile):
+            sock = attach(f"evil{i}")
+            wire.send_frame(sock, frame)
+            # The link must die (recv sees EOF), not the server.
+            sock.settimeout(10)
+            try:
+                assert sock.recv(1) == b""
+            except (TimeoutError, OSError):
+                raise AssertionError(
+                    f"hostile frame {i} did not kill its link"
+                )
+            finally:
+                sock.close()
+        assert db.sources() == [], "no hostile sample may land"
+        ok = attach("good")
+        wire.send_frame(ok, good_frame)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and db.latest("good") == {}:
+            time.sleep(0.02)
+        assert db.latest("good") != {}, "good link must still serve"
+        ok.close()
+    finally:
+        srv.close()
